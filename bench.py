@@ -1,0 +1,55 @@
+"""Benchmark harness — mirrors the reference's shape
+(``/root/reference/benchmarks/test_base.py:18-88``: N compiled steps,
+wall-clock after warm-up) on the BASELINE.json north-star config:
+PSO, pop=100k, dim=1000, Sphere, generations/sec on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Run with the default environment so the real TPU (axon) backend is used.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def bench_pso(pop_size: int = 100_000, dim: int = 1000, n_steps: int = 100) -> dict:
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    lb = jnp.full((dim,), -10.0)
+    ub = jnp.full((dim,), 10.0)
+    wf = StdWorkflow(PSO(pop_size, lb, ub), Sphere())
+    state = wf.init(jax.random.key(0))
+    init_step = jax.jit(wf.init_step, donate_argnums=0)
+    step = jax.jit(wf.step, donate_argnums=0)
+
+    # Warm-up: compile both programs and run a couple of steps.
+    state = init_step(state)
+    for _ in range(2):
+        state = step(state)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state = step(state)
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+
+    gens_per_sec = n_steps / elapsed
+    return {
+        "metric": f"PSO generations/sec/chip (pop={pop_size}, dim={dim}, Sphere)",
+        "value": round(gens_per_sec, 3),
+        "unit": "generations/sec",
+        # The reference publishes no concrete numbers (BASELINE.md); 1.0 marks
+        # "no published baseline to normalize against".
+        "vs_baseline": 1.0,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_pso()))

@@ -1,0 +1,54 @@
+"""evox_tpu: a TPU-native (JAX/XLA/Pallas) evolutionary computation framework
+with the capabilities of EvoX v1.2.2 (see SURVEY.md for the blueprint).
+
+Top-level re-exports mirror the reference (``src/evox/__init__.py``): core
+symbols flat, subpackages as namespaces, with namespace-package extensions
+auto-loaded at import (``evox_tpu_ext``).
+"""
+
+__version__ = "0.1.0"
+
+from . import algorithms, core, metrics, operators, problems, utils, vis_tools, workflows
+from .core import (
+    Algorithm,
+    Monitor,
+    Mutable,
+    Parameter,
+    Problem,
+    State,
+    Workflow,
+    compile,
+    jit,
+    use_state,
+    vmap,
+)
+
+__all__ = [
+    "algorithms",
+    "core",
+    "metrics",
+    "operators",
+    "problems",
+    "utils",
+    "vis_tools",
+    "workflows",
+    "Algorithm",
+    "Problem",
+    "Workflow",
+    "Monitor",
+    "State",
+    "Parameter",
+    "Mutable",
+    "compile",
+    "jit",
+    "vmap",
+    "use_state",
+]
+
+# Plugin autoload (reference: ``src/evox/__init__.py:27-29``).
+try:
+    from evox_tpu_ext import auto_load_extensions
+
+    auto_load_extensions()
+except ImportError:
+    pass

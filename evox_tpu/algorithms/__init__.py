@@ -1,0 +1,5 @@
+"""Algorithm library (reference: ``src/evox/algorithms/__init__.py:1-37``)."""
+
+__all__ = ["PSO"]
+
+from .so.pso_variants import PSO

@@ -1,0 +1,3 @@
+__all__ = ["PSO"]
+
+from .pso import PSO

@@ -1,0 +1,42 @@
+"""Shared helpers for PSO variants (reference:
+``src/evox/algorithms/so/pso_variants/utils.py:6-48``)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["min_by", "max_by", "random_select_from_mask"]
+
+
+def min_by(
+    values: Sequence[jax.Array], keys: Sequence[jax.Array]
+) -> tuple[jax.Array, jax.Array]:
+    """Global-argmin reduction over a list of candidate tensors: concatenate
+    ``keys`` (fitness) and ``values`` (locations) and return the value/key at
+    the overall minimum.  Reference ``utils.py:6-22``."""
+    keys_cat = jnp.concatenate([jnp.atleast_1d(k) for k in keys])
+    values_cat = jnp.concatenate([jnp.atleast_2d(v) for v in values])
+    idx = jnp.argmin(keys_cat)
+    return values_cat[idx], keys_cat[idx]
+
+
+def max_by(
+    values: Sequence[jax.Array], keys: Sequence[jax.Array]
+) -> tuple[jax.Array, jax.Array]:
+    keys_cat = jnp.concatenate([jnp.atleast_1d(k) for k in keys])
+    values_cat = jnp.concatenate([jnp.atleast_2d(v) for v in values])
+    idx = jnp.argmax(keys_cat)
+    return values_cat[idx], keys_cat[idx]
+
+
+def random_select_from_mask(key: jax.Array, mask: jax.Array) -> jax.Array:
+    """For each row of a boolean ``mask``, pick one True column uniformly at
+    random (rows with no True entries return index 0).  Reference
+    ``utils.py:24-48`` — implemented there with masked randperm; here with
+    Gumbel-max over the mask, a single fused op on TPU."""
+    g = jax.random.gumbel(key, mask.shape)
+    scores = jnp.where(mask, g, -jnp.inf)
+    return jnp.argmax(scores, axis=-1)

@@ -1,0 +1,32 @@
+"""Core runtime: pytree state, component protocols, functional transforms.
+
+TPU-native counterpart of the reference core (``src/evox/core/``): the
+reference's ``compile``/``vmap`` wrappers (``core/module.py:111-141``) are
+plain ``jax.jit``/``jax.vmap`` here (no scalar-index workarounds needed — XLA
+handles 0-d indexing natively), and ``use_state`` (``module.py:154-190``) is
+the identity because all component methods are already pure.
+"""
+
+from jax import jit, vmap  # re-export: the reference exports compile/vmap
+
+from .components import Algorithm, EvalFn, Monitor, Problem, Workflow
+from .state import Mutable, Parameter, State, get_params, set_params, use_state
+
+compile = jit  # reference name (``evox.core.compile``)
+
+__all__ = [
+    "Algorithm",
+    "Problem",
+    "Workflow",
+    "Monitor",
+    "EvalFn",
+    "State",
+    "Parameter",
+    "Mutable",
+    "get_params",
+    "set_params",
+    "use_state",
+    "compile",
+    "jit",
+    "vmap",
+]

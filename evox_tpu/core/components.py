@@ -1,0 +1,138 @@
+"""Abstract component interfaces: Algorithm / Problem / Workflow / Monitor.
+
+Mirrors the reference's component layer (``src/evox/core/components.py:17-146``)
+re-designed for JAX: every method is a pure function threading an immutable
+:class:`~evox_tpu.core.state.State`, with explicit PRNG keys stored *inside*
+the state (``state.key``) so that ``step(state) -> state`` is self-contained
+and therefore directly ``jax.jit``-able, ``jax.vmap``-able (distinct per-
+instance keys give "different" randomness for free) and usable as a
+``lax.fori_loop``/``lax.scan`` body.
+
+Contract differences from the reference, by design:
+
+* ``Algorithm.step(state, evaluate) -> state`` receives the evaluation
+  callback explicitly instead of a workflow-injected ``self.evaluate`` proxy
+  (reference ``components.py:35-46`` + dynamic subclassing in
+  ``std_workflow.py:116-125``).  The callback must be called **exactly once
+  per step, at the top trace level** (not under ``lax.cond``/``scan``) — the
+  same implicit contract the reference's compiled path has.
+* Problems and monitors thread their own sub-states explicitly; there is no
+  module-global side channel.  Host-side history uses ``io_callback``
+  (see ``workflows/eval_monitor.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+from .state import State
+
+__all__ = ["Algorithm", "Problem", "Workflow", "Monitor", "EvalFn"]
+
+# evaluate(population) -> fitness; provided to Algorithm.step by the workflow.
+EvalFn = Callable[[jax.Array], jax.Array]
+
+
+class _Component:
+    """Shared base: components are plain Python objects holding *static*
+    configuration only; all evolving values live in the State returned by
+    ``setup``. Being static, instances can be closed over by jitted code."""
+
+    def setup(self, key: jax.Array) -> State:
+        """Build this component's initial state. Default: stateless."""
+        del key
+        return State()
+
+    # Components are static w.r.t. jit: hashable by identity.
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __eq__(self, other: Any) -> bool:
+        return self is other
+
+
+class Algorithm(_Component):
+    """An optimization algorithm (reference ``components.py:17-50``).
+
+    Subclasses implement:
+
+    * ``setup(key) -> State`` — initial population/state; hyperparameters
+      wrapped in :class:`Parameter`, evolving buffers as plain arrays.
+    * ``step(state, evaluate) -> State`` — one ask-eval-tell generation.
+    * ``init_step(state, evaluate) -> State`` — optional first-generation
+      variant (defaults to ``step``).
+    * ``final_step(state, evaluate) -> State`` — optional last generation.
+    * ``record_step(state) -> dict`` — optional auxiliary values for the
+      monitor (reference ``record_step``, ``components.py:47-50``).
+    """
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        raise NotImplementedError
+
+    def init_step(self, state: State, evaluate: EvalFn) -> State:
+        return self.step(state, evaluate)
+
+    def final_step(self, state: State, evaluate: EvalFn) -> State:
+        return self.step(state, evaluate)
+
+    def record_step(self, state: State) -> dict[str, Any]:
+        del state
+        return {}
+
+
+class Problem(_Component):
+    """An optimization problem (reference ``components.py:53-69``).
+
+    ``evaluate(state, pop) -> (fitness, state)``: fitness is ``(pop_size,)``
+    for single-objective or ``(pop_size, n_obj)`` for multi-objective
+    problems.  Stateless problems simply return ``state`` unchanged.
+    """
+
+    def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
+        raise NotImplementedError
+
+
+class Workflow(_Component):
+    """A steppable composition of components (reference ``components.py:72-85``)."""
+
+    def init_step(self, state: State) -> State:
+        return self.step(state)
+
+    def step(self, state: State) -> State:
+        raise NotImplementedError
+
+    def final_step(self, state: State) -> State:
+        return self.step(state)
+
+
+class Monitor(_Component):
+    """Hook pipeline around evaluation (reference ``components.py:88-146``).
+
+    All hooks are pure ``(state, value) -> state``; the no-op base makes a
+    bare ``Monitor()`` a zero-cost default.
+    """
+
+    def set_config(self, **config: Any) -> "Monitor":
+        return self
+
+    def post_ask(self, state: State, population: jax.Array) -> State:
+        del population
+        return state
+
+    def pre_eval(self, state: State, population: jax.Array) -> State:
+        del population
+        return state
+
+    def post_eval(self, state: State, fitness: jax.Array) -> State:
+        del fitness
+        return state
+
+    def pre_tell(self, state: State, fitness: jax.Array) -> State:
+        del fitness
+        return state
+
+    def record_auxiliary(self, state: State, aux: dict[str, Any]) -> State:
+        del aux
+        return state

@@ -1,0 +1,221 @@
+"""Immutable pytree state containers — the TPU-native replacement for the
+reference's ``ModuleBase``/``Mutable``/``Parameter``/``use_state`` machinery
+(reference: ``src/evox/core/module.py:22-190``).
+
+The reference spends most of its core on making *mutable* ``nn.Module``
+attributes work under ``torch.compile``/``torch.vmap`` (``use_state``,
+``TransformGetSetItemToIndex``).  JAX's functional model makes all of that
+unnecessary: evolving state lives in an immutable :class:`State` pytree and
+every component method is a pure function ``state -> state``.  ``jax.jit``,
+``jax.vmap``, ``jax.lax.fori_loop`` and ``shard_map`` then compose natively.
+
+Two leaf-labeling wrappers mirror the reference's semantics:
+
+* :class:`Parameter` — an HPO-tunable hyperparameter (reference
+  ``Parameter``, ``module.py:22-45``).  Recorded in the ``State``'s static
+  metadata so :func:`get_params`/:func:`set_params` can expose exactly the
+  tunable subtree to meta-optimizers (see ``problems/hpo_wrapper.py``).
+* :class:`Mutable` — evolving state (reference ``Mutable``,
+  ``module.py:48-58``).  In this framework *every* non-``Parameter`` leaf is
+  mutable state, so the wrapper is accepted for parity but adds no behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Mapping
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Parameter",
+    "Mutable",
+    "State",
+    "get_params",
+    "set_params",
+    "use_state",
+]
+
+
+class Parameter:
+    """Marks a value as an HPO-visible hyperparameter when building a State."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any, dtype=None):
+        self.value = jnp.asarray(value, dtype=dtype)
+
+
+class Mutable:
+    """Marks a value as evolving state (accepted for API parity; all
+    non-Parameter State leaves are mutable by construction)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any, dtype=None):
+        self.value = jnp.asarray(value, dtype=dtype)
+
+
+def _convert(v: Any) -> Any:
+    if isinstance(v, (Parameter, Mutable)):
+        return v.value
+    return v
+
+
+@jax.tree_util.register_pytree_with_keys_class
+class State(Mapping):
+    """An immutable, ordered, attribute-accessible pytree mapping.
+
+    ``State(w=Parameter(0.6), pop=pop)`` records ``{"w"}`` as the set of
+    hyperparameter keys in static (aux) metadata, so tree transformations
+    preserve the labeling and HPO wrappers can find tunables by path.
+
+    Values may be arrays, arbitrary pytrees, or nested ``State`` objects
+    (e.g. a workflow state holding algorithm/problem/monitor sub-states).
+    """
+
+    __slots__ = ("_data", "_param_keys")
+
+    def __init__(self, _param_keys: frozenset[str] | None = None, **kwargs: Any):
+        params = set(_param_keys or ())
+        data = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Parameter):
+                params.add(k)
+            data[k] = _convert(v)
+        object.__setattr__(self, "_data", data)
+        object.__setattr__(self, "_param_keys", frozenset(params))
+
+    # -- Mapping protocol ---------------------------------------------------
+    def __getitem__(self, key: str) -> Any:
+        return self._data[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._data)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __getattr__(self, key: str) -> Any:
+        # Never resolve dunder/slot names through _data: during unpickling /
+        # copy the _data slot is not yet set and object.__getattribute__
+        # falls through to here — recursing on self._data would loop forever.
+        if key.startswith("_"):
+            raise AttributeError(key)
+        try:
+            return self._data[key]
+        except KeyError:
+            raise AttributeError(key) from None
+
+    def __setattr__(self, key: str, value: Any):
+        raise AttributeError("State is immutable; use .replace(**updates)")
+
+    # pickle/copy support: restore slots without tripping the immutability
+    # guard in __setattr__.
+    def __getstate__(self):
+        return (self._data, self._param_keys)
+
+    def __setstate__(self, state):
+        data, params = state
+        object.__setattr__(self, "_data", data)
+        object.__setattr__(self, "_param_keys", params)
+
+    def __repr__(self) -> str:
+        inner = ", ".join(
+            f"{k}{'*' if k in self._param_keys else ''}={_short(v)}"
+            for k, v in self._data.items()
+        )
+        return f"State({inner})"
+
+    # -- functional update --------------------------------------------------
+    def replace(self, **updates: Any) -> "State":
+        """Return a new State with the given fields replaced (new Parameter
+        wrappers extend the param-key set)."""
+        data = dict(self._data)
+        params = set(self._param_keys)
+        for k, v in updates.items():
+            if isinstance(v, Parameter):
+                params.add(k)
+            data[k] = _convert(v)
+        new = object.__new__(State)
+        object.__setattr__(new, "_data", data)
+        object.__setattr__(new, "_param_keys", frozenset(params))
+        return new
+
+    @property
+    def param_keys(self) -> frozenset[str]:
+        return self._param_keys
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten_with_keys(self):
+        keys = tuple(self._data.keys())
+        children = tuple(
+            (jax.tree_util.DictKey(k), self._data[k]) for k in keys
+        )
+        return children, (keys, self._param_keys)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        keys, param_keys = aux
+        new = object.__new__(cls)
+        object.__setattr__(new, "_data", dict(zip(keys, children)))
+        object.__setattr__(new, "_param_keys", param_keys)
+        return new
+
+
+def _short(v: Any) -> str:
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return f"{v.dtype}{list(v.shape)}"
+    return repr(v)
+
+
+# ---------------------------------------------------------------------------
+# Hyperparameter access (reference: HPOProblemWrapper.get_init_params,
+# ``src/evox/problems/hpo_wrapper.py:297-340`` — there it walks nn.Parameter
+# entries of a stacked state_dict; here we walk Parameter-labeled State keys).
+# ---------------------------------------------------------------------------
+
+def get_params(state: State, prefix: str = "") -> dict[str, Any]:
+    """Collect all Parameter-labeled leaves of a (nested) State as a flat
+    ``{"path.to.param": value}`` dict."""
+    out: dict[str, Any] = {}
+    for k, v in state.items():
+        path = f"{prefix}{k}"
+        if isinstance(v, State):
+            out.update(get_params(v, path + "."))
+        elif k in state.param_keys:
+            out[path] = v
+    return out
+
+
+def set_params(state: State, params: Mapping[str, Any]) -> State:
+    """Return a new State with the given ``{"path.to.param": value}`` entries
+    replaced. Unknown paths raise ``KeyError``."""
+    updates: dict[str, Any] = {}
+    nested: dict[str, dict[str, Any]] = {}
+    for path, v in params.items():
+        head, _, rest = path.partition(".")
+        if rest:
+            nested.setdefault(head, {})[rest] = v
+        else:
+            if head not in state.param_keys:
+                raise KeyError(f"{head!r} is not a Parameter of {state!r}")
+            updates[head] = v
+    for head, sub in nested.items():
+        child = state[head]
+        if not isinstance(child, State):
+            raise KeyError(f"{head!r} is not a nested State")
+        updates[head] = set_params(child, sub)
+    return state.replace(**updates)
+
+
+def use_state(fn: Callable, /) -> Callable:
+    """API-parity shim for the reference's ``use_state``
+    (``src/evox/core/module.py:154-190``).
+
+    There, ``use_state`` converts a stateful module method into a pure
+    ``state_dict -> state_dict'`` function via ``torch.func.functional_call``.
+    Here every component method is *already* pure ``(state, ...) -> state``,
+    so this is the identity — kept so reference-style code reads the same.
+    """
+    return fn

@@ -1,0 +1,7 @@
+"""Operator layer: pure tensor->tensor functions with explicit PRNG keys
+(reference: ``src/evox/operators/__init__.py:1-4``)."""
+
+__all__ = ["crossover", "mutation", "sampling", "selection", "crowding_distance", "non_dominate_rank"]
+
+from . import crossover, mutation, sampling, selection
+from .selection import crowding_distance, non_dominate_rank

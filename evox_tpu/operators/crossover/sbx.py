@@ -1,0 +1,55 @@
+"""Simulated binary crossover (SBX), full and half-offspring variants
+(reference: ``src/evox/operators/crossover/sbx.py:4-39`` and
+``sbx_half.py:4-35``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["simulated_binary", "simulated_binary_half"]
+
+
+def _sbx_beta(key: jax.Array, shape, pro_c: float, dis_c: float, dtype) -> jax.Array:
+    mu_key, dir_key, p1_key, p2_key = jax.random.split(key, 4)
+    mu = jax.random.uniform(mu_key, shape, dtype=dtype)
+    beta = jnp.where(
+        mu <= 0.5,
+        (2.0 * mu) ** (1.0 / (dis_c + 1.0)),
+        (2.0 - 2.0 * mu) ** (-1.0 / (dis_c + 1.0)),
+    )
+    # Random contraction/expansion direction per gene.
+    sign = 1 - 2 * jax.random.randint(dir_key, shape, 0, 2)
+    beta = beta * sign
+    # Half the genes (and all genes of non-crossover pairs) pass through.
+    beta = jnp.where(jax.random.uniform(p1_key, shape, dtype=dtype) < 0.5, 1.0, beta)
+    beta = jnp.where(jax.random.uniform(p2_key, shape, dtype=dtype) > pro_c, 1.0, beta)
+    return beta
+
+
+def simulated_binary(
+    key: jax.Array, x: jax.Array, pro_c: float = 1.0, dis_c: float = 20.0
+) -> jax.Array:
+    """SBX producing a full set of offspring (two per parent pair).
+
+    :param x: parents, (n, d); pairs are (x[i], x[i + n//2]).
+    :return: (2 * (n // 2), d) offspring.
+    """
+    n, d = x.shape
+    p1 = x[: n // 2]
+    p2 = x[n // 2 : n // 2 * 2]
+    beta = _sbx_beta(key, p1.shape, pro_c, dis_c, x.dtype)
+    mean = (p1 + p2) / 2.0
+    diff = beta * (p1 - p2) / 2.0
+    return jnp.concatenate([mean + diff, mean - diff], axis=0)
+
+
+def simulated_binary_half(
+    key: jax.Array, x: jax.Array, pro_c: float = 1.0, dis_c: float = 20.0
+) -> jax.Array:
+    """SBX producing one offspring per parent pair ((n // 2, d))."""
+    n, d = x.shape
+    p1 = x[: n // 2]
+    p2 = x[n // 2 : n // 2 * 2]
+    beta = _sbx_beta(key, p1.shape, pro_c, dis_c, x.dtype)
+    return (p1 + p2) / 2.0 + beta * (p1 - p2) / 2.0

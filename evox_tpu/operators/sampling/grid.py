@@ -1,0 +1,25 @@
+"""Grid (meshgrid lattice) sampling (reference:
+``src/evox/operators/sampling/gird.py:7-32`` — the reference file name is a
+typo kept out of this tree; the module is re-exported under both names)."""
+
+from __future__ import annotations
+
+from math import ceil
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["grid_sampling"]
+
+
+def grid_sampling(n: int, m: int) -> tuple[jax.Array, int]:
+    """Uniform lattice of ~``n`` points in the unit hypercube ``[0, 1]^m``.
+
+    :return: ``(points, n_samples)`` with ``n_samples = ceil(n^(1/m))^m``.
+    """
+    num_points = int(ceil(n ** (1 / m)))
+    gap = jnp.linspace(0.0, 1.0, num_points)
+    grid = jnp.meshgrid(*([gap] * m), indexing="ij")
+    w = jnp.stack(grid, axis=-1).reshape(-1, m)
+    w = w[:, ::-1]
+    return w, w.shape[0]

@@ -1,0 +1,38 @@
+"""Latin hypercube sampling (reference:
+``src/evox/operators/sampling/latin_hypercube.py:4-38``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["latin_hypercube_sampling", "latin_hypercube_sampling_standard"]
+
+
+def latin_hypercube_sampling_standard(
+    key: jax.Array, n: int, d: int, smooth: bool = True
+) -> jax.Array:
+    """LHS in the unit hypercube: one sample per stratum per dimension, with
+    independently permuted strata across dimensions.
+
+    :return: (n, d) samples.
+    """
+    perm_key, jitter_key = jax.random.split(key)
+    # Independent permutation of the n strata in each of the d columns.
+    cells = jnp.argsort(jax.random.uniform(perm_key, (n, d)), axis=0).astype(
+        jnp.float32
+    )
+    if smooth:
+        offset = jax.random.uniform(jitter_key, (n, d))
+    else:
+        offset = 0.5
+    return (cells + offset) / n
+
+
+def latin_hypercube_sampling(
+    key: jax.Array, n: int, lb: jax.Array, ub: jax.Array, smooth: bool = True
+) -> jax.Array:
+    """LHS in the box ``[lb, ub]`` (both 1-D of size ``d``)."""
+    assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+    samples = latin_hypercube_sampling_standard(key, n, lb.shape[0], smooth)
+    return lb[None, :] + samples.astype(lb.dtype) * (ub - lb)[None, :]

@@ -1,0 +1,49 @@
+"""Das-Dennis simplex-lattice reference-vector sampling for MOEAs
+(reference: ``src/evox/operators/sampling/uniform.py:8-51``).  Host-side
+(itertools) construction, exactly like the reference — reference vectors are
+computed once at algorithm setup, never inside the jitted loop."""
+
+from __future__ import annotations
+
+import itertools
+from math import comb
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["uniform_sampling"]
+
+
+def _das_dennis_layer(h: int, m: int) -> np.ndarray:
+    combos = np.asarray(
+        list(itertools.combinations(range(1, h + m), m - 1)), dtype=np.float64
+    )
+    combos = combos - np.arange(m - 1)[None, :] - 1
+    left = np.concatenate([combos, np.full((combos.shape[0], 1), h)], axis=1)
+    right = np.concatenate([np.zeros((combos.shape[0], 1)), combos], axis=1)
+    return (left - right) / h
+
+
+def uniform_sampling(n: int, m: int) -> tuple[jax.Array, int]:
+    """Generate ~``n`` uniformly spread points on the ``m``-simplex (Das and
+    Dennis's method, with Deb and Jain's inner-layer augmentation when the
+    boundary layer is too coarse).
+
+    :return: ``(points, n_samples)``; points have shape ``(n_samples, m)``.
+    """
+    h1 = 1
+    while comb(h1 + m, m - 1) <= n:
+        h1 += 1
+    w = _das_dennis_layer(h1, m)
+
+    if h1 < m:
+        h2 = 0
+        while comb(h1 + m - 1, m - 1) + comb(h2 + m, m - 1) <= n:
+            h2 += 1
+        if h2 > 0:
+            w2 = _das_dennis_layer(h2, m)
+            w = np.concatenate([w, w2 / 2.0 + 1.0 / (2.0 * m)], axis=0)
+
+    w = np.maximum(w, 1e-6)
+    return jnp.asarray(w, dtype=jnp.float32), w.shape[0]

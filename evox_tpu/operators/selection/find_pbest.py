@@ -1,0 +1,22 @@
+"""p-best selection (reference:
+``src/evox/operators/selection/find_pbest.py:4-19``): for each individual,
+pick a random member of the top-``percent`` fraction of the population.
+Used by SHADE/JaDE-style adaptive DE."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["select_rand_pbest"]
+
+
+def select_rand_pbest(
+    key: jax.Array, percent: float, population: jax.Array, fitness: jax.Array
+) -> jax.Array:
+    """:return: ``(pop_size, dim)`` p-best vectors, one per individual."""
+    pop_size = population.shape[0]
+    top_p_num = max(int(pop_size * percent), 1)
+    pbest_pool = jnp.argsort(fitness)[:top_p_num]
+    random_indices = jax.random.randint(key, (pop_size,), 0, top_p_num)
+    return population[pbest_pool[random_indices]]

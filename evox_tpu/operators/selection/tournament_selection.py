@@ -1,0 +1,49 @@
+"""Tournament selection (reference:
+``src/evox/operators/selection/tournament_selection.py:8-54``), with explicit
+PRNG keys in place of global torch RNG."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...utils import lexsort
+
+__all__ = ["tournament_selection", "tournament_selection_multifit"]
+
+
+def tournament_selection(
+    key: jax.Array, n_round: int, fitness: jax.Array, tournament_size: int = 2
+) -> jax.Array:
+    """Single-fitness k-tournament: for each of ``n_round`` rounds draw
+    ``tournament_size`` random candidates and keep the argmin-fitness one.
+
+    :return: ``(n_round,)`` indices of the winners.
+    """
+    num_candidates = fitness.shape[0]
+    parents = jax.random.randint(
+        key, (n_round, tournament_size), 0, num_candidates
+    )
+    winners = jnp.argmin(fitness[parents], axis=1)
+    return jnp.take_along_axis(parents, winners[:, None], axis=1).squeeze(1)
+
+
+def tournament_selection_multifit(
+    key: jax.Array,
+    n_round: int,
+    fitnesses: Sequence[jax.Array],
+    tournament_size: int = 2,
+) -> jax.Array:
+    """Multi-fitness k-tournament: winners decided lexicographically over the
+    fitness list (last entry most significant — numpy ``lexsort`` convention,
+    matching the reference)."""
+    fitness_tensor = jnp.stack(fitnesses, axis=1)  # (n, k)
+    num_candidates = fitness_tensor.shape[0]
+    parents = jax.random.randint(
+        key, (n_round, tournament_size), 0, num_candidates
+    )
+    cand = fitness_tensor[parents]  # (n_round, tournament_size, k)
+    order = lexsort([cand[..., i] for i in range(cand.shape[-1])])
+    return jnp.take_along_axis(parents, order[:, :1], axis=1).squeeze(1)

@@ -1,0 +1,68 @@
+"""Pallas blocked dominance-matrix kernel.
+
+The O(n²m) dominance matrix is the hot spot of non-dominated sorting
+(SURVEY §2.3 ⚠ — reference ``operators/selection/non_dominate.py:6-26``
+computes it as a broadcasted (n, n, m) compare).  For pop ≥ ~4k, this kernel
+computes the (n, n) boolean matrix in (B, B) VMEM tiles, never materializing
+an (n, n, m) intermediate: objectives are laid out ``(m, n)`` so each tile
+compare is an unrolled loop of ``(B, 1) vs (1, B)`` VPU ops.
+
+Falls back to interpret mode off-TPU so tests exercise the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["dominance_matrix"]
+
+
+def _dominance_kernel(xi_ref, xj_ref, out_ref, *, n_obj: int):
+    # xi_ref, xj_ref: (m, B) objective columns for the row/col tile.
+    le = None
+    lt = None
+    for k in range(n_obj):
+        a = xi_ref[k, :][:, None]  # (B, 1)
+        b = xj_ref[k, :][None, :]  # (1, B)
+        le_k = a <= b
+        lt_k = a < b
+        le = le_k if le is None else (le & le_k)
+        lt = lt_k if lt is None else (lt | lt_k)
+    out_ref[...] = le & lt
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+def dominance_matrix(
+    f: jax.Array, block_size: int = 512, interpret: bool | None = None
+) -> jax.Array:
+    """Return the (n, n) boolean matrix ``A[i, j] = f_i dominates f_j``.
+
+    :param f: objectives, (n, m) float.
+    :param block_size: tile edge; rounded down to n when larger.
+    :param interpret: force pallas interpret mode (default: off-TPU only).
+    """
+    n, m = f.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bs = min(block_size, n)
+    n_pad = -(-n // bs) * bs
+    # (m, n) layout: the population axis is the 128-lane axis.
+    xt = jnp.pad(
+        f.T.astype(jnp.float32), ((0, 0), (0, n_pad - n)), constant_values=jnp.inf
+    )
+    out = pl.pallas_call(
+        functools.partial(_dominance_kernel, n_obj=m),
+        grid=(n_pad // bs, n_pad // bs),
+        in_specs=[
+            pl.BlockSpec((m, bs), lambda i, j: (0, i)),
+            pl.BlockSpec((m, bs), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bs, bs), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, n_pad), jnp.bool_),
+        interpret=interpret,
+    )(xt, xt)
+    return out[:n, :n]

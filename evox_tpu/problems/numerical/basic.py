@@ -1,0 +1,164 @@
+"""Basic numerical benchmark problems.
+
+TPU-native counterpart of the reference's basic suite
+(``src/evox/problems/numerical/basic.py:25-195``): the same seven functions
+(Ackley, Griewank, Rastrigin, Rosenbrock, Schwefel, Sphere, Ellipsoid) behind
+a shift+affine pre-transform base.  All are whole-population ``(N, D) -> (N,)``
+tensor expressions — on TPU the affine transform is a single ``(N,D)x(D,D)``
+matmul on the MXU and everything else fuses into it.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import Problem, State
+
+__all__ = [
+    "ShiftAffineNumericalProblem",
+    "Ackley",
+    "Griewank",
+    "Rastrigin",
+    "Rosenbrock",
+    "Schwefel",
+    "Sphere",
+    "Ellipsoid",
+    "ackley_func",
+    "griewank_func",
+    "rastrigin_func",
+    "rosenbrock_func",
+    "schwefel_func",
+    "sphere_func",
+    "ellipsoid_func",
+]
+
+
+def ackley_func(a: float, b: float, c: float, x: jax.Array) -> jax.Array:
+    d = x.shape[1]
+    return (
+        -a * jnp.exp(-b * jnp.sqrt(jnp.sum(x**2, axis=1) / d))
+        - jnp.exp(jnp.sum(jnp.cos(c * x), axis=1) / d)
+        + a
+        + math.e
+    )
+
+
+def griewank_func(x: jax.Array) -> jax.Array:
+    d = x.shape[1]
+    i = jnp.arange(1, d + 1, dtype=x.dtype)
+    return (
+        jnp.sum(x**2, axis=1) / 4000.0
+        - jnp.prod(jnp.cos(x / jnp.sqrt(i)), axis=1)
+        + 1.0
+    )
+
+
+def rastrigin_func(x: jax.Array) -> jax.Array:
+    d = x.shape[1]
+    return 10.0 * d + jnp.sum(x**2 - 10.0 * jnp.cos(2.0 * jnp.pi * x), axis=1)
+
+
+def rosenbrock_func(x: jax.Array) -> jax.Array:
+    return jnp.sum(
+        100.0 * (x[:, 1:] - x[:, :-1] ** 2) ** 2 + (x[:, :-1] - 1.0) ** 2, axis=1
+    )
+
+
+def schwefel_func(x: jax.Array) -> jax.Array:
+    d = x.shape[1]
+    return 418.9828872724338 * d - jnp.sum(
+        x * jnp.sin(jnp.sqrt(jnp.abs(x))), axis=1
+    )
+
+
+def sphere_func(x: jax.Array) -> jax.Array:
+    return jnp.sum(x**2, axis=1)
+
+
+def ellipsoid_func(x: jax.Array) -> jax.Array:
+    d = x.shape[1]
+    i = jnp.arange(1, d + 1, dtype=x.dtype)
+    return jnp.sum(i * x**2, axis=1)
+
+
+class ShiftAffineNumericalProblem(Problem):
+    """Numerical problem with optional shift vector and affine matrix applied
+    to the population before evaluation (reference ``basic.py:25-59``)."""
+
+    def __init__(self, shift: jax.Array | None = None, affine: jax.Array | None = None):
+        if affine is not None:
+            affine = jnp.asarray(affine)
+            assert affine.ndim == 2 and affine.shape[0] == affine.shape[1]
+        if shift is not None:
+            shift = jnp.asarray(shift)
+            assert shift.ndim == 1
+            if affine is not None:
+                assert affine.shape[0] == shift.shape[0]
+        self.shift = shift
+        self.affine = affine
+
+    def _true_evaluate(self, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
+        if self.shift is not None:
+            pop = pop + self.shift[None, :]
+        if self.affine is not None:
+            pop = pop @ self.affine  # MXU matmul; elementwise eval fuses in
+        return self._true_evaluate(pop), state
+
+
+class Ackley(ShiftAffineNumericalProblem):
+    """Ackley function; minimum at x = 0 (reference ``basic.py:68-85``)."""
+
+    def __init__(self, a: float = 20.0, b: float = 0.2, c: float = 2 * math.pi, **kwargs):
+        super().__init__(**kwargs)
+        self.a, self.b, self.c = a, b, c
+
+    def _true_evaluate(self, x):
+        return ackley_func(self.a, self.b, self.c, x)
+
+
+class Griewank(ShiftAffineNumericalProblem):
+    """Griewank function; minimum at x = 0."""
+
+    def _true_evaluate(self, x):
+        return griewank_func(x)
+
+
+class Rastrigin(ShiftAffineNumericalProblem):
+    """Rastrigin function; minimum at x = 0."""
+
+    def _true_evaluate(self, x):
+        return rastrigin_func(x)
+
+
+class Rosenbrock(ShiftAffineNumericalProblem):
+    """Rosenbrock function; minimum at x = 1."""
+
+    def _true_evaluate(self, x):
+        return rosenbrock_func(x)
+
+
+class Schwefel(ShiftAffineNumericalProblem):
+    """Schwefel function; minimum at x = 420.9687."""
+
+    def _true_evaluate(self, x):
+        return schwefel_func(x)
+
+
+class Sphere(ShiftAffineNumericalProblem):
+    """Sphere function; minimum at x = 0."""
+
+    def _true_evaluate(self, x):
+        return sphere_func(x)
+
+
+class Ellipsoid(ShiftAffineNumericalProblem):
+    """Ellipsoid function; minimum at x = 0."""
+
+    def _true_evaluate(self, x):
+        return ellipsoid_func(x)

@@ -1,0 +1,46 @@
+"""Utility layer (reference: ``src/evox/utils/__init__.py:1-22``)."""
+
+from jax.tree_util import tree_flatten, tree_unflatten  # re-exports, as reference
+
+from .ops import (
+    clamp,
+    clamp_float,
+    clamp_int,
+    clip,
+    lexsort,
+    maximum,
+    maximum_float,
+    maximum_int,
+    minimum,
+    minimum_float,
+    minimum_int,
+    nanmax,
+    nanmin,
+    randint,
+    switch,
+)
+from .params_vector import ParamsAndVector
+from .vmap_ops import host_op, register_vmap_op
+
+__all__ = [
+    "switch",
+    "clamp",
+    "clamp_float",
+    "clamp_int",
+    "clip",
+    "maximum",
+    "minimum",
+    "maximum_float",
+    "minimum_float",
+    "maximum_int",
+    "minimum_int",
+    "lexsort",
+    "nanmin",
+    "nanmax",
+    "randint",
+    "ParamsAndVector",
+    "register_vmap_op",
+    "host_op",
+    "tree_flatten",
+    "tree_unflatten",
+]

@@ -1,0 +1,89 @@
+"""Fusion-safe math ops — API parity with the reference's ``jit_fix`` family
+(``src/evox/utils/jit_fix_operator.py:6-388``).
+
+The reference re-implements ``clamp``/``maximum``/``minimum`` with ReLU
+arithmetic because torch Inductor could not fuse the native ops, and provides
+``lexsort``/``nanmin``/``nanmax``/``randint`` missing from compiled torch.
+On TPU, XLA fuses the native ``jnp`` ops directly, so these are thin wrappers
+kept for API parity (user code written against the reference's ``evox.utils``
+works unchanged), plus ``switch`` which remains genuinely useful.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "switch",
+    "clamp",
+    "clamp_int",
+    "clamp_float",
+    "clip",
+    "maximum",
+    "minimum",
+    "maximum_float",
+    "minimum_float",
+    "maximum_int",
+    "minimum_int",
+    "lexsort",
+    "nanmin",
+    "nanmax",
+    "randint",
+]
+
+
+def switch(label: jax.Array, values: Sequence[jax.Array]) -> jax.Array:
+    """Element-wise select-by-label: ``out[i] = values[label[i]][i]``.
+
+    Reference: ``jit_fix_operator.py`` ``switch`` — a chain of
+    ``torch.where``; here one gather over a stacked axis, which XLA lowers to
+    a single fused select tree.
+    """
+    stacked = jnp.stack(values, axis=0)  # (n_branches, ...)
+    label = jnp.clip(label, 0, stacked.shape[0] - 1)
+    return jnp.take_along_axis(stacked, label[None, ...], axis=0)[0]
+
+
+def clamp(a: jax.Array, lo: jax.Array, hi: jax.Array) -> jax.Array:
+    return jnp.clip(a, lo, hi)
+
+
+clamp_int = clamp
+clamp_float = clamp
+clip = clamp
+
+
+def maximum(a, b):
+    return jnp.maximum(a, b)
+
+
+def minimum(a, b):
+    return jnp.minimum(a, b)
+
+
+maximum_float = maximum_int = maximum
+minimum_float = minimum_int = minimum
+
+
+def lexsort(keys: Sequence[jax.Array] | jax.Array, dim: int = -1) -> jax.Array:
+    """Stable multi-key argsort; last key in ``keys`` is primary — numpy
+    convention, matching the reference's ``lexsort``."""
+    return jnp.lexsort(keys, axis=dim)
+
+
+def nanmin(a: jax.Array, axis=None, keepdims=False):
+    return jnp.nanmin(a, axis=axis, keepdims=keepdims)
+
+
+def nanmax(a: jax.Array, axis=None, keepdims=False):
+    return jnp.nanmax(a, axis=axis, keepdims=keepdims)
+
+
+def randint(key: jax.Array, shape, low, high) -> jax.Array:
+    """Uniform integers in ``[low, high)`` with tensor bounds (reference's
+    ``randint`` exists because compiled torch lacked tensor-bound randint;
+    ``jax.random.randint`` supports it natively)."""
+    return jax.random.randint(key, shape, low, high)

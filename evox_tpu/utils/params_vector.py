@@ -1,0 +1,58 @@
+"""Model-parameters ↔ flat-vector adapter.
+
+TPU-native counterpart of the reference's ``ParamsAndVector``
+(``src/evox/utils/parameters_and_vector.py:12-97``): there it flattens a
+torch module's ``named_parameters()`` into a flat vector (optionally batched)
+so a whole population of network weights can be evolved as a 2-D matrix.
+Here the same job is one ``jax.flatten_util.ravel_pytree`` plus a ``vmap``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+__all__ = ["ParamsAndVector"]
+
+
+class ParamsAndVector:
+    """Bidirectional adapter between a parameter pytree and a flat vector.
+
+    ``to_vector``/``to_params`` handle single models;
+    ``batched_to_vector``/``batched_to_params`` handle a population (leading
+    batch axis).  Calling the adapter itself applies ``batched_to_params`` so
+    it plugs into ``StdWorkflow`` as a ``solution_transform``, exactly like
+    the reference (``parameters_and_vector.py:95-97``).
+    """
+
+    def __init__(self, dummy_model: Any):
+        """``dummy_model``: an example parameter pytree fixing structure,
+        shapes and dtypes (the reference takes an ``nn.Module``; here any
+        pytree of arrays, e.g. a flax/haiku params dict)."""
+        flat, unravel = ravel_pytree(dummy_model)
+        self._unravel = unravel
+        self._size = flat.shape[0]
+        self._dtype = flat.dtype
+
+    @property
+    def vector_size(self) -> int:
+        return self._size
+
+    def to_vector(self, params: Any) -> jax.Array:
+        flat, _ = ravel_pytree(params)
+        return flat
+
+    def to_params(self, vector: jax.Array) -> Any:
+        return self._unravel(vector)
+
+    def batched_to_vector(self, batched_params: Any) -> jax.Array:
+        return jax.vmap(self.to_vector)(batched_params)
+
+    def batched_to_params(self, vectors: jax.Array) -> Any:
+        return jax.vmap(self._unravel)(vectors)
+
+    def __call__(self, vectors: jax.Array) -> Any:
+        return self.batched_to_params(vectors)

@@ -1,0 +1,75 @@
+"""Custom-op registration helpers.
+
+TPU-native counterpart of the reference's ``register_vmap_op``
+(``src/evox/utils/op_register.py:26-136``).  There, a decorator registers a
+``torch.library.custom_op`` with a fake (abstract-eval) function and stacked
+vmap rules up to ``max_vmap_level`` so host-side or graph-breaking code
+survives ``torch.compile`` + nested ``vmap`` (used by ``non_dominate_rank``
+and the Brax/HPO loops).
+
+In JAX the same needs decompose into two native mechanisms:
+
+* :func:`register_vmap_op` — wrap a function with
+  ``jax.custom_batching.custom_vmap`` and an explicit batch rule (default:
+  ``sequential_vmap``-style mapping, or a user rule).  Nested vmap composes
+  automatically, so there is no ``max_vmap_level`` bookkeeping.
+* :func:`host_op` — run a host-side (impure) function inside a jitted graph
+  via ``jax.pure_callback`` (or ``io_callback`` for ordered side effects),
+  the counterpart of the reference's fake-fn + eager-body custom ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["register_vmap_op", "host_op"]
+
+
+def register_vmap_op(vmap_fn: Callable | None = None):
+    """Decorator: give ``fn`` a custom batching rule.
+
+    ``vmap_fn(axis_size, in_batched, *args) -> (out, out_batched)`` follows
+    ``jax.custom_batching.custom_vmap``'s rule signature.  With no rule the
+    function is mapped sequentially via ``custom_batching.sequential_vmap``.
+    """
+
+    def decorator(fn: Callable) -> Callable:
+        if vmap_fn is None:
+            return jax.custom_batching.sequential_vmap(fn)
+        wrapped = jax.custom_batching.custom_vmap(fn)
+        wrapped.def_vmap(vmap_fn)
+        return wrapped
+
+    return decorator
+
+
+def host_op(
+    fn: Callable,
+    result_shape_dtypes: Any,
+    *,
+    ordered: bool = False,
+    vmap_method: str = "sequential",
+) -> Callable:
+    """Wrap a host-side function for use inside jit.
+
+    ``ordered=True`` uses ``io_callback`` with ordering enforced — the
+    counterpart of the reference's token-chained ``_data_sink``
+    (``workflows/eval_monitor.py:72-80``). Otherwise ``pure_callback``.
+    """
+    if ordered:
+        from jax.experimental import io_callback
+
+        def call(*args, **kw):
+            return io_callback(fn, result_shape_dtypes, *args, ordered=True, **kw)
+
+    else:
+
+        def call(*args, **kw):
+            return jax.pure_callback(
+                fn, result_shape_dtypes, *args, vmap_method=vmap_method, **kw
+            )
+
+    return call

@@ -1,0 +1,6 @@
+"""Workflow layer (reference: ``src/evox/workflows/__init__.py:1-7``)."""
+
+__all__ = ["StdWorkflow", "EvalMonitor"]
+
+from .eval_monitor import EvalMonitor
+from .std_workflow import StdWorkflow

@@ -1,0 +1,283 @@
+"""Evaluation monitor.
+
+TPU-native counterpart of the reference ``EvalMonitor``
+(``src/evox/workflows/eval_monitor.py:83-378``): tracks the latest
+solution/fitness and a running top-k *inside* jitted code as pure State, and
+streams full fitness/solution/auxiliary history to host memory.
+
+The reference escapes the compiled graph with a custom op ``_data_sink``
+chained through a token tensor to force ordering
+(``eval_monitor.py:46-80,243-251``).  Here the same side channel is
+``jax.experimental.io_callback(ordered=True)`` — the JAX effects system plays
+the token's role.  For vmapped (batched-instance) workflows pass
+``ordered=False``: callbacks then batch, and each history entry carries the
+extra instance axis.
+"""
+
+from __future__ import annotations
+
+import warnings
+import weakref
+from enum import IntEnum
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from ..core import Monitor, State
+
+__all__ = ["EvalMonitor"]
+
+
+class HistoryType(IntEnum):
+    FITNESS = 0
+    SOLUTION = 1
+    AUXILIARY = 2
+
+
+# Host-side history store: monitor id -> {HistoryType: [np.ndarray, ...]}
+# (reference: module-global ``__monitor_history__``, ``eval_monitor.py:46``).
+__monitor_history__: dict[int, dict[int, list]] = {}
+
+
+class EvalMonitor(Monitor):
+    """Monitor hooked around evaluation; records offspring, fitness, top-k
+    elites, and (on demand) the full history / pareto front."""
+
+    def __init__(
+        self,
+        multi_obj: bool = False,
+        full_fit_history: bool = True,
+        full_sol_history: bool = False,
+        full_pop_history: bool = False,
+        topk: int = 1,
+        ordered: bool = True,
+    ):
+        """
+        :param multi_obj: whether the optimization is multi-objective.
+        :param full_fit_history: record full fitness history on the host.
+        :param full_sol_history: record full solution history on the host.
+        :param full_pop_history: record auxiliary population records fed via
+            ``record_auxiliary``.
+        :param topk: number of elite solutions tracked (single-objective).
+        :param ordered: use ordered host callbacks; set False when the
+            workflow is vmapped over instances.
+        """
+        self.multi_obj = multi_obj
+        self.full_fit_history = full_fit_history
+        self.full_sol_history = full_sol_history
+        self.full_pop_history = full_pop_history
+        self.topk = topk
+        self.ordered = ordered
+        self.opt_direction = 1
+        self.aux_keys: list[str] = []
+        self._id_ = id(self)
+        __monitor_history__[self._id_] = {t: [] for t in HistoryType}
+        weakref.finalize(self, __monitor_history__.pop, self._id_, None)
+
+    # -- config ------------------------------------------------------------
+    def set_config(self, **config: Any) -> "EvalMonitor":
+        for k in ("multi_obj", "full_fit_history", "full_sol_history", "topk", "opt_direction"):
+            if k in config:
+                setattr(self, k, config[k])
+        return self
+
+    # -- state -------------------------------------------------------------
+    def setup(self, key: jax.Array) -> State:
+        del key
+        empty = jnp.empty((0,))
+        return State(
+            latest_solution=empty,
+            latest_fitness=empty,
+            topk_solutions=empty,
+            topk_fitness=empty,
+        )
+
+    # -- host side channel --------------------------------------------------
+    def _sink(self, data: jax.Array, data_type: int) -> None:
+        def append(x):
+            __monitor_history__[self._id_][int(data_type)].append(np.asarray(x))
+
+        io_callback(append, None, data, ordered=self.ordered)
+
+    # -- hooks --------------------------------------------------------------
+    def post_ask(self, state: State, population: jax.Array) -> State:
+        return state.replace(latest_solution=population)
+
+    def pre_tell(self, state: State, fitness: jax.Array) -> State:
+        state = state.replace(latest_fitness=fitness)
+        if fitness.ndim == 1:
+            # Single-objective: maintain running top-k. The first call (empty
+            # placeholder state) and later calls are separate traces, so the
+            # shape switch below is a static Python branch.
+            assert fitness.shape[0] >= self.topk
+            if state.topk_solutions.ndim <= 1:
+                cand_solutions = state.latest_solution
+                cand_fitness = fitness
+            else:
+                cand_solutions = jnp.concatenate(
+                    [state.topk_solutions, state.latest_solution]
+                )
+                cand_fitness = jnp.concatenate([state.topk_fitness, fitness])
+            _, rank = jax.lax.top_k(-cand_fitness, self.topk)
+            state = state.replace(
+                topk_fitness=cand_fitness[rank],
+                topk_solutions=cand_solutions[rank],
+            )
+        elif fitness.ndim != 2:
+            raise ValueError(f"Invalid fitness shape: {fitness.shape}")
+        # Multi-objective: no single top-k; the pareto front is recovered from
+        # history on demand (``get_pf``).
+        if self.full_sol_history:
+            self._sink(state.latest_solution, HistoryType.SOLUTION)
+        if self.full_fit_history:
+            self._sink(fitness, HistoryType.FITNESS)
+        return state
+
+    def record_auxiliary(self, state: State, aux: dict[str, jax.Array]) -> State:
+        if self.full_pop_history:
+            if not self.aux_keys:
+                self.aux_keys = list(aux.keys())
+            for k in self.aux_keys:
+                self._sink(aux[k], HistoryType.AUXILIARY)
+        return state
+
+    # -- history accessors (host side) --------------------------------------
+    @property
+    def fitness_history(self) -> list:
+        return __monitor_history__[self._id_][HistoryType.FITNESS]
+
+    fit_history = fitness_history
+
+    @property
+    def solution_history(self) -> list:
+        return __monitor_history__[self._id_][HistoryType.SOLUTION]
+
+    sol_history = solution_history
+
+    @property
+    def auxiliary_history(self) -> dict[str, list]:
+        raw = __monitor_history__[self._id_][HistoryType.AUXILIARY]
+        n = len(self.aux_keys)
+        if n == 0:
+            return {}
+        assert len(raw) % n == 0
+        return {k: raw[i::n] for i, k in enumerate(self.aux_keys)}
+
+    aux_history = auxiliary_history
+
+    def clear_history(self) -> None:
+        __monitor_history__[self._id_] = {t: [] for t in HistoryType}
+
+    # -- result accessors ----------------------------------------------------
+    def get_latest_fitness(self, state: State) -> jax.Array:
+        """Fitness of the latest generation (original sign restored)."""
+        return self.opt_direction * state.latest_fitness
+
+    def get_latest_solution(self, state: State) -> jax.Array:
+        return state.latest_solution
+
+    def get_topk_fitness(self, state: State) -> jax.Array:
+        return self.opt_direction * state.topk_fitness
+
+    def get_topk_solutions(self, state: State) -> jax.Array:
+        self._assert_single("get_topk_solutions")
+        return state.topk_solutions
+
+    def get_best_solution(self, state: State) -> jax.Array:
+        self._assert_single("get_best_solution")
+        return state.topk_solutions[0]
+
+    def get_best_fitness(self, state: State) -> jax.Array:
+        self._assert_single("get_best_fitness")
+        return self.opt_direction * state.topk_fitness[0]
+
+    def _assert_single(self, name: str) -> None:
+        if self.multi_obj:
+            raise ValueError(
+                f"Multi-objective optimization does not have a single best; "
+                f"use get_pf_* instead of {name}"
+            )
+
+    # -- pareto front from history -------------------------------------------
+    def get_pf_fitness(self, deduplicate: bool = True) -> jax.Array:
+        """Approximate pareto-front fitness over all evaluations so far
+        (requires ``full_fit_history``)."""
+        from ..operators.selection import non_dominate_rank
+
+        if not self.multi_obj:
+            raise ValueError("get_pf_fitness is only available for multi-objective optimization.")
+        if not self.full_fit_history:
+            warnings.warn("`get_pf_fitness` requires enabling `full_fit_history`.")
+        all_fit = jnp.concatenate(
+            [jnp.asarray(f) for f in self.fitness_history], axis=0
+        )
+        if deduplicate:
+            all_fit = jnp.unique(all_fit, axis=0)
+        rank = non_dominate_rank(all_fit)
+        return all_fit[rank == 0] * self.opt_direction
+
+    def get_pf(self, deduplicate: bool = True) -> tuple[jax.Array, jax.Array]:
+        """Approximate pareto-front (solutions, fitness) over all evaluations
+        (requires both ``full_sol_history`` and ``full_fit_history``)."""
+        from ..operators.selection import non_dominate_rank
+
+        if not self.multi_obj:
+            raise ValueError("get_pf is only available for multi-objective optimization.")
+        if not (self.full_fit_history and self.full_sol_history):
+            warnings.warn("`get_pf` requires enabling both `full_sol_history` and `full_fit_history`.")
+        all_sol = jnp.concatenate(
+            [jnp.asarray(s) for s in self.solution_history], axis=0
+        )
+        all_fit = jnp.concatenate(
+            [jnp.asarray(f) for f in self.fitness_history], axis=0
+        )
+        if deduplicate:
+            _, idx = np.unique(np.asarray(all_sol), axis=0, return_index=True)
+            idx = jnp.sort(jnp.asarray(idx))
+            all_sol, all_fit = all_sol[idx], all_fit[idx]
+        rank = non_dominate_rank(all_fit)
+        return all_sol[rank == 0], all_fit[rank == 0] * self.opt_direction
+
+    def get_pf_solutions(self, deduplicate: bool = True) -> jax.Array:
+        sol, _ = self.get_pf(deduplicate)
+        return sol
+
+    def get_fitness_history(self) -> list:
+        return [self.opt_direction * jnp.asarray(f) for f in self.fitness_history]
+
+    def get_solution_history(self) -> list:
+        return [jnp.asarray(s) for s in self.solution_history]
+
+    # -- plotting -------------------------------------------------------------
+    def plot(self, problem_pf=None, source: str = "eval", **kwargs):
+        """Plot the fitness history (1/2/3-objective dispatch), mirroring the
+        reference (``eval_monitor.py:338-378``). Requires plotly."""
+        if not self.fitness_history and not self.aux_history:
+            warnings.warn("No fitness history recorded, return None")
+            return None
+        try:
+            from ..vis_tools import plot
+        except ImportError as e:
+            warnings.warn(f"No visualization tool available ({e}), return None")
+            return None
+        if source == "pop":
+            fitness_history = [np.asarray(f) for f in self.aux_history["fit"]]
+        elif source == "eval":
+            fitness_history = [np.asarray(f) for f in self.get_fitness_history()]
+        else:
+            raise ValueError(f"Invalid source argument: {source}, expect 'eval' or 'pop'.")
+        if not fitness_history:
+            warnings.warn(f"No data recorded for source={source!r}, return None")
+            return None
+        n_objs = 1 if fitness_history[0].ndim == 1 else fitness_history[0].shape[1]
+        if n_objs == 1:
+            return plot.plot_obj_space_1d(fitness_history, **kwargs)
+        if n_objs == 2:
+            return plot.plot_obj_space_2d(fitness_history, problem_pf, **kwargs)
+        if n_objs == 3:
+            return plot.plot_obj_space_3d(fitness_history, problem_pf, **kwargs)
+        warnings.warn("Not supported yet.")
+        return None

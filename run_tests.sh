@@ -1,0 +1,7 @@
+#!/bin/bash
+# CPU-only test runner: bypasses the axon TPU-tunnel sitecustomize hook
+# (single-client relay) so unit tests never claim TPU hardware.
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  _EVOX_TPU_TEST_REEXEC=1 \
+  python -m pytest "${@:-tests/ -x -q}"

@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Tests run on an 8-virtual-device CPU platform so distributed (mesh /
+``shard_map``) paths are exercised without TPU hardware — the JAX analogue of
+the reference's localhost multi-process distributed test
+(``unit_test/workflows/test_std_workflow.py:95-116``).
+
+The env vars must be set BEFORE the first JAX backend initialization; conftest
+imports early enough.  (This box routes Python processes through an ``axon``
+TPU-tunnel hook; pinning ``JAX_PLATFORMS=cpu`` here keeps unit tests off the
+tunnel so they are fast and never serialize on the single-client relay.)
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Persistent compilation cache: this box has a single CPU core, so XLA
+# compiles dominate test time; cache them across runs.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def key():
+    return jax.random.key(42)

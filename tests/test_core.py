@@ -1,0 +1,101 @@
+"""Core State/Parameter/transform tests (reference test analogue:
+``unit_test/core/test_jit_util.py``, ``unit_test/utils/``)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu.core import Mutable, Parameter, State, get_params, set_params
+from evox_tpu.utils import ParamsAndVector, lexsort, switch
+
+
+def test_state_basics():
+    s = State(w=Parameter(0.5), pop=jnp.zeros((3, 2)))
+    assert s.param_keys == frozenset({"w"})
+    assert s.w == 0.5
+    assert s["pop"].shape == (3, 2)
+    s2 = s.replace(w=1.0)
+    assert s2.w == 1.0 and s.w == 0.5
+    with pytest.raises(AttributeError):
+        s.w = 2.0
+
+
+def test_state_is_pytree():
+    s = State(a=jnp.ones(3), nested=State(b=Parameter(2.0)))
+    doubled = jax.tree.map(lambda x: x * 2, s)
+    assert isinstance(doubled, State)
+    assert doubled.a[0] == 2.0
+    assert doubled.nested.b == 4.0
+    # Param labeling survives flatten/unflatten.
+    assert doubled.nested.param_keys == frozenset({"b"})
+
+
+def test_state_jit_vmap():
+    s = State(x=jnp.arange(4.0), k=Parameter(3.0))
+
+    @jax.jit
+    def f(s):
+        return s.replace(x=s.x * s.k)
+
+    out = f(s)
+    assert out.x[1] == 3.0
+
+    stacked = jax.tree.map(lambda x: jnp.stack([x, x * 2]), s)
+    batched = jax.vmap(f)(stacked)
+    assert batched.x.shape == (2, 4)
+    assert batched.x[1, 1] == 12.0  # x=2, k=6
+
+
+def test_get_set_params():
+    s = State(
+        algo=State(w=Parameter(0.5), pop=jnp.zeros(2)),
+        mon=State(topk=jnp.zeros(1)),
+    )
+    params = get_params(s)
+    assert set(params) == {"algo.w"}
+    s2 = set_params(s, {"algo.w": 0.9})
+    assert s2.algo.w == 0.9
+    with pytest.raises(KeyError):
+        set_params(s, {"algo.pop": jnp.ones(2)})
+
+
+def test_params_and_vector_roundtrip():
+    model = {"w": jnp.ones((3, 2)), "b": jnp.zeros(3)}
+    adapter = ParamsAndVector(model)
+    vec = adapter.to_vector(model)
+    assert vec.shape == (9,)
+    back = adapter.to_params(vec)
+    assert jnp.allclose(back["w"], model["w"])
+    # batched
+    pop = jnp.stack([vec, vec * 2])
+    params = adapter.batched_to_params(pop)
+    assert params["w"].shape == (2, 3, 2)
+    vecs = adapter.batched_to_vector(params)
+    assert jnp.allclose(vecs, pop)
+
+
+def test_switch():
+    label = jnp.array([0, 1, 2, 1])
+    values = [jnp.full((4,), float(i)) for i in range(3)]
+    out = switch(label, values)
+    assert jnp.allclose(out, jnp.array([0.0, 1.0, 2.0, 1.0]))
+
+
+def test_lexsort():
+    k1 = jnp.array([1, 3, 2])
+    k2 = jnp.array([9, 7, 8])
+    # last key primary (numpy convention)
+    idx = lexsort([k1, k2])
+    assert list(idx) == [1, 2, 0]
+
+
+def test_state_pickle_copy():
+    import copy
+    import pickle
+
+    s = State(w=Parameter(0.5), pop=jnp.zeros((3, 2)))
+    s2 = pickle.loads(pickle.dumps(s))
+    assert s2.param_keys == frozenset({"w"}) and float(s2.w) == 0.5
+    s3 = copy.copy(s)
+    s4 = copy.deepcopy(s)
+    assert float(s3.w) == 0.5 and s4["pop"].shape == (3, 2)

@@ -1,0 +1,221 @@
+"""Operator unit tests vs brute-force oracles (reference:
+``unit_test/operators/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.operators.crossover import (
+    DE_arithmetic_recombination,
+    DE_binary_crossover,
+    DE_differential_sum,
+    DE_exponential_crossover,
+    simulated_binary,
+    simulated_binary_half,
+)
+from evox_tpu.operators.mutation import polynomial_mutation
+from evox_tpu.operators.sampling import (
+    grid_sampling,
+    latin_hypercube_sampling,
+    latin_hypercube_sampling_standard,
+    uniform_sampling,
+)
+from evox_tpu.operators.selection import (
+    crowding_distance,
+    dominate_relation,
+    nd_environmental_selection,
+    non_dominate_rank,
+    select_rand_pbest,
+    tournament_selection,
+    tournament_selection_multifit,
+)
+
+
+def brute_force_rank(f: np.ndarray) -> np.ndarray:
+    """O(n^3) oracle for non-domination ranks."""
+    n = f.shape[0]
+    dominates = lambda a, b: np.all(a <= b) and np.any(a < b)
+    remaining = set(range(n))
+    rank = np.zeros(n, dtype=np.int32)
+    r = 0
+    while remaining:
+        front = [
+            i
+            for i in remaining
+            if not any(dominates(f[j], f[i]) for j in remaining if j != i)
+        ]
+        for i in front:
+            rank[i] = r
+            remaining.discard(i)
+        r += 1
+    return rank
+
+
+@pytest.fixture(scope="module")
+def mo_fitness():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.standard_normal((40, 3)).astype(np.float32))
+
+
+def test_dominate_relation(mo_fitness):
+    f = np.asarray(mo_fitness)
+    rel = np.asarray(dominate_relation(mo_fitness, mo_fitness))
+    for i in range(10):
+        for j in range(10):
+            expected = bool(np.all(f[i] <= f[j]) and np.any(f[i] < f[j]))
+            assert rel[i, j] == expected
+
+
+def test_non_dominate_rank_matches_bruteforce(mo_fitness):
+    rank = np.asarray(non_dominate_rank(mo_fitness))
+    expected = brute_force_rank(np.asarray(mo_fitness))
+    np.testing.assert_array_equal(rank, expected)
+
+
+def test_non_dominate_rank_jit_vmap(mo_fitness):
+    expected = np.asarray(non_dominate_rank(mo_fitness))
+    jit_rank = np.asarray(jax.jit(non_dominate_rank)(mo_fitness))
+    np.testing.assert_array_equal(jit_rank, expected)
+    batched = jnp.stack([mo_fitness, mo_fitness[::-1]])
+    vmap_rank = np.asarray(jax.jit(jax.vmap(non_dominate_rank))(batched))
+    np.testing.assert_array_equal(vmap_rank[0], expected)
+    np.testing.assert_array_equal(vmap_rank[1], expected[::-1])
+
+
+def test_pallas_dominance_kernel(mo_fitness):
+    from evox_tpu.ops.dominance import dominance_matrix
+
+    expected = np.asarray(dominate_relation(mo_fitness, mo_fitness))
+    got = np.asarray(dominance_matrix(mo_fitness, block_size=16, interpret=True))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_crowding_distance():
+    # 2-objective front on a line: interior points have finite distance,
+    # boundary points inf.
+    f = jnp.asarray([[0.0, 3.0], [1.0, 2.0], [2.0, 1.0], [3.0, 0.0]])
+    d = np.asarray(crowding_distance(f, None))
+    assert np.isinf(d[0]) and np.isinf(d[3])
+    assert np.isfinite(d[1]) and np.isfinite(d[2])
+    assert d[1] == pytest.approx(d[2])
+
+
+def test_crowding_distance_mask():
+    f = jnp.asarray([[0.0, 3.0], [1.0, 2.0], [2.0, 1.0], [3.0, 0.0]])
+    mask = jnp.asarray([True, True, True, False])
+    d = np.asarray(crowding_distance(f, mask))
+    assert np.isinf(d[0]) and np.isinf(d[2])  # new boundary
+    assert d[3] == -np.inf  # masked out
+
+
+def test_nd_environmental_selection(mo_fitness):
+    x = jnp.tile(jnp.arange(40, dtype=jnp.float32)[:, None], (1, 2))
+    sx, sf, rank, cd = nd_environmental_selection(x, mo_fitness, 10)
+    assert sx.shape == (10, 2) and sf.shape == (10, 3)
+    full_rank = np.asarray(non_dominate_rank(mo_fitness))
+    # Selected ranks are the 10 best ranks overall.
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(rank)), np.sort(full_rank)[:10]
+    )
+
+
+def test_tournament_selection(key):
+    fit = jnp.asarray([5.0, 1.0, 3.0, 0.5, 9.0])
+    idx = tournament_selection(key, 64, fit, tournament_size=3)
+    assert idx.shape == (64,)
+    # winners are biased toward low fitness; best index must appear
+    counts = np.bincount(np.asarray(idx), minlength=5)
+    assert counts[3] > counts[4]
+
+
+def test_tournament_selection_multifit(key):
+    rank = jnp.asarray([0.0, 0.0, 1.0, 1.0])
+    neg_cd = jnp.asarray([-0.1, -5.0, -0.2, -0.3])
+    idx = tournament_selection_multifit(key, 100, [rank, neg_cd])
+    assert idx.shape == (100,)
+    # index 1 (rank 0, biggest crowding) should win most often — note the
+    # numpy lexsort convention: LAST key is primary, so pass [secondary,
+    # primary]? No: reference passes [rank, -cd] and its lexsort makes the
+    # last list entry primary... verify empirically that low rank dominates.
+    counts = np.bincount(np.asarray(idx), minlength=4)
+    assert counts[1] >= counts[2]
+
+
+def test_select_rand_pbest(key):
+    pop = jnp.arange(20, dtype=jnp.float32)[:, None] * jnp.ones((1, 3))
+    fit = jnp.arange(20, dtype=jnp.float32)
+    pbest = select_rand_pbest(key, 0.2, pop, fit)
+    assert pbest.shape == (20, 3)
+    # all selected vectors come from the top-4 (20 * 0.2) individuals
+    assert np.all(np.asarray(pbest[:, 0]) < 4)
+
+
+def test_de_differential_sum(key):
+    pop = jax.random.normal(key, (10, 4))
+    diff, first = DE_differential_sum(key, 5, jnp.asarray(2), jnp.arange(10), pop)
+    assert diff.shape == (10, 4)
+    assert first.shape == (10,)
+    assert np.all(np.isfinite(np.asarray(diff)))
+
+
+def test_de_crossovers(key):
+    mutant = jnp.ones((8, 5))
+    current = jnp.zeros((8, 5))
+    out_bin = DE_binary_crossover(key, mutant, current, jnp.asarray(0.5))
+    assert out_bin.shape == (8, 5)
+    # every row has at least one mutant gene (forced j-rand)
+    assert np.all(np.asarray(out_bin).sum(axis=1) >= 1)
+    out_exp = DE_exponential_crossover(key, mutant, current, jnp.asarray(0.5))
+    assert set(np.unique(np.asarray(out_exp))) <= {0.0, 1.0}
+    out_arith = DE_arithmetic_recombination(mutant, current, jnp.asarray(0.3))
+    np.testing.assert_allclose(np.asarray(out_arith), 0.3)
+
+
+def test_sbx(key):
+    x = jax.random.uniform(key, (10, 4))
+    off = simulated_binary(key, x)
+    assert off.shape == (10, 4)
+    # offspring pair means equal parent pair means
+    p_mean = np.asarray((x[:5] + x[5:]) / 2)
+    o_mean = np.asarray((off[:5] + off[5:]) / 2)
+    np.testing.assert_allclose(o_mean, p_mean, rtol=1e-4, atol=1e-5)
+    half = simulated_binary_half(key, x)
+    assert half.shape == (5, 4)
+
+
+def test_polynomial_mutation(key):
+    lb = -jnp.ones(6)
+    ub = jnp.ones(6)
+    x = jax.random.uniform(key, (50, 6), minval=-1.0, maxval=1.0)
+    out = polynomial_mutation(key, x, lb, ub, pro_m=6.0)
+    assert out.shape == x.shape
+    assert np.all(np.asarray(out) >= -1.0) and np.all(np.asarray(out) <= 1.0)
+    assert not np.allclose(np.asarray(out), np.asarray(x))
+
+
+def test_uniform_sampling():
+    w, n = uniform_sampling(91, 3)
+    assert w.shape == (n, 3)
+    np.testing.assert_allclose(np.asarray(w).sum(axis=1), 1.0, rtol=1e-5)
+    assert n >= 91
+
+
+def test_latin_hypercube(key):
+    s = latin_hypercube_sampling_standard(key, 16, 3)
+    assert s.shape == (16, 3)
+    # exactly one sample per stratum per dimension
+    strata = np.floor(np.asarray(s) * 16).astype(int)
+    for d in range(3):
+        assert sorted(strata[:, d]) == list(range(16))
+    lb, ub = -2.0 * jnp.ones(3), 3.0 * jnp.ones(3)
+    sb = latin_hypercube_sampling(key, 16, lb, ub)
+    assert np.all(np.asarray(sb) >= -2.0) and np.all(np.asarray(sb) <= 3.0)
+
+
+def test_grid_sampling():
+    w, n = grid_sampling(27, 3)
+    assert w.shape == (n, 3) and n == 27
+    assert np.isclose(np.asarray(w).min(), 0.0) and np.isclose(
+        np.asarray(w).max(), 1.0
+    )

@@ -1,0 +1,21 @@
+"""PSO-family tests (reference: ``unit_test/algorithms/test_pso_variants.py``)."""
+
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu.algorithms import PSO
+
+from test_base_algorithms import check_improvement, contract_test
+
+DIM = 10
+POP = 20
+LB = -10.0 * jnp.ones(DIM)
+UB = 10.0 * jnp.ones(DIM)
+
+
+def test_pso_contract():
+    contract_test(lambda: PSO(POP, LB, UB))
+
+
+def test_pso_converges():
+    check_improvement(PSO(50, LB, UB), steps=50)
