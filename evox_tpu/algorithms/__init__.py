@@ -1,5 +1,28 @@
 """Algorithm library (reference: ``src/evox/algorithms/__init__.py:1-37``)."""
 
-__all__ = ["PSO"]
+__all__ = [
+    # DE
+    "DE", "ODE", "JaDE", "SaDE", "SHADE", "CoDE",
+    # ES
+    "CMAES", "OpenES", "XNES", "SeparableNES", "SNES", "DES", "ARS",
+    "ASEBO", "GuidedES", "PersistentES", "NoiseReuseES", "ESMC",
+    # PSO
+    "PSO",
+]
 
+from .so.de_variants import DE, CoDE, JaDE, ODE, SaDE, SHADE
+from .so.es_variants import (
+    ARS,
+    ASEBO,
+    CMAES,
+    DES,
+    ESMC,
+    GuidedES,
+    NoiseReuseES,
+    OpenES,
+    PersistentES,
+    SeparableNES,
+    SNES,
+    XNES,
+)
 from .so.pso_variants import PSO

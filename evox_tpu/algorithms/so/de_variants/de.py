@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, Parameter, State
+from ....operators.crossover import DE_binary_crossover
 
 __all__ = ["DE"]
 
@@ -82,7 +83,7 @@ class DE(Algorithm):
     def step(self, state: State, evaluate: EvalFn) -> State:
         pop, fit = state.pop, state.fit
         num_vec = self.num_difference_vectors * 2 + (0 if self.best_vector else 1)
-        key, choice_key, cr_key, dim_key = jax.random.split(state.key, 4)
+        key, choice_key, cx_key = jax.random.split(state.key, 3)
 
         # Replacement-sampled index table, one column per needed vector
         # (the reference documents the same replacement-sampling deviation
@@ -110,13 +111,8 @@ class DE(Algorithm):
         mutant = base + difference
 
         # Binomial crossover with one guaranteed mutant gene per row.
-        cross = jax.random.uniform(cr_key, (self.pop_size, self.dim), dtype=pop.dtype)
-        forced = (
-            jax.random.randint(dim_key, (self.pop_size, 1), 0, self.dim)
-            == jnp.arange(self.dim)[None, :]
-        )
-        mask = (cross < state.cross_probability) | forced
-        new_pop = jnp.clip(jnp.where(mask, mutant, pop), self.lb, self.ub)
+        new_pop = DE_binary_crossover(cx_key, mutant, pop, state.cross_probability)
+        new_pop = jnp.clip(new_pop, self.lb, self.ub)
 
         new_fit = evaluate(new_pop)
         improved = new_fit < fit

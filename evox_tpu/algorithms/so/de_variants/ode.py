@@ -1,0 +1,35 @@
+"""Opposition-based Differential Evolution.
+
+TPU-native counterpart of the reference ODE
+(``src/evox/algorithms/so/de_variants/ode.py:9-173``): a standard DE
+generation (shared with :class:`DE`) followed by an opposition-based phase
+that evaluates the mirrored population ``lb + ub - pop`` and keeps the better
+of each individual and its opposite (``ode.py:160-173``).  Two fixed-shape
+evaluations per generation; everything else fuses into elementwise kernels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core import EvalFn, State
+from .de import DE
+
+__all__ = ["ODE"]
+
+
+class ODE(DE):
+    """Opposition-based DE (Rahnamayan et al., 2008)."""
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        state = super().step(state, evaluate)
+
+        # Opposition phase: mirror through the bound midpoints and keep the
+        # better of each individual and its opposite.
+        opposition = self.lb + self.ub - state.pop
+        opp_fit = evaluate(opposition)
+        opp_better = opp_fit < state.fit
+        return state.replace(
+            pop=jnp.where(opp_better[:, None], opposition, state.pop),
+            fit=jnp.where(opp_better, opp_fit, state.fit),
+        )

@@ -1,0 +1,190 @@
+"""SaDE — DE with strategy adaptation.
+
+TPU-native counterpart of the reference SaDE
+(``src/evox/algorithms/so/de_variants/sade.py:21-209``): four candidate
+strategies (rand/1/bin, rand-to-best/2/bin, rand/2/bin, current-to-rand/1)
+sampled per individual from success-rate-derived probabilities, CR sampled
+around per-strategy medians of a success memory, and LP-deep success /
+failure / CR memories updated each generation.
+
+The reference updates its memories with per-individual Python loops
+(``sade.py:185-205``); here they are fixed-shape vector ops:
+
+* success/failure counts per strategy — a one-hot masked sum;
+* the per-strategy CR FIFO — a stable-compaction push: this generation's
+  successful CRs for strategy ``k`` (newest first) are compacted to the
+  front with an ``argsort`` on the success mask, then the old column is
+  shifted down by the (traced) success count via a gather.  Bit-identical to
+  performing the reference's per-item rolls in sequence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core import Algorithm, EvalFn, State
+from .strategy import (
+    CURRENT2RAND_1,
+    RAND2BEST_2_BIN,
+    RAND_1_BIN,
+    RAND_2_BIN,
+    composite_trial,
+)
+
+__all__ = ["SaDE"]
+
+
+class SaDE(Algorithm):
+    """SaDE (Qin, Huang & Suganthan, 2008)."""
+
+    def __init__(
+        self,
+        pop_size: int,
+        lb: jax.Array,
+        ub: jax.Array,
+        diff_padding_num: int = 9,
+        LP: int = 50,
+        dtype=jnp.float32,
+    ):
+        """
+        :param LP: learning-period depth of the success/failure/CR memories.
+        """
+        assert pop_size >= 9
+        lb = jnp.asarray(lb, dtype=dtype)
+        ub = jnp.asarray(ub, dtype=dtype)
+        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        self.pop_size = pop_size
+        self.dim = lb.shape[0]
+        self.diff_padding_num = diff_padding_num
+        self.LP = LP
+        self.lb, self.ub = lb, ub
+        self.dtype = dtype
+        self.strategy_pool = jnp.asarray(
+            [RAND_1_BIN, RAND2BEST_2_BIN, RAND_2_BIN, CURRENT2RAND_1]
+        )
+
+    def setup(self, key: jax.Array) -> State:
+        key, init_key = jax.random.split(key)
+        pop = (
+            jax.random.uniform(init_key, (self.pop_size, self.dim), dtype=self.dtype)
+            * (self.ub - self.lb)
+            + self.lb
+        )
+        return State(
+            key=key,
+            gen_iter=jnp.asarray(0),
+            best_index=jnp.asarray(0),
+            pop=pop,
+            fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
+            success_memory=jnp.zeros((self.LP, 4), dtype=self.dtype),
+            failure_memory=jnp.zeros((self.LP, 4), dtype=self.dtype),
+            CR_memory=jnp.full((self.LP, 4), jnp.nan, dtype=self.dtype),
+        )
+
+    def init_step(self, state: State, evaluate: EvalFn) -> State:
+        fit = evaluate(state.pop)
+        return state.replace(fit=fit, best_index=jnp.argmin(fit))
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        pop, fit = state.pop, state.fit
+        n = self.pop_size
+        key, strat_key, cr_key, cr_fix_key, f_key, trial_key = jax.random.split(
+            state.key, 6
+        )
+
+        # Strategy probabilities from the success/failure memories once the
+        # learning period has filled (``sade.py:100-112``).
+        success_sum = jnp.sum(state.success_memory, axis=0)
+        failure_sum = jnp.sum(state.failure_memory, axis=0)
+        S = success_sum / (success_sum + failure_sum + 1e-12) + 0.01
+        strategy_p = jnp.where(
+            state.gen_iter >= self.LP, S / jnp.sum(S), jnp.full((4,), 0.25)
+        )
+        CRM = jnp.where(
+            state.gen_iter > self.LP,
+            jnp.nanmedian(state.CR_memory, axis=0),
+            jnp.full((4,), 0.5),
+        )
+        CRM = jnp.nan_to_num(CRM, nan=0.5)
+
+        strategy_ids = jax.random.categorical(
+            strat_key, jnp.log(strategy_p), shape=(n,)
+        )
+
+        # CR sampled around the per-strategy median, redrawn once if outside
+        # [0, 1] (``sade.py:115-119``).
+        CRs = jax.random.normal(cr_key, (n, 4), dtype=pop.dtype) * 0.1 + CRM
+        CRs_repair = jax.random.normal(cr_fix_key, (n, 4), dtype=pop.dtype) * 0.1 + CRM
+        CRs = jnp.where((CRs < 0) | (CRs > 1), CRs_repair, CRs)
+        CR_vec = jnp.take_along_axis(CRs, strategy_ids[:, None], axis=1)[:, 0]
+        F_vec = jax.random.normal(f_key, (n,), dtype=pop.dtype) * 0.3 + 0.5
+
+        code = self.strategy_pool[strategy_ids]  # (n, 4)
+        trial = composite_trial(
+            trial_key,
+            pop,
+            fit,
+            state.best_index,
+            code[:, 0],
+            code[:, 1],
+            code[:, 2],
+            code[:, 3],
+            F_vec,
+            CR_vec,
+            self.diff_padding_num,
+        )
+        trial = jnp.clip(trial, self.lb, self.ub)
+
+        trial_fit = evaluate(trial)
+        success = trial_fit <= fit
+        new_pop = jnp.where(success[:, None], trial, pop)
+        new_fit = jnp.where(success, trial_fit, fit)
+
+        # Memory updates, vectorized (see module docstring).
+        one_hot = jax.nn.one_hot(strategy_ids, 4, dtype=self.dtype)
+        succ_counts = jnp.sum(one_hot * success[:, None], axis=0)
+        fail_counts = jnp.sum(one_hot * (~success)[:, None], axis=0)
+        success_memory = jnp.roll(state.success_memory, 1, axis=0).at[0].set(succ_counts)
+        failure_memory = jnp.roll(state.failure_memory, 1, axis=0).at[0].set(fail_counts)
+
+        CR_memory = self._push_cr(state.CR_memory, CR_vec, strategy_ids, success)
+
+        return state.replace(
+            key=key,
+            gen_iter=state.gen_iter + 1,
+            pop=new_pop,
+            fit=new_fit,
+            best_index=jnp.argmin(new_fit),
+            success_memory=success_memory,
+            failure_memory=failure_memory,
+            CR_memory=CR_memory,
+        )
+
+    def _push_cr(
+        self,
+        CR_memory: jax.Array,
+        CR_vec: jax.Array,
+        strategy_ids: jax.Array,
+        success: jax.Array,
+    ) -> jax.Array:
+        """Push this generation's successful CRs into the per-strategy FIFO
+        columns, newest at row 0."""
+        n = CR_vec.shape[0]
+        j = jnp.arange(self.LP)
+        cols = []
+        for k in range(4):
+            mask = success & (strategy_ids == k)
+            # Newest-first candidate list, compacted to the front.
+            mask_desc = mask[::-1]
+            order = jnp.argsort(~mask_desc, stable=True)
+            compacted = CR_vec[::-1][order]
+            s = jnp.sum(mask)
+            old = CR_memory[:, k]
+            new_col = jnp.where(
+                j < s,
+                compacted[jnp.clip(j, 0, n - 1)],
+                old[jnp.clip(j - s, 0, self.LP - 1)],
+            )
+            cols.append(new_col)
+        return jnp.stack(cols, axis=1)

@@ -1,0 +1,105 @@
+"""Shared machinery for strategy-coded DE variants (SaDE / CoDE / SHADE).
+
+The reference encodes trial-vector generation strategies as 4-bit codes
+``[base_vec_prim, base_vec_sec, diff_num, cross_strategy]`` with
+``base_vec: 0=rand, 1=best, 2=pbest, 3=current`` and
+``cross_strategy: 0=bin, 1=exp, 2=arith``
+(``src/evox/algorithms/so/de_variants/code.py:13-23``,
+``sade.py:13-18``).  This module provides the vectorized building blocks:
+per-individual base-vector selection and crossover dispatch as fixed-shape
+``where``-selects, so a whole population with mixed strategies is one fused
+XLA program (the reference does the same select trick; its per-individual
+memory loops elsewhere are vectorized in the respective algorithm files).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....operators.crossover import (
+    DE_arithmetic_recombination,
+    DE_binary_crossover,
+    DE_differential_sum,
+    DE_exponential_crossover,
+)
+from ....operators.selection import select_rand_pbest
+
+__all__ = [
+    "RAND_1_BIN",
+    "RAND_2_BIN",
+    "RAND2BEST_2_BIN",
+    "CURRENT2RAND_1",
+    "CURRENT2PBEST_1_BIN",
+    "composite_trial",
+]
+
+# [base_vec_prim, base_vec_sec, diff_num, cross_strategy]
+RAND_1_BIN = (0, 0, 1, 0)
+RAND_2_BIN = (0, 0, 2, 0)
+RAND2BEST_2_BIN = (0, 1, 2, 0)
+CURRENT2RAND_1 = (0, 0, 1, 2)  # current2rand/1 == rand/1/arith
+CURRENT2PBEST_1_BIN = (3, 2, 1, 0)
+
+
+def _pick_base(vtype: jax.Array, merged: jax.Array) -> jax.Array:
+    """Per-individual base-vector pick: ``merged`` is (4, n, d) stacked
+    [rand, best, pbest, current]; ``vtype`` is scalar or (n,) codes."""
+    n = merged.shape[1]
+    vtype = jnp.broadcast_to(jnp.asarray(vtype), (n,))
+    return merged[vtype, jnp.arange(n)]
+
+
+def composite_trial(
+    key: jax.Array,
+    pop: jax.Array,
+    fit: jax.Array,
+    best_index: jax.Array,
+    prim_type: jax.Array,
+    sec_type: jax.Array,
+    num_diff_vectors: jax.Array,
+    cross_strategy: jax.Array,
+    differential_weight: jax.Array,
+    cross_probability: jax.Array,
+    diff_padding_num: int,
+    static_base_types: tuple[int, ...] | None = None,
+) -> jax.Array:
+    """Build one trial vector per individual under (possibly per-individual)
+    strategy codes — the vectorized core of SaDE/CoDE/SHADE step functions.
+
+    All strategy inputs may be scalars or (n,) arrays of codes; ``F``/``CR``
+    may be scalars or (n,) vectors.  When the base-vector codes are known at
+    trace time, pass them via ``static_base_types`` so unreachable candidate
+    bases (e.g. the fitness argsort behind pbest) are never computed.
+    """
+    n, _ = pop.shape
+    diff_key, pbest_key, cross_key = jax.random.split(key, 3)
+
+    difference_sum, rand_vec_idx = DE_differential_sum(
+        diff_key, diff_padding_num, num_diff_vectors, jnp.arange(n), pop
+    )
+    needed = (
+        set(static_base_types) if static_base_types is not None else {0, 1, 2, 3}
+    )
+    rand_vec = pop[rand_vec_idx] if 0 in needed else pop
+    best_vec = jnp.broadcast_to(pop[best_index], pop.shape) if 1 in needed else pop
+    pbest_vec = select_rand_pbest(pbest_key, 0.05, pop, fit) if 2 in needed else pop
+    merged = jnp.stack([rand_vec, best_vec, pbest_vec, pop])
+
+    base_prim = _pick_base(prim_type, merged)
+    base_sec = _pick_base(sec_type, merged)
+
+    F = jnp.reshape(jnp.asarray(differential_weight), (-1, 1))
+    base = base_prim + F * (base_sec - base_prim)
+    mutation = base + difference_sum * F
+
+    bin_key, exp_key = jax.random.split(cross_key)
+    CR = jnp.asarray(cross_probability)
+    trial_bin = DE_binary_crossover(bin_key, mutation, pop, CR)
+    trial_exp = DE_exponential_crossover(exp_key, mutation, pop, CR)
+    trial_arith = DE_arithmetic_recombination(mutation, pop, CR)
+
+    cs = jnp.broadcast_to(jnp.asarray(cross_strategy), (n,))[:, None]
+    return jnp.where(
+        cs == 0, trial_bin, jnp.where(cs == 1, trial_exp, trial_arith)
+    )

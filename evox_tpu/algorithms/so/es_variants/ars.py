@@ -1,0 +1,64 @@
+"""ARS — Augmented Random Search (reference
+``src/evox/algorithms/so/es_variants/ars.py:10-101``): mirrored directions,
+top-k elite directions by best-of-pair fitness, std-normalized finite-
+difference gradient."""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from ....core import EvalFn, Parameter, State
+from .base import CenterES
+
+__all__ = ["ARS"]
+
+
+class ARS(CenterES):
+    def __init__(
+        self,
+        pop_size: int,
+        center_init: jax.Array,
+        elite_ratio: float = 0.1,
+        lr: float = 0.05,
+        sigma: float = 0.03,
+        optimizer: Literal["adam"] | None = None,
+    ):
+        assert pop_size > 1 and pop_size % 2 == 0
+        assert 0 <= elite_ratio <= 1
+        center_init = jnp.asarray(center_init)
+        self.dim = center_init.shape[0]
+        self.pop_size = pop_size
+        self.center_init = center_init
+        self.sigma = sigma
+        self.elite_pop_size = max(1, int(pop_size / 2 * elite_ratio))
+        self._init_optimizer(optimizer, lr)
+
+    def setup(self, key: jax.Array) -> State:
+        return State(
+            key=key,
+            sigma=Parameter(self.sigma),
+            center=self.center_init,
+            fit=jnp.full((self.pop_size,), jnp.inf),
+            **self._opt_state(self.center_init),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, noise_key = jax.random.split(state.key)
+        half = self.pop_size // 2
+        z_plus = jax.random.normal(noise_key, (half, self.dim))
+        noise = jnp.concatenate([z_plus, -z_plus], axis=0)
+        pop = state.center + state.sigma * noise
+
+        fit = evaluate(pop)
+        fit_1, fit_2 = fit[:half], fit[half:]
+        elite_idx = jnp.argsort(jnp.minimum(fit_1, fit_2))[: self.elite_pop_size]
+
+        fit_elite = jnp.concatenate([fit_1[elite_idx], fit_2[elite_idx]])
+        sigma_fitness = jnp.std(fit_elite) + 1e-5
+        fit_diff = fit_1[elite_idx] - fit_2[elite_idx]
+        grad = z_plus[elite_idx].T @ fit_diff / (self.elite_pop_size * sigma_fitness)
+
+        return state.replace(key=key, fit=fit, **self._opt_update(state, grad))

@@ -1,0 +1,59 @@
+"""Shared base for gradient-estimating ES algorithms with an optional Adam
+optimizer on the search-distribution center — the pattern the reference
+repeats in OpenES/ARS/ESMC/GuidedES/PersistentES/NoiseReuseES/ASEBO
+(e.g. ``so/es_variants/open_es.py:54-59``, ``:72-84``)."""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from ....core import Algorithm, Parameter, State
+from .opt import adam_single_tensor
+
+__all__ = ["CenterES"]
+
+
+class CenterES(Algorithm):
+    """Base for ES variants that maintain a center vector updated by an
+    estimated gradient, optionally through Adam.  Subclasses call
+    ``_opt_state()`` inside ``setup`` and ``_opt_update(state, grad)`` inside
+    ``step``."""
+
+    optimizer: Literal["adam"] | None
+
+    def _init_optimizer(self, optimizer: Literal["adam"] | None, lr: float):
+        assert optimizer in (None, "adam"), "optimizer must be None or 'adam'"
+        self.optimizer = optimizer
+        self.lr = lr
+
+    def _opt_state(self, center: jax.Array) -> dict:
+        opt = {"lr": Parameter(self.lr)}
+        if self.optimizer == "adam":
+            opt.update(
+                exp_avg=jnp.zeros_like(center),
+                exp_avg_sq=jnp.zeros_like(center),
+                beta1=Parameter(0.9),
+                beta2=Parameter(0.999),
+            )
+        return opt
+
+    def _opt_update(self, state: State, grad: jax.Array) -> dict:
+        """Descend the estimated gradient; returns State updates."""
+        if self.optimizer is None:
+            return {"center": state.center - state.lr * grad}
+        center, exp_avg, exp_avg_sq = adam_single_tensor(
+            state.center,
+            grad,
+            state.exp_avg,
+            state.exp_avg_sq,
+            state.beta1,
+            state.beta2,
+            state.lr,
+        )
+        return {"center": center, "exp_avg": exp_avg, "exp_avg_sq": exp_avg_sq}
+
+    def record_step(self, state: State) -> dict:
+        return {"center": state.center}

@@ -1,0 +1,64 @@
+"""DES — "Discovering Evolution Strategies" learned-heuristic ES (reference
+``src/evox/algorithms/so/es_variants/des.py:7-80``; evosax-style)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core import Algorithm, EvalFn, Parameter, State
+
+__all__ = ["DES"]
+
+
+class DES(Algorithm):
+    def __init__(
+        self,
+        pop_size: int,
+        center_init: jax.Array,
+        temperature: float = 12.5,
+        sigma_init: float = 0.1,
+    ):
+        assert pop_size > 1
+        center_init = jnp.asarray(center_init)
+        self.dim = center_init.shape[0]
+        self.pop_size = pop_size
+        self.temperature = temperature
+        self.sigma_init = sigma_init
+        self.center_init = center_init
+        self.ranks = jnp.arange(pop_size) / (pop_size - 1) - 0.5
+
+    def setup(self, key: jax.Array) -> State:
+        return State(
+            key=key,
+            temperature=Parameter(self.temperature),
+            lrate_mean=Parameter(1.0),
+            lrate_sigma=Parameter(0.1),
+            center=self.center_init,
+            sigma=jnp.full((self.dim,), self.sigma_init),
+            fit=jnp.full((self.pop_size,), jnp.inf),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, noise_key = jax.random.split(state.key)
+        noise = jax.random.normal(noise_key, (self.pop_size, self.dim))
+        pop = state.center + noise * state.sigma
+
+        fit = evaluate(pop)
+        order = jnp.argsort(fit)
+        sorted_pop = pop[order]
+
+        weight = jax.nn.softmax(
+            -20 * jax.nn.sigmoid(state.temperature * self.ranks)
+        )[:, None]
+        weight_mean = jnp.sum(weight * sorted_pop, axis=0)
+        weight_sigma = jnp.sqrt(
+            jnp.sum(weight * (sorted_pop - state.center) ** 2, axis=0) + 1e-6
+        )
+
+        center = state.center + state.lrate_mean * (weight_mean - state.center)
+        sigma = state.sigma + state.lrate_sigma * (weight_sigma - state.sigma)
+        return state.replace(key=key, center=center, sigma=sigma, fit=fit[order])
+
+    def record_step(self, state: State) -> dict:
+        return {"center": state.center, "sigma": state.sigma}

@@ -1,0 +1,67 @@
+"""ESMC — ES with a zero-perturbation baseline member (reference
+``src/evox/algorithms/so/es_variants/esmc.py:10-113``; Learn2Hop)."""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from ....core import EvalFn, Parameter, State
+from .base import CenterES
+
+__all__ = ["ESMC"]
+
+
+class ESMC(CenterES):
+    def __init__(
+        self,
+        pop_size: int,
+        center_init: jax.Array,
+        optimizer: Literal["adam"] | None = None,
+        sigma_decay: float = 1.0,
+        sigma_limit: float = 0.01,
+        lr: float = 0.05,
+        sigma: float = 0.03,
+    ):
+        assert pop_size > 1 and pop_size % 2 == 1, (
+            "ESMC uses a baseline member plus mirrored pairs; pop_size must be odd"
+        )
+        center_init = jnp.asarray(center_init)
+        self.dim = center_init.shape[0]
+        self.pop_size = pop_size
+        self.center_init = center_init
+        self.sigma_init = sigma
+        self.sigma_decay = sigma_decay
+        self.sigma_limit = sigma_limit
+        self._init_optimizer(optimizer, lr)
+
+    def setup(self, key: jax.Array) -> State:
+        return State(
+            key=key,
+            sigma_decay=Parameter(self.sigma_decay),
+            sigma_limit=Parameter(self.sigma_limit),
+            center=self.center_init,
+            sigma=jnp.full((self.dim,), self.sigma_init),
+            fit=jnp.full((self.pop_size,), jnp.inf),
+            **self._opt_state(self.center_init),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, noise_key = jax.random.split(state.key)
+        half = (self.pop_size - 1) // 2
+        z_plus = jax.random.normal(noise_key, (half, self.dim))
+        z = jnp.concatenate([jnp.zeros((1, self.dim)), z_plus, -z_plus], axis=0)
+        pop = state.center + z * state.sigma
+
+        fit = evaluate(pop)
+        baseline = fit[0]
+        fit_1, fit_2 = fit[1 : half + 1], fit[half + 1 :]
+        fit_diff = jnp.minimum(fit_1, baseline) - jnp.minimum(fit_2, baseline)
+        grad = z_plus.T @ fit_diff / half
+
+        sigma = jnp.maximum(state.sigma * state.sigma_decay, state.sigma_limit)
+        return state.replace(
+            key=key, fit=fit, sigma=sigma, **self._opt_update(state, grad)
+        )

@@ -1,0 +1,89 @@
+"""Guided ES — surrogate-gradient-guided subspace sampling (reference
+``src/evox/algorithms/so/es_variants/guided_es.py:10-125``): perturbations
+blend isotropic noise with noise in the QR-orthonormalized span of recent
+gradient estimates."""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from ....core import EvalFn, Parameter, State
+from .base import CenterES
+
+__all__ = ["GuidedES"]
+
+
+class GuidedES(CenterES):
+    def __init__(
+        self,
+        pop_size: int,
+        center_init: jax.Array,
+        subspace_dims: int | None = None,
+        optimizer: Literal["adam"] | None = None,
+        sigma: float = 0.03,
+        lr: float = 60,
+        sigma_decay: float = 1.0,
+        sigma_limit: float = 0.01,
+    ):
+        assert pop_size > 1 and pop_size % 2 == 0
+        center_init = jnp.asarray(center_init)
+        self.dim = center_init.shape[0]
+        self.pop_size = pop_size
+        self.center_init = center_init
+        self.sigma_init = sigma
+        self.sigma_decay = sigma_decay
+        self.sigma_limit = sigma_limit
+        self.subspace_dims = subspace_dims if subspace_dims is not None else self.dim
+        self._init_optimizer(optimizer, lr)
+
+    def setup(self, key: jax.Array) -> State:
+        key, gs_key = jax.random.split(key)
+        return State(
+            key=key,
+            beta=Parameter(1.0),
+            sigma_decay=Parameter(self.sigma_decay),
+            sigma_limit=Parameter(self.sigma_limit),
+            center=self.center_init,
+            alpha=jnp.asarray(0.5),
+            sigma=jnp.asarray(self.sigma_init),
+            grad_subspace=jax.random.normal(gs_key, (self.subspace_dims, self.dim)),
+            fit=jnp.full((self.pop_size,), jnp.inf),
+            **self._opt_state(self.center_init),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, full_key, sub_key = jax.random.split(state.key, 3)
+        half = self.pop_size // 2
+
+        a = state.sigma * jnp.sqrt(state.alpha / self.dim)
+        c = state.sigma * jnp.sqrt((1.0 - state.alpha) / self.subspace_dims)
+        eps_full = jax.random.normal(full_key, (self.dim, half))
+        eps_subspace = jax.random.normal(sub_key, (self.subspace_dims, half))
+        # Orthonormal basis of the recent-gradient span (rows of grad_subspace
+        # live in R^dim, so factorize the transpose).
+        Q, _ = jnp.linalg.qr(state.grad_subspace.T)
+
+        z_plus = (a * eps_full + c * (Q @ eps_subspace)).T
+        z = jnp.concatenate([z_plus, -z_plus], axis=0)
+        pop = state.center + z
+
+        fit = evaluate(pop)
+        fit_1, fit_2 = fit[:half], fit[half:]
+        noise_1 = (z / state.sigma)[:half]
+        grad = (state.beta / self.pop_size) * (noise_1.T @ (fit_1 - fit_2))
+
+        grad_subspace = jnp.concatenate([state.grad_subspace[1:], grad[None, :]], axis=0)
+        sigma = jnp.maximum(state.sigma_decay * state.sigma, state.sigma_limit)
+        return state.replace(
+            key=key,
+            fit=fit,
+            sigma=sigma,
+            grad_subspace=grad_subspace,
+            **self._opt_update(state, grad),
+        )
+
+    def record_step(self, state: State) -> dict:
+        return {"center": state.center, "sigma": state.sigma}

@@ -1,0 +1,177 @@
+"""Exponential and Separable Natural Evolution Strategies — TPU-native
+counterparts of the reference (``src/evox/algorithms/so/es_variants/nes.py:8-212``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....core import Algorithm, EvalFn, Parameter, State
+
+__all__ = ["XNES", "SeparableNES"]
+
+
+def _default_recombination_weights(pop_size: int) -> jax.Array:
+    w = jnp.clip(
+        math.log(pop_size / 2 + 1) - jnp.log(jnp.arange(1, pop_size + 1)), 0
+    )
+    return w / jnp.sum(w) - 1 / pop_size
+
+
+class XNES(Algorithm):
+    """xNES (Glasmachers et al., 2010): multiplicative natural-gradient
+    updates of a full covariance factor via ``expm`` (reference
+    ``nes.py:8-120``)."""
+
+    def __init__(
+        self,
+        init_mean: jax.Array,
+        init_covar: jax.Array,
+        pop_size: int | None = None,
+        recombination_weights: jax.Array | None = None,
+        learning_rate_mean: float | None = None,
+        learning_rate_var: float | None = None,
+        learning_rate_B: float | None = None,
+        covar_as_cholesky: bool = False,
+    ):
+        init_mean = jnp.asarray(init_mean)
+        dim = init_mean.shape[0]
+        self.dim = dim
+        if pop_size is None:
+            pop_size = 4 + math.floor(3 * math.log(dim))
+        assert pop_size > 0
+        self.pop_size = pop_size
+
+        self.learning_rate_mean = learning_rate_mean or 1.0
+        self.learning_rate_var = (
+            learning_rate_var
+            if learning_rate_var is not None
+            else (9 + 3 * math.log(dim)) / 5 / math.pow(dim, 1.5)
+        )
+        self.learning_rate_B = (
+            learning_rate_B if learning_rate_B is not None else self.learning_rate_var
+        )
+
+        init_covar = jnp.asarray(init_covar)
+        if not covar_as_cholesky:
+            init_covar = jnp.linalg.cholesky(init_covar)
+        self.init_mean = init_mean
+        self.init_covar = init_covar
+
+        if recombination_weights is None:
+            recombination_weights = _default_recombination_weights(pop_size)
+        else:
+            recombination_weights = jnp.asarray(recombination_weights)
+            assert bool(
+                jnp.all(recombination_weights[1:] <= recombination_weights[:-1])
+            ), "recombination_weights must be descending"
+        self.weights = recombination_weights
+
+    def setup(self, key: jax.Array) -> State:
+        sigma = jnp.prod(jnp.diag(self.init_covar)) ** (1 / self.dim)
+        return State(
+            key=key,
+            learning_rate_mean=Parameter(self.learning_rate_mean),
+            learning_rate_var=Parameter(self.learning_rate_var),
+            learning_rate_B=Parameter(self.learning_rate_B),
+            mean=self.init_mean,
+            sigma=sigma,
+            B=self.init_covar / sigma,
+            fit=jnp.full((self.pop_size,), jnp.inf),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, noise_key = jax.random.split(state.key)
+        noise = jax.random.normal(noise_key, (self.pop_size, self.dim))
+        pop = state.mean + state.sigma * (noise @ state.B.T)
+
+        fit = evaluate(pop)
+        order = jnp.argsort(fit)
+        noise = noise[order]
+        w = self.weights
+
+        eye = jnp.eye(self.dim)
+        grad_delta = jnp.sum(w[:, None] * noise, axis=0)
+        grad_M = (w * noise.T) @ noise - jnp.sum(w) * eye
+        grad_sigma = jnp.trace(grad_M) / self.dim
+        grad_B = grad_M - grad_sigma * eye
+
+        mean = state.mean + state.learning_rate_mean * state.sigma * state.B @ grad_delta
+        sigma = state.sigma * jnp.exp(state.learning_rate_var / 2 * grad_sigma)
+        B = state.B @ jax.scipy.linalg.expm(state.learning_rate_B / 2 * grad_B)
+
+        return state.replace(key=key, mean=mean, sigma=sigma, B=B, fit=fit[order])
+
+    def record_step(self, state: State) -> dict:
+        return {"mean": state.mean, "sigma": state.sigma, "B": state.B}
+
+
+class SeparableNES(Algorithm):
+    """Separable NES (Wierstra et al., 2014): diagonal-covariance natural
+    gradient (reference ``nes.py:121-212``)."""
+
+    def __init__(
+        self,
+        init_mean: jax.Array,
+        init_std: jax.Array,
+        pop_size: int | None = None,
+        recombination_weights: jax.Array | None = None,
+        learning_rate_mean: float | None = None,
+        learning_rate_var: float | None = None,
+    ):
+        init_mean = jnp.asarray(init_mean)
+        init_std = jnp.asarray(init_std)
+        dim = init_mean.shape[0]
+        assert init_std.shape == (dim,)
+        self.dim = dim
+        if pop_size is None:
+            pop_size = 4 + math.floor(3 * math.log(dim))
+        assert pop_size > 0
+        self.pop_size = pop_size
+        self.learning_rate_mean = learning_rate_mean or 1.0
+        self.learning_rate_var = (
+            learning_rate_var
+            if learning_rate_var is not None
+            else (3 + math.log(dim)) / 5 / math.sqrt(dim)
+        )
+        if recombination_weights is None:
+            recombination_weights = _default_recombination_weights(pop_size)
+        else:
+            recombination_weights = jnp.asarray(recombination_weights)
+            assert recombination_weights.shape == (pop_size,)
+        self.weights = recombination_weights
+        self.init_mean = init_mean
+        self.init_std = init_std
+
+    def setup(self, key: jax.Array) -> State:
+        return State(
+            key=key,
+            learning_rate_mean=Parameter(self.learning_rate_mean),
+            learning_rate_var=Parameter(self.learning_rate_var),
+            mean=self.init_mean,
+            sigma=self.init_std,
+            fit=jnp.full((self.pop_size,), jnp.inf),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, noise_key = jax.random.split(state.key)
+        z = jax.random.normal(noise_key, (self.pop_size, self.dim))
+        pop = state.mean + z * state.sigma
+
+        fit = evaluate(pop)
+        order = jnp.argsort(fit)
+        z = z[order]
+
+        w = self.weights[:, None]
+        grad_mu = jnp.sum(w * z, axis=0)
+        grad_sigma = jnp.sum(w * (z * z - 1), axis=0)
+
+        mean = state.mean + state.learning_rate_mean * state.sigma * grad_mu
+        sigma = state.sigma * jnp.exp(state.learning_rate_var / 2 * grad_sigma)
+        return state.replace(key=key, mean=mean, sigma=sigma, fit=fit[order])
+
+    def record_step(self, state: State) -> dict:
+        return {"mean": state.mean, "sigma": state.sigma}

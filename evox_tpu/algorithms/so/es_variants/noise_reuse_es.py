@@ -1,0 +1,85 @@
+"""Noise-Reuse ES — online ES reusing perturbations across an unroll
+(reference ``src/evox/algorithms/so/es_variants/noise_reuse_es.py:10-120``;
+Li et al. 2023): fresh mirrored noise is drawn only at unroll boundaries."""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from ....core import EvalFn, Parameter, State
+from .base import CenterES
+
+__all__ = ["NoiseReuseES"]
+
+
+class NoiseReuseES(CenterES):
+    def __init__(
+        self,
+        pop_size: int,
+        center_init: jax.Array,
+        optimizer: Literal["adam"] | None = None,
+        lr: float = 0.05,
+        sigma: float = 0.03,
+        T: int = 100,
+        K: int = 10,
+        sigma_decay: float = 1.0,
+        sigma_limit: float = 0.01,
+    ):
+        assert pop_size > 1 and pop_size % 2 == 0
+        center_init = jnp.asarray(center_init)
+        self.dim = center_init.shape[0]
+        self.pop_size = pop_size
+        self.center_init = center_init
+        self.sigma_init = sigma
+        self.T = T
+        self.K = K
+        self.sigma_decay = sigma_decay
+        self.sigma_limit = sigma_limit
+        self._init_optimizer(optimizer, lr)
+
+    def setup(self, key: jax.Array) -> State:
+        return State(
+            key=key,
+            T=Parameter(self.T),
+            K=Parameter(self.K),
+            sigma_decay=Parameter(self.sigma_decay),
+            sigma_limit=Parameter(self.sigma_limit),
+            center=self.center_init,
+            sigma=jnp.asarray(self.sigma_init),
+            inner_step_counter=jnp.asarray(0.0),
+            unroll_pert=jnp.zeros((self.pop_size, self.dim)),
+            fit=jnp.full((self.pop_size,), jnp.inf),
+            **self._opt_state(self.center_init),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, noise_key = jax.random.split(state.key)
+        half = self.pop_size // 2
+        pos = jax.random.normal(noise_key, (half, self.dim)) * state.sigma
+        perts = jnp.concatenate([pos, -pos], axis=0)
+        unroll_pert = jnp.where(state.inner_step_counter == 0, perts, state.unroll_pert)
+
+        pop = state.center + unroll_pert
+        fit = evaluate(pop)
+        grad = jnp.mean(unroll_pert * fit[:, None] / (state.sigma**2), axis=0)
+
+        counter = jnp.where(
+            state.inner_step_counter + state.K >= state.T,
+            0.0,
+            state.inner_step_counter + state.K,
+        )
+        sigma = jnp.maximum(state.sigma_decay * state.sigma, state.sigma_limit)
+        return state.replace(
+            key=key,
+            fit=fit,
+            sigma=sigma,
+            inner_step_counter=counter,
+            unroll_pert=unroll_pert,
+            **self._opt_update(state, grad),
+        )
+
+    def record_step(self, state: State) -> dict:
+        return {"center": state.center, "sigma": state.sigma}

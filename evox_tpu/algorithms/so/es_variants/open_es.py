@@ -1,0 +1,61 @@
+"""OpenES (Salimans et al., 2017) — TPU-native counterpart of the reference
+(``src/evox/algorithms/so/es_variants/open_es.py:10-86``): mirrored Gaussian
+sampling around a center, fitness-weighted noise average as the gradient
+estimate, plain SGD or Adam on the center.  The whole generation is one
+matmul (``noise.T @ fitness``) plus elementwise ops — MXU-friendly at any
+population size."""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from ....core import EvalFn, Parameter, State
+from .base import CenterES
+
+__all__ = ["OpenES"]
+
+
+class OpenES(CenterES):
+    def __init__(
+        self,
+        pop_size: int,
+        center_init: jax.Array,
+        learning_rate: float,
+        noise_stdev: float,
+        optimizer: Literal["adam"] | None = None,
+        mirrored_sampling: bool = True,
+    ):
+        assert noise_stdev > 0 and learning_rate > 0 and pop_size > 0
+        if mirrored_sampling:
+            assert pop_size % 2 == 0, "mirrored sampling requires even pop_size"
+        self.pop_size = pop_size
+        center_init = jnp.asarray(center_init)
+        self.dim = center_init.shape[0]
+        self.center_init = center_init
+        self.noise_stdev = noise_stdev
+        self.mirrored_sampling = mirrored_sampling
+        self._init_optimizer(optimizer, learning_rate)
+
+    def setup(self, key: jax.Array) -> State:
+        return State(
+            key=key,
+            noise_stdev=Parameter(self.noise_stdev),
+            center=self.center_init,
+            fit=jnp.full((self.pop_size,), jnp.inf),
+            **self._opt_state(self.center_init),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, noise_key = jax.random.split(state.key)
+        if self.mirrored_sampling:
+            half = jax.random.normal(noise_key, (self.pop_size // 2, self.dim))
+            noise = jnp.concatenate([half, -half], axis=0)
+        else:
+            noise = jax.random.normal(noise_key, (self.pop_size, self.dim))
+        pop = state.center + state.noise_stdev * noise
+        fit = evaluate(pop)
+        grad = noise.T @ fit / self.pop_size / state.noise_stdev
+        return state.replace(key=key, fit=fit, **self._opt_update(state, grad))

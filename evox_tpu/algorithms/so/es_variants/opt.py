@@ -1,0 +1,35 @@
+"""Shared ES helpers: compile-friendly single-tensor Adam and fitness-sorted
+population permutation (reference ``so/es_variants/adam_step.py:4-27`` and
+``so/es_variants/sort_utils.py:6-19``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["adam_single_tensor", "sort_by_key"]
+
+
+def adam_single_tensor(
+    param: jax.Array,
+    grad: jax.Array,
+    exp_avg: jax.Array,
+    exp_avg_sq: jax.Array,
+    beta1=0.9,
+    beta2=0.999,
+    lr=1e-3,
+    weight_decay=0.0,
+    eps=1e-8,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One Adam step on a flat parameter tensor (no bias correction, matching
+    the reference); returns ``(new_param, new_exp_avg, new_exp_avg_sq)``."""
+    grad = grad + weight_decay * param
+    exp_avg = exp_avg + (1 - beta1) * (grad - exp_avg)
+    exp_avg_sq = beta2 * exp_avg_sq + (1 - beta2) * grad * grad
+    return param - lr * exp_avg / (jnp.sqrt(exp_avg_sq) + eps), exp_avg, exp_avg_sq
+
+
+def sort_by_key(fitness: jax.Array, *arrays: jax.Array):
+    """Sort ``arrays`` rows by ascending fitness; returns (fitness, *arrays)."""
+    order = jnp.argsort(fitness)
+    return (fitness[order], *(a[order] for a in arrays))

@@ -1,0 +1,90 @@
+"""Persistent ES — unbiased unrolled-computation gradients (reference
+``src/evox/algorithms/so/es_variants/persistent_es.py:10-115``; Vicol et al.
+2021): perturbation accumulator across truncated unrolls, reset every
+``T/K`` steps."""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from ....core import EvalFn, Parameter, State
+from .base import CenterES
+
+__all__ = ["PersistentES"]
+
+
+class PersistentES(CenterES):
+    def __init__(
+        self,
+        pop_size: int,
+        center_init: jax.Array,
+        optimizer: Literal["adam"] | None = None,
+        lr: float = 0.05,
+        sigma: float = 0.03,
+        T: int = 100,
+        K: int = 10,
+        sigma_decay: float = 1.0,
+        sigma_limit: float = 0.01,
+    ):
+        """
+        :param T: inner-problem (unroll) length.
+        :param K: truncation length per step.
+        """
+        assert pop_size > 1 and pop_size % 2 == 0
+        center_init = jnp.asarray(center_init)
+        self.dim = center_init.shape[0]
+        self.pop_size = pop_size
+        self.center_init = center_init
+        self.sigma_init = sigma
+        self.T = T
+        self.K = K
+        self.sigma_decay = sigma_decay
+        self.sigma_limit = sigma_limit
+        self._init_optimizer(optimizer, lr)
+
+    def setup(self, key: jax.Array) -> State:
+        return State(
+            key=key,
+            T=Parameter(self.T),
+            K=Parameter(self.K),
+            sigma_decay=Parameter(self.sigma_decay),
+            sigma_limit=Parameter(self.sigma_limit),
+            center=self.center_init,
+            sigma=jnp.asarray(self.sigma_init),
+            inner_step_counter=jnp.asarray(0.0),
+            pert_accum=jnp.zeros((self.pop_size, self.dim)),
+            fit=jnp.full((self.pop_size,), jnp.inf),
+            **self._opt_state(self.center_init),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, noise_key = jax.random.split(state.key)
+        half = self.pop_size // 2
+        pos = jax.random.normal(noise_key, (half, self.dim)) * state.sigma
+        perts = jnp.concatenate([pos, -pos], axis=0)
+        pert_accum = state.pert_accum + perts
+        pop = state.center + perts
+
+        fit = evaluate(pop)
+        grad = jnp.mean(pert_accum * fit[:, None] / (state.sigma**2), axis=0)
+
+        counter = state.inner_step_counter + state.K
+        reset = counter >= state.T
+        counter = jnp.where(reset, 0.0, counter)
+        pert_accum = jnp.where(reset, jnp.zeros_like(pert_accum), pert_accum)
+
+        sigma = jnp.maximum(state.sigma_decay * state.sigma, state.sigma_limit)
+        return state.replace(
+            key=key,
+            fit=fit,
+            sigma=sigma,
+            inner_step_counter=counter,
+            pert_accum=pert_accum,
+            **self._opt_update(state, grad),
+        )
+
+    def record_step(self, state: State) -> dict:
+        return {"center": state.center, "sigma": state.sigma}

@@ -1,0 +1,78 @@
+"""SNES — separable NES with rank-shaped weights (reference
+``src/evox/algorithms/so/es_variants/snes.py:10-99``; evosax-style)."""
+
+from __future__ import annotations
+
+import math
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from ....core import Algorithm, EvalFn, Parameter, State
+
+__all__ = ["SNES"]
+
+
+class SNES(Algorithm):
+    def __init__(
+        self,
+        pop_size: int,
+        center_init: jax.Array,
+        sigma: float = 1.0,
+        lrate_mean: float = 1.0,
+        temperature: float = 12.5,
+        weight_type: Literal["recomb", "temp"] = "temp",
+    ):
+        assert pop_size > 1
+        center_init = jnp.asarray(center_init)
+        dim = center_init.shape[0]
+        self.dim = dim
+        self.pop_size = pop_size
+        self.lrate_mean = lrate_mean
+        self.lrate_sigma = (3 + math.log(dim)) / (5 * math.sqrt(dim))
+        self.temperature = temperature
+        self.center_init = center_init
+        self.sigma_init = sigma
+
+        if weight_type == "temp":
+            ranks = jnp.arange(pop_size) / (pop_size - 1) - 0.5
+            weights = jax.nn.softmax(-20 * jax.nn.sigmoid(temperature * ranks))
+        elif weight_type == "recomb":
+            weights = jnp.clip(
+                math.log(pop_size / 2 + 1) - jnp.log(jnp.arange(1, pop_size + 1)), 0
+            )
+            weights = weights / jnp.sum(weights) - 1 / pop_size
+        else:
+            raise ValueError(f"unknown weight_type {weight_type!r}")
+        self.weights = weights
+
+    def setup(self, key: jax.Array) -> State:
+        return State(
+            key=key,
+            lrate_mean=Parameter(self.lrate_mean),
+            lrate_sigma=Parameter(self.lrate_sigma),
+            center=self.center_init,
+            sigma=jnp.full((self.dim,), self.sigma_init),
+            fit=jnp.full((self.pop_size,), jnp.inf),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, noise_key = jax.random.split(state.key)
+        noise = jax.random.normal(noise_key, (self.pop_size, self.dim))
+        pop = state.center + noise * state.sigma
+
+        fit = evaluate(pop)
+        order = jnp.argsort(fit)
+        z = noise[order]
+        w = self.weights[:, None]
+
+        grad_mean = jnp.sum(w * z, axis=0)
+        grad_sigma = jnp.sum(w * (z**2 - 1), axis=0)
+
+        center = state.center + state.lrate_mean * state.sigma * grad_mean
+        sigma = state.sigma * jnp.exp(state.lrate_sigma / 2 * grad_sigma)
+        return state.replace(key=key, center=center, sigma=sigma, fit=fit[order])
+
+    def record_step(self, state: State) -> dict:
+        return {"center": state.center, "sigma": state.sigma}

@@ -1,7 +1,8 @@
 #!/bin/bash
 # CPU-only test runner: bypasses the axon TPU-tunnel sitecustomize hook
 # (single-client relay) so unit tests never claim TPU hardware.
+if [ $# -eq 0 ]; then set -- tests/ -q; fi
 exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   _EVOX_TPU_TEST_REEXEC=1 \
-  python -m pytest "${@:-tests/ -x -q}"
+  python -m pytest "$@"

@@ -1,0 +1,35 @@
+"""DE-family three-mode contract tests (reference:
+``unit_test/algorithms/test_de_variants.py``)."""
+
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu.algorithms import DE, CoDE, JaDE, ODE, SaDE, SHADE
+
+from test_base_algorithms import check_improvement, contract_test
+
+DIM = 8
+LB = jnp.full((DIM,), -10.0)
+UB = jnp.full((DIM,), 10.0)
+
+FACTORIES = {
+    "DE": lambda: DE(16, LB, UB),
+    "DE_best_2": lambda: DE(16, LB, UB, base_vector="best",
+                            num_difference_vectors=2,
+                            differential_weight=jnp.asarray([0.5, 0.3])),
+    "ODE": lambda: ODE(16, LB, UB),
+    "JaDE": lambda: JaDE(16, LB, UB),
+    "SaDE": lambda: SaDE(16, LB, UB, LP=3),
+    "SHADE": lambda: SHADE(16, LB, UB),
+    "CoDE": lambda: CoDE(16, LB, UB),
+}
+
+
+@pytest.mark.parametrize("name", FACTORIES)
+def test_contract(name):
+    contract_test(FACTORIES[name])
+
+
+@pytest.mark.parametrize("name", ["DE", "JaDE", "SHADE"])
+def test_improvement(name):
+    check_improvement(FACTORIES[name]())

@@ -1,0 +1,53 @@
+"""ES-family three-mode contract tests (reference:
+``unit_test/algorithms/test_es_variants.py``)."""
+
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu.algorithms import (
+    ARS,
+    ASEBO,
+    CMAES,
+    DES,
+    ESMC,
+    GuidedES,
+    NoiseReuseES,
+    OpenES,
+    PersistentES,
+    SeparableNES,
+    SNES,
+    XNES,
+)
+
+from test_base_algorithms import check_improvement, contract_test
+
+DIM = 8
+CENTER = jnp.zeros((DIM,)) + 1.0
+
+FACTORIES = {
+    "CMAES": lambda: CMAES(CENTER, sigma=1.0, pop_size=16),
+    "OpenES": lambda: OpenES(16, CENTER, learning_rate=0.05, noise_stdev=0.1),
+    "OpenES_adam": lambda: OpenES(
+        16, CENTER, learning_rate=0.05, noise_stdev=0.1, optimizer="adam"
+    ),
+    "XNES": lambda: XNES(CENTER, jnp.eye(DIM), pop_size=16),
+    "SeparableNES": lambda: SeparableNES(CENTER, jnp.ones(DIM), pop_size=16),
+    "SNES": lambda: SNES(16, CENTER),
+    "DES": lambda: DES(16, CENTER),
+    "ARS": lambda: ARS(16, CENTER),
+    "ASEBO": lambda: ASEBO(16, CENTER, subspace_dims=4),
+    "GuidedES": lambda: GuidedES(16, CENTER, subspace_dims=4),
+    "PersistentES": lambda: PersistentES(16, CENTER),
+    "NoiseReuseES": lambda: NoiseReuseES(16, CENTER),
+    "ESMC": lambda: ESMC(17, CENTER),
+}
+
+
+@pytest.mark.parametrize("name", FACTORIES)
+def test_contract(name):
+    contract_test(FACTORIES[name])
+
+
+@pytest.mark.parametrize("name", ["CMAES", "OpenES", "SNES"])
+def test_improvement(name):
+    check_improvement(FACTORIES[name]())
