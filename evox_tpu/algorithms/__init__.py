@@ -8,8 +8,11 @@ __all__ = [
     "ASEBO", "GuidedES", "PersistentES", "NoiseReuseES", "ESMC",
     # PSO
     "PSO",
+    # MO
+    "NSGA2", "NSGA3", "RVEA", "RVEAa", "MOEAD", "HypE",
 ]
 
+from .mo import MOEAD, NSGA2, NSGA3, RVEA, RVEAa, HypE
 from .so.de_variants import DE, CoDE, JaDE, ODE, SaDE, SHADE
 from .so.es_variants import (
     ARS,

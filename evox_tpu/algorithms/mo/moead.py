@@ -1,0 +1,150 @@
+"""MOEA/D: decomposition-based multi-objective optimization.
+
+Counterpart of the reference MOEAD (``src/evox/algorithms/mo/moead.py:23-123``)
+with one deliberate design deviation, per SURVEY hard-part №5: the reference
+keeps the original paper's *sequential* per-individual loop (one evaluation
+per subproblem per generation, ``moead.py:110-123``) and documents that it is
+GPU-inefficient.  A sequential loop is equally hostile to TPU/XLA, so this
+implementation is the *tensorized* MOEA/D used by the tensorized-EMO line of
+work: all subproblems generate offspring in parallel, one batched evaluation,
+then a scatter-min neighborhood replacement that lets each individual be
+claimed by the best improving offspring whose neighborhood contains it.
+The PBI aggregation (``moead.py:13-20``) is numerically identical.
+
+References:
+    [1] Q. Zhang and H. Li, "MOEA/D: A Multiobjective Evolutionary Algorithm
+        Based on Decomposition," IEEE TEVC 11(6), 2007.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ...core import Algorithm, EvalFn, State
+from ...operators.crossover import simulated_binary_half
+from ...operators.mutation import polynomial_mutation
+from ...operators.sampling import uniform_sampling
+
+__all__ = ["MOEAD"]
+
+
+def pbi(f: jax.Array, w: jax.Array, z: jax.Array, theta: float = 5.0) -> jax.Array:
+    """Penalty-based boundary intersection aggregation (reference
+    ``moead.py:13-20``): projection distance along the weight direction plus
+    ``theta`` times the perpendicular deviation."""
+    norm_w = jnp.linalg.norm(w, axis=-1)
+    f = f - z
+    d1 = jnp.sum(f * w, axis=-1) / norm_w
+    d2 = jnp.linalg.norm(f - d1[..., None] * w / norm_w[..., None], axis=-1)
+    return d1 + theta * d2
+
+
+class MOEAD(Algorithm):
+    """Tensorized MOEA/D with PBI aggregation and parallel neighborhood
+    replacement."""
+
+    def __init__(
+        self,
+        pop_size: int,
+        n_objs: int,
+        lb: jax.Array,
+        ub: jax.Array,
+        selection_op: Callable | None = None,
+        mutation_op: Callable | None = None,
+        crossover_op: Callable | None = None,
+        dtype=jnp.float32,
+    ):
+        """
+        :param pop_size: requested population size; rounded to the Das-Dennis
+            weight-vector count.
+        :param n_objs: number of objectives.
+        :param lb: 1-D lower bounds. :param ub: 1-D upper bounds.
+        """
+        lb = jnp.asarray(lb, dtype=dtype)
+        ub = jnp.asarray(ub, dtype=dtype)
+        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        self.n_objs = n_objs
+        self.dim = lb.shape[0]
+        self.lb = lb
+        self.ub = ub
+        self.dtype = dtype
+        self.mutation = mutation_op or polynomial_mutation
+        self.crossover = crossover_op or simulated_binary_half
+        del selection_op  # parity: the reference accepts but never uses it
+
+        w, n_vec = uniform_sampling(pop_size, n_objs)
+        self.w = w.astype(dtype)
+        self.pop_size = n_vec
+        self.n_neighbor = int(math.ceil(self.pop_size / 10))
+        # Neighborhoods: each subproblem's n_neighbor closest weight vectors.
+        dist = jnp.linalg.norm(self.w[:, None, :] - self.w[None, :, :], axis=-1)
+        self.neighbors = jnp.argsort(dist, axis=1, stable=True)[:, : self.n_neighbor]
+
+    def setup(self, key: jax.Array) -> State:
+        key, init_key = jax.random.split(key)
+        pop = (
+            jax.random.uniform(init_key, (self.pop_size, self.dim), dtype=self.dtype)
+            * (self.ub - self.lb)
+            + self.lb
+        )
+        return State(
+            key=key,
+            pop=pop,
+            fit=jnp.full((self.pop_size, self.n_objs), jnp.inf, dtype=self.dtype),
+            z=jnp.zeros((self.n_objs,), dtype=self.dtype),
+        )
+
+    def init_step(self, state: State, evaluate: EvalFn) -> State:
+        fit = evaluate(state.pop)
+        return state.replace(fit=fit, z=jnp.min(fit, axis=0))
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        P, T = self.pop_size, self.n_neighbor
+        key, parent_key, x_key, mut_key = jax.random.split(state.key, 4)
+
+        # Each subproblem draws two distinct random neighbors as parents.
+        perm = jax.vmap(lambda k: jax.random.permutation(k, T))(
+            jax.random.split(parent_key, P)
+        )
+        parents = jnp.take_along_axis(self.neighbors, perm[:, :2], axis=1)  # (P, 2)
+        p1 = state.pop[parents[:, 0]]
+        p2 = state.pop[parents[:, 1]]
+        # One SBX-half offspring per subproblem: pair layout (p1; p2).
+        offspring = self.crossover(x_key, jnp.concatenate([p1, p2], axis=0))
+        offspring = self.mutation(mut_key, offspring, self.lb, self.ub)
+        offspring = jnp.clip(offspring, self.lb, self.ub)
+        off_fit = evaluate(offspring)
+
+        z = jnp.minimum(state.z, jnp.min(off_fit, axis=0))
+
+        # Offspring i may replace any member of its neighborhood where it
+        # improves the member's own PBI subproblem; each member takes the
+        # best improving claimant (scatter-min — the tensorized stand-in for
+        # the reference's order-dependent sequential replacement).
+        nb_w = self.w[self.neighbors]  # (P, T, m)
+        g_old = pbi(state.fit[self.neighbors], nb_w, z)  # (P, T)
+        g_new = pbi(off_fit[:, None, :], nb_w, z)  # (P, T)
+        improve = g_new <= g_old
+
+        flat_target = self.neighbors.reshape(-1)
+        flat_gnew = jnp.where(improve, g_new, jnp.inf).reshape(-1)
+        best_g = jnp.full((P,), jnp.inf, dtype=flat_gnew.dtype).at[flat_target].min(
+            flat_gnew
+        )
+        # Recover the claiming offspring: scatter-min its index among ties.
+        off_idx = jnp.broadcast_to(
+            jnp.arange(P, dtype=jnp.int32)[:, None], (P, T)
+        ).reshape(-1)
+        is_best = flat_gnew == best_g[flat_target]
+        claimant = jnp.full((P,), P, dtype=jnp.int32).at[flat_target].min(
+            jnp.where(is_best & jnp.isfinite(flat_gnew), off_idx, P)
+        )
+        replaced = claimant < P
+        safe = jnp.minimum(claimant, P - 1)
+        pop = jnp.where(replaced[:, None], offspring[safe], state.pop)
+        fit = jnp.where(replaced[:, None], off_fit[safe], state.fit)
+        return state.replace(key=key, pop=pop, fit=fit, z=z)

@@ -1,0 +1,150 @@
+"""RVEA: reference-vector guided evolutionary algorithm.
+
+TPU-native counterpart of the reference RVEA
+(``src/evox/algorithms/mo/rvea.py:13-154``): APD-based survivor selection
+against a Das-Dennis reference-vector set, with periodic reference-vector
+adaptation gated by ``lax.cond`` (the reference uses ``torch.cond``,
+``rvea.py:131-133``).  The population is kept at the fixed reference-vector
+count with NaN rows marking empty slots — the fixed-shape idiom that keeps
+a "variable-size" population compile-friendly (SURVEY hard-part №2).
+
+References:
+    [1] R. Cheng et al., "A reference vector guided evolutionary algorithm
+        for many-objective optimization," IEEE TEVC 20(5), 2016.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ...core import Algorithm, EvalFn, Parameter, State
+from ...operators.crossover import simulated_binary
+from ...operators.mutation import polynomial_mutation
+from ...operators.sampling import uniform_sampling
+from ...operators.selection import ref_vec_guided
+
+__all__ = ["RVEA"]
+
+
+def _valid_mating_pool(key: jax.Array, pop: jax.Array, n: int) -> jax.Array:
+    """Sample ``n`` rows uniformly among the non-NaN rows of ``pop``
+    (reference ``rvea.py:118-125``): NaN rows are empty population slots."""
+    valid_mask = ~jnp.isnan(pop).all(axis=1)
+    num_valid = jnp.sum(valid_mask, dtype=jnp.int32)
+    mating = jax.random.randint(key, (n,), 0, jnp.maximum(num_valid, 1))
+    # Stable-compaction: indices of valid rows first, in order.
+    sorted_indices = jnp.argsort(
+        jnp.where(valid_mask, jnp.arange(pop.shape[0]), jnp.iinfo(jnp.int32).max),
+        stable=True,
+    )
+    return pop[sorted_indices[mating]]
+
+
+class RVEA(Algorithm):
+    """Tensorized RVEA with angle-penalized-distance selection."""
+
+    def __init__(
+        self,
+        pop_size: int,
+        n_objs: int,
+        lb: jax.Array,
+        ub: jax.Array,
+        alpha: float = 2.0,
+        fr: float = 0.1,
+        max_gen: int = 100,
+        selection_op: Callable | None = None,
+        mutation_op: Callable | None = None,
+        crossover_op: Callable | None = None,
+        dtype=jnp.float32,
+    ):
+        """
+        :param pop_size: requested population size; rounded to the Das-Dennis
+            reference-vector count.
+        :param n_objs: number of objectives.
+        :param lb: 1-D lower bounds. :param ub: 1-D upper bounds.
+        :param alpha: APD penalty rate-of-change parameter.
+        :param fr: reference-vector adaptation frequency.
+        :param max_gen: expected number of generations (drives the APD ramp).
+        """
+        lb = jnp.asarray(lb, dtype=dtype)
+        ub = jnp.asarray(ub, dtype=dtype)
+        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        self.n_objs = n_objs
+        self.dim = lb.shape[0]
+        self.lb = lb
+        self.ub = ub
+        self.dtype = dtype
+        self.alpha = alpha
+        self.fr = fr
+        self.max_gen = max_gen
+        self.selection = selection_op or ref_vec_guided
+        self.mutation = mutation_op or polynomial_mutation
+        self.crossover = crossover_op or simulated_binary
+        v, n_vec = uniform_sampling(pop_size, n_objs)
+        self.init_v = v.astype(dtype)
+        self.pop_size = n_vec
+
+    def setup(self, key: jax.Array) -> State:
+        key, init_key = jax.random.split(key)
+        pop = (
+            jax.random.uniform(init_key, (self.pop_size, self.dim), dtype=self.dtype)
+            * (self.ub - self.lb)
+            + self.lb
+        )
+        return State(
+            key=key,
+            alpha=Parameter(self.alpha, dtype=self.dtype),
+            fr=Parameter(self.fr, dtype=self.dtype),
+            max_gen=Parameter(self.max_gen, dtype=self.dtype),
+            pop=pop,
+            fit=jnp.full((self.pop_size, self.n_objs), jnp.inf, dtype=self.dtype),
+            reference_vector=self.init_v,
+            gen=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    def init_step(self, state: State, evaluate: EvalFn) -> State:
+        return state.replace(fit=evaluate(state.pop))
+
+    def _adapt_rv(self, state: State, survivor_fit: jax.Array) -> jax.Array:
+        """Periodic reference-vector scaling to the current objective ranges
+        (reference ``rvea.py:110-113,131-133``)."""
+        rv_adapt_every = jnp.maximum(jnp.round(1.0 / state.fr), 1.0).astype(jnp.int32)
+
+        def adapt(fit):
+            scale = jnp.nanmax(fit, axis=0) - jnp.nanmin(fit, axis=0)
+            return self.init_v * scale
+
+        return jax.lax.cond(
+            state.gen % rv_adapt_every == 0,
+            adapt,
+            lambda fit: state.reference_vector,
+            survivor_fit,
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        gen = state.gen + 1
+        key, mate_key, x_key, mut_key = jax.random.split(state.key, 4)
+        pop = _valid_mating_pool(mate_key, state.pop, self.pop_size)
+        crossovered = self.crossover(x_key, pop)
+        offspring = self.mutation(mut_key, crossovered, self.lb, self.ub)
+        offspring = jnp.clip(offspring, self.lb, self.ub)
+        off_fit = evaluate(offspring)
+        merge_pop = jnp.concatenate([state.pop, offspring], axis=0)
+        merge_fit = jnp.concatenate([state.fit, off_fit], axis=0)
+        survivor, survivor_fit = self.selection(
+            merge_pop,
+            merge_fit,
+            state.reference_vector,
+            (gen.astype(self.dtype) / state.max_gen) ** state.alpha,
+        )
+        reference_vector = self._adapt_rv(state.replace(gen=gen), survivor_fit)
+        return state.replace(
+            key=key,
+            gen=gen,
+            pop=survivor,
+            fit=survivor_fit,
+            reference_vector=reference_vector,
+        )

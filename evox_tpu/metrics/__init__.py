@@ -1,0 +1,7 @@
+"""Multi-objective quality metrics (reference: ``src/evox/metrics/``)."""
+
+__all__ = ["gd", "hv", "igd"]
+
+from .gd import gd
+from .hv import hv
+from .igd import igd

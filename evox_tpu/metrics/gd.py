@@ -1,0 +1,17 @@
+"""Generational Distance (reference: ``src/evox/metrics/gd.py:4-22``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["gd"]
+
+
+def gd(objs: jax.Array, pf: jax.Array) -> jax.Array:
+    """GD between a solution set ``objs`` (n, m) and the true Pareto front
+    ``pf`` (k, m): L2 norm of per-solution nearest-front distances divided by
+    the solution count.  Lower is better."""
+    dist = jnp.linalg.norm(objs[:, None, :] - pf[None, :, :], axis=-1)
+    min_dis = jnp.min(dist, axis=1)
+    return jnp.linalg.norm(min_dis) / min_dis.shape[0]

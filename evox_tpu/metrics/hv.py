@@ -1,0 +1,23 @@
+"""Monte-Carlo hypervolume (reference: ``src/evox/metrics/hv.py:4-20``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["hv"]
+
+
+def hv(
+    key: jax.Array, objs: jax.Array, ref: jax.Array, num_sample: int = 100000
+) -> jax.Array:
+    """Monte-Carlo hypervolume of ``objs`` (n, m) w.r.t. reference point
+    ``ref`` (m,), by uniform sampling of the bounding cube.  Higher is
+    better.  Unlike the reference (global torch RNG) the sample draw takes an
+    explicit PRNG ``key``."""
+    points = jnp.abs(objs - ref)
+    bound = jnp.max(points, axis=0)
+    max_vol = jnp.prod(bound)
+    samples = jax.random.uniform(key, (num_sample, points.shape[1]), dtype=objs.dtype) * bound
+    in_cube = jnp.any(jnp.all(samples[:, None, :] < points[None, :, :], axis=2), axis=1)
+    return jnp.sum(in_cube) / num_sample * max_vol

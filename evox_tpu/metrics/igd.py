@@ -1,0 +1,21 @@
+"""Inverted Generational Distance (reference: ``src/evox/metrics/igd.py:4-21``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["igd"]
+
+
+def igd(objs: jax.Array, pf: jax.Array, p: int = 1) -> jax.Array:
+    """IGD between a solution set ``objs`` (n, m) and the true Pareto front
+    ``pf`` (k, m): mean L^p-aggregated distance from each front point to its
+    nearest solution.  Lower is better.
+
+    The (k, n) distance matrix is one MXU-friendly
+    ``|a|² + |b|² - 2 a·bᵀ`` expansion via ``jnp.linalg`` broadcasting.
+    """
+    dist = jnp.linalg.norm(pf[:, None, :] - objs[None, :, :], axis=-1)
+    min_dis = jnp.min(dist, axis=1)
+    return jnp.mean(min_dis**p) ** (1.0 / p)

@@ -1,0 +1,226 @@
+"""DTLZ test suite (DTLZ1-7) for multi-objective optimization.
+
+TPU-native counterpart of the reference DTLZ suite
+(``src/evox/problems/numerical/dtlz.py:19-423``): the shared
+``(1+g) * flip(cumprod([1, cos])) * [1, sin]`` objective construction is
+factored into one helper, everything is batched ``(n, d) -> (n, m)`` tensor
+math that XLA fuses into a single kernel, and each problem's analytic Pareto
+front (``pf()``) is built host-side from Das-Dennis / grid sampling exactly
+like the reference.
+
+References:
+    [1] K. Deb et al., "Scalable test problems for evolutionary
+        multiobjective optimization," in Evolutionary Multiobjective
+        Optimization, Springer, 2005, pp. 105-145.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import Problem, State
+from ...operators.sampling import grid_sampling, uniform_sampling
+
+__all__ = ["DTLZ", "DTLZ1", "DTLZ2", "DTLZ3", "DTLZ4", "DTLZ5", "DTLZ6", "DTLZ7"]
+
+
+def _angle_objectives(g: jax.Array, x_front: jax.Array) -> jax.Array:
+    """The spherical objective construction shared by DTLZ2-6:
+    ``(1+g) * flip(cumprod([1, cos(x π/2)])) * [1, sin(flip(x) π/2)]``."""
+    n = x_front.shape[0]
+    ones = jnp.ones((n, 1), dtype=x_front.dtype)
+    cos_part = jnp.flip(
+        jnp.cumprod(
+            jnp.concatenate(
+                [ones, jnp.maximum(jnp.cos(x_front * jnp.pi / 2), 0.0)], axis=1
+            ),
+            axis=1,
+        ),
+        axis=1,
+    )
+    sin_part = jnp.concatenate(
+        [ones, jnp.sin(jnp.flip(x_front, axis=1) * jnp.pi / 2)], axis=1
+    )
+    return (1 + g) * cos_part * sin_part
+
+
+def _rastrigin_g(x_rear: jax.Array, d: int, m: int) -> jax.Array:
+    """The multimodal distance function of DTLZ1/DTLZ3."""
+    return 100.0 * (
+        d
+        - m
+        + 1
+        + jnp.sum(
+            (x_rear - 0.5) ** 2 - jnp.cos(20.0 * jnp.pi * (x_rear - 0.5)),
+            axis=1,
+            keepdims=True,
+        )
+    )
+
+
+def _degenerate_pf(n: int, m: int, dtype) -> jax.Array:
+    """Analytic degenerate-curve Pareto front of DTLZ5/DTLZ6
+    (reference ``dtlz.py:266-300``)."""
+    a = jnp.concatenate([jnp.arange(0.0, 1.0, 1.0 / (n - 1)), jnp.ones((1,))])
+    b = jnp.concatenate([jnp.arange(1.0, 0.0, -1.0 / (n - 1)), jnp.zeros((1,))])
+    f = jnp.stack([a, b], axis=1).astype(dtype)
+    f = f / jnp.linalg.norm(f, axis=1, keepdims=True)
+    for _ in range(m - 2):
+        f = jnp.concatenate([f[:, :1], f], axis=1)
+    powers = jnp.concatenate(
+        [jnp.asarray([m - 2]), jnp.arange(m - 2, -1, -1)]
+    ).astype(dtype)
+    return f / jnp.sqrt(jnp.asarray(2.0, dtype)) ** powers[None, :]
+
+
+class DTLZ(Problem):
+    """Base class of the DTLZ suite: decision space ``[0, 1]^d``, objective
+    count ``m``, analytic ``pf()`` sampled at ``ref_num * m`` points."""
+
+    def __init__(self, d: int, m: int, ref_num: int = 1000, dtype=jnp.float32):
+        self.d = d
+        self.m = m
+        self.ref_num = ref_num
+        self.dtype = dtype
+        self.sample = uniform_sampling(ref_num * m, m)[0].astype(dtype)
+
+    @property
+    def lb(self) -> jax.Array:
+        return jnp.zeros((self.d,), dtype=self.dtype)
+
+    @property
+    def ub(self) -> jax.Array:
+        return jnp.ones((self.d,), dtype=self.dtype)
+
+    def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
+        return self._eval(pop), state
+
+    def _eval(self, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def pf(self) -> jax.Array:
+        return self.sample / 2
+
+
+class DTLZ1(DTLZ):
+    """Linear Pareto front with a highly multimodal distance function."""
+
+    def __init__(self, d: int = 7, m: int = 3, ref_num: int = 1000, dtype=jnp.float32):
+        super().__init__(d, m, ref_num, dtype)
+
+    def _eval(self, x: jax.Array) -> jax.Array:
+        n, d = x.shape
+        m = self.m
+        g = _rastrigin_g(x[:, m - 1 :], d, m)
+        ones = jnp.ones((n, 1), dtype=x.dtype)
+        flip_cumprod = jnp.flip(
+            jnp.cumprod(jnp.concatenate([ones, x[:, : m - 1]], axis=1), axis=1),
+            axis=1,
+        )
+        rest = jnp.concatenate([ones, 1 - jnp.flip(x[:, : m - 1], axis=1)], axis=1)
+        return 0.5 * (1 + g) * flip_cumprod * rest
+
+
+class DTLZ2(DTLZ):
+    """Spherical Pareto front, unimodal distance function."""
+
+    def __init__(self, d: int = 12, m: int = 3, ref_num: int = 1000, dtype=jnp.float32):
+        super().__init__(d, m, ref_num, dtype)
+
+    def _eval(self, x: jax.Array) -> jax.Array:
+        m = self.m
+        g = jnp.sum((x[:, m - 1 :] - 0.5) ** 2, axis=1, keepdims=True)
+        return _angle_objectives(g, x[:, : m - 1])
+
+    def pf(self) -> jax.Array:
+        f = self.sample
+        return f / jnp.linalg.norm(f, axis=1, keepdims=True)
+
+
+class DTLZ3(DTLZ2):
+    """DTLZ2 front with the DTLZ1 multimodal distance function."""
+
+    def _eval(self, x: jax.Array) -> jax.Array:
+        m = self.m
+        g = _rastrigin_g(x[:, m - 1 :], x.shape[1], m)
+        return _angle_objectives(g, x[:, : m - 1])
+
+
+class DTLZ4(DTLZ2):
+    """DTLZ2 with a strong density bias (``x^100`` mapping) on the front."""
+
+    def _eval(self, x: jax.Array) -> jax.Array:
+        m = self.m
+        x_front = x[:, : m - 1] ** 100
+        g = jnp.sum((x[:, m - 1 :] - 0.5) ** 2, axis=1, keepdims=True)
+        return _angle_objectives(g, x_front)
+
+
+class DTLZ5(DTLZ):
+    """Degenerate-curve Pareto front."""
+
+    def __init__(self, d: int = 12, m: int = 3, ref_num: int = 1000, dtype=jnp.float32):
+        super().__init__(d, m, ref_num, dtype)
+
+    def _eval(self, x: jax.Array) -> jax.Array:
+        m = self.m
+        g = jnp.sum((x[:, m - 1 :] - 0.5) ** 2, axis=1, keepdims=True)
+        x_front = x[:, : m - 1]
+        bent = (1 + 2 * g * x_front[:, 1:]) / (2 + 2 * g)
+        x_front = jnp.concatenate([x_front[:, :1], bent], axis=1)
+        return _angle_objectives(g, x_front)
+
+    def pf(self) -> jax.Array:
+        return _degenerate_pf(self.ref_num * self.m, self.m, self.dtype)
+
+
+class DTLZ6(DTLZ5):
+    """DTLZ5 with a biased ``x^0.1`` distance function."""
+
+    def _eval(self, x: jax.Array) -> jax.Array:
+        m = self.m
+        g = jnp.sum(x[:, m - 1 :] ** 0.1, axis=1, keepdims=True)
+        x_front = x[:, : m - 1]
+        bent = (1 + 2 * g * x_front[:, 1:]) / (2 + 2 * g)
+        x_front = jnp.concatenate([x_front[:, :1], bent], axis=1)
+        return _angle_objectives(g, x_front)
+
+
+class DTLZ7(DTLZ):
+    """Disconnected Pareto front."""
+
+    def __init__(self, d: int = 21, m: int = 3, ref_num: int = 1000, dtype=jnp.float32):
+        super().__init__(d, m, ref_num, dtype)
+        self.sample = grid_sampling(ref_num * m, m - 1)[0].astype(dtype)
+
+    def _eval(self, x: jax.Array) -> jax.Array:
+        m = self.m
+        g = 1 + 9 * jnp.mean(x[:, m - 1 :], axis=1, keepdims=True)
+        term = jnp.sum(
+            x[:, : m - 1] / (1 + g) * (1 + jnp.sin(3 * jnp.pi * x[:, : m - 1])),
+            axis=1,
+            keepdims=True,
+        )
+        return jnp.concatenate([x[:, : m - 1], (1 + g) * (m - term)], axis=1)
+
+    def pf(self) -> jax.Array:
+        # Piecewise remap of the grid into the disconnected regions
+        # (reference ``dtlz.py:400-423``).
+        interval = jnp.asarray([0.0, 0.251412, 0.631627, 0.859401], self.dtype)
+        median = (interval[1] - interval[0]) / (
+            interval[3] - interval[2] + interval[1] - interval[0]
+        )
+        x = self.sample
+        x = jnp.where(
+            x <= median, x * (interval[1] - interval[0]) / median + interval[0], x
+        )
+        x = jnp.where(
+            x > median,
+            (x - median) * (interval[3] - interval[2]) / (1 - median) + interval[2],
+            x,
+        )
+        last = 2 * (
+            self.m - jnp.sum(x / 2 * (1 + jnp.sin(3 * jnp.pi * x)), axis=1, keepdims=True)
+        )
+        return jnp.concatenate([x, last], axis=1)

@@ -1,0 +1,66 @@
+"""DTLZ suite tests (reference: ``unit_test/problems/test_dtlz.py``):
+shape contracts, known optima on analytic points, and Pareto-front sanity."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu.problems.numerical import (
+    DTLZ1,
+    DTLZ2,
+    DTLZ3,
+    DTLZ4,
+    DTLZ5,
+    DTLZ6,
+    DTLZ7,
+)
+
+SUITE = [DTLZ1, DTLZ2, DTLZ3, DTLZ4, DTLZ5, DTLZ6, DTLZ7]
+
+
+@pytest.mark.parametrize("cls", SUITE)
+def test_shapes_and_pf(cls, key):
+    prob = cls(m=3)
+    pop = jax.random.uniform(key, (8, prob.d))
+    fit, _ = prob.evaluate(prob.setup(key), pop)
+    assert fit.shape == (8, 3)
+    assert jnp.all(jnp.isfinite(fit))
+    pf = prob.pf()
+    assert pf.shape[1] == 3
+    assert jnp.all(jnp.isfinite(pf))
+
+
+def test_dtlz1_optimum():
+    # x_rear = 0.5 makes g = 0; objectives sum to 0.5 on the linear front.
+    prob = DTLZ1(m=3)
+    x = jnp.concatenate([jnp.asarray([0.3, 0.7]), jnp.full((prob.d - 2,), 0.5)])[None]
+    fit, _ = prob.evaluate(prob.setup(jax.random.key(0)), x)
+    assert jnp.allclose(jnp.sum(fit), 0.5, atol=1e-5)
+
+
+def test_dtlz2_optimum_sphere():
+    # x_rear = 0.5 gives points exactly on the unit sphere.
+    prob = DTLZ2(m=3)
+    x = jnp.concatenate([jnp.asarray([0.2, 0.8]), jnp.full((prob.d - 2,), 0.5)])[None]
+    fit, _ = prob.evaluate(prob.setup(jax.random.key(0)), x)
+    assert jnp.allclose(jnp.linalg.norm(fit), 1.0, atol=1e-5)
+
+
+def test_dtlz2_pf_on_sphere():
+    pf = DTLZ2(m=3).pf()
+    norms = jnp.linalg.norm(pf, axis=1)
+    assert jnp.allclose(norms, 1.0, atol=1e-5)
+
+
+def test_dtlz7_disconnected_front_shape():
+    pf = DTLZ7(m=3).pf()
+    # First m-1 coordinates are in [0, 1); last is the h-function value.
+    assert jnp.all(pf[:, :2] >= 0.0) and jnp.all(pf[:, :2] <= 1.0)
+    assert jnp.all(pf[:, 2] > 0.0)
+
+
+def test_evaluate_is_jittable(key):
+    prob = DTLZ3(m=3)
+    pop = jax.random.uniform(key, (4, prob.d))
+    fit = jax.jit(lambda p: prob.evaluate(prob.setup(jax.random.key(0)), p)[0])(pop)
+    assert fit.shape == (4, 3)

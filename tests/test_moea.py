@@ -1,0 +1,99 @@
+"""MO algorithm tests (reference contract:
+``unit_test/algorithms/test_moea.py:11-86``): every MOEA runs eager, jitted,
+and vmapped over stacked instances on DTLZ2(m=3), with Pareto-front retrieval
+through the monitor, plus a convergence sanity check (IGD improves) that the
+reference's smoke tests lack.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu.algorithms import MOEAD, NSGA2, NSGA3, RVEA, RVEAa, HypE
+from evox_tpu.metrics import igd
+from evox_tpu.problems.numerical import DTLZ2
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+POP_SIZE = 20
+DIM = 10
+LB = jnp.zeros(DIM)
+UB = jnp.ones(DIM)
+
+ALGOS = {
+    "nsga2": lambda: NSGA2(POP_SIZE, 3, LB, UB),
+    "nsga3": lambda: NSGA3(POP_SIZE, 3, LB, UB),
+    "rvea": lambda: RVEA(POP_SIZE, 3, LB, UB),
+    "rveaa": lambda: RVEAa(POP_SIZE, 3, LB, UB),
+    "moead": lambda: MOEAD(POP_SIZE, 3, LB, UB),
+    "hype": lambda: HypE(POP_SIZE, 3, LB, UB, n_sample=512),
+}
+
+
+def _fit_ok(fit):
+    # NaN rows are legal empty slots for the NaN-padded algorithms; at least
+    # one row must be real and no row may be +-inf after the first eval.
+    valid = ~jnp.isnan(fit).any(axis=-1)
+    assert jnp.sum(valid) > 0
+    assert jnp.all(jnp.isfinite(fit[valid]))
+
+
+@pytest.mark.parametrize("name", ALGOS)
+def test_mo_eager(name):
+    algo = ALGOS[name]()
+    monitor = EvalMonitor(multi_obj=True, full_sol_history=True)
+    wf = StdWorkflow(algo, DTLZ2(m=3), monitor=monitor)
+    state = wf.init(jax.random.key(0))
+    state = wf.init_step(state)
+    for _ in range(3):
+        state = wf.step(state)
+    _fit_ok(state.algorithm.fit)
+    sol, fit = monitor.get_pf()
+    assert sol.shape[1] == DIM and fit.shape[1] == 3
+    assert monitor.get_pf_fitness().shape[1] == 3
+
+
+@pytest.mark.parametrize("name", ALGOS)
+def test_mo_jit(name):
+    algo = ALGOS[name]()
+    wf = StdWorkflow(algo, DTLZ2(m=3))
+    state = wf.init(jax.random.key(1))
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    for _ in range(3):
+        state = step(state)
+    _fit_ok(state.algorithm.fit)
+
+
+@pytest.mark.parametrize("name", ["nsga2", "rvea", "moead"])
+def test_mo_vmap(name):
+    algo = ALGOS[name]()
+    wf = StdWorkflow(algo, DTLZ2(m=3))
+    keys = jax.random.split(jax.random.key(2), 3)
+    states = jax.vmap(wf.init)(keys)
+    states = jax.jit(jax.vmap(wf.init_step))(states)
+    step = jax.jit(jax.vmap(wf.step))
+    for _ in range(3):
+        states = step(states)
+    assert states.algorithm.fit.shape[0] == 3
+    _fit_ok(states.algorithm.fit[0])
+
+
+@pytest.mark.parametrize("name", ["nsga2", "rvea"])
+def test_mo_converges(name):
+    # IGD on DTLZ2 must improve substantially over 30 generations — a real
+    # optimization check, not just a smoke run.
+    algo = ALGOS[name]()
+    prob = DTLZ2(m=3)
+    wf = StdWorkflow(algo, prob)
+    state = wf.init(jax.random.key(3))
+    state = jax.jit(wf.init_step)(state)
+    fit0 = state.algorithm.fit
+    valid0 = ~jnp.isnan(fit0).any(axis=-1)
+    igd0 = igd(fit0[valid0], prob.pf())
+    step = jax.jit(wf.step)
+    for _ in range(30):
+        state = step(state)
+    fit = state.algorithm.fit
+    valid = ~jnp.isnan(fit).any(axis=-1)
+    igd1 = igd(jnp.where(valid[:, None], fit, 1e9), prob.pf())
+    assert igd1 < igd0 * 0.7, f"IGD did not improve: {igd0} -> {igd1}"
