@@ -24,7 +24,9 @@ from ...core import Algorithm, EvalFn, State
 from ...operators.crossover import simulated_binary
 from ...operators.mutation import polynomial_mutation
 from ...operators.selection import (
+    crowding_distance,
     nd_environmental_selection,
+    non_dominate_rank,
     tournament_selection_multifit,
 )
 
@@ -84,8 +86,13 @@ class NSGA2(Algorithm):
         )
 
     def init_step(self, state: State, evaluate: EvalFn) -> State:
+        # Rank/crowding must stay aligned with pop row order — the reference
+        # (``nsga2.py:90``) stores them permuted by nd_environmental_selection
+        # while keeping pop unpermuted, mis-attributing selection keys for the
+        # first generation; here they are computed in place.
         fit = evaluate(state.pop)
-        _, _, rank, dis = nd_environmental_selection(state.pop, fit, self.pop_size)
+        rank = non_dominate_rank(fit)
+        dis = crowding_distance(fit)
         return state.replace(fit=fit, rank=rank, dis=dis)
 
     def step(self, state: State, evaluate: EvalFn) -> State:

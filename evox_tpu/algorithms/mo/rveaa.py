@@ -30,15 +30,10 @@ from ...operators.crossover import simulated_binary
 from ...operators.mutation import polynomial_mutation
 from ...operators.sampling import uniform_sampling
 from ...operators.selection import non_dominate_rank, ref_vec_guided
+from ...operators.selection.rvea_selection import _cosine_similarity as _cosine
 from .rvea import _valid_mating_pool
 
 __all__ = ["RVEAa"]
-
-
-def _cosine(a: jax.Array, b: jax.Array) -> jax.Array:
-    a_n = a / jnp.maximum(jnp.linalg.norm(a, axis=-1, keepdims=True), 1e-12)
-    b_n = b / jnp.maximum(jnp.linalg.norm(b, axis=-1, keepdims=True), 1e-12)
-    return a_n @ b_n.T
 
 
 class RVEAa(Algorithm):
@@ -148,7 +143,9 @@ class RVEAa(Algorithm):
         not_all_nan = ~jnp.isnan(cosine).all(axis=1)
         diag = jnp.eye(cosine.shape[0], dtype=bool) & not_all_nan[:, None]
         cosine = jnp.where(diag, 0.0, cosine)
-        # Crowding key: similarity to the nearest neighbor (NaN rows last).
+        # Crowding key: similarity to the nearest neighbor.  NaN (empty) rows
+        # map to -inf and therefore sort FIRST, absorbing the drop quota
+        # before any crowded valid row — same -inf key as the reference.
         nearest = jnp.sort(-cosine, axis=1)[:, 0]
         nearest = jnp.where(jnp.isnan(nearest), -jnp.inf, nearest)
         order = jnp.argsort(nearest)

@@ -1,0 +1,125 @@
+"""Comprehensive Learning PSO.
+
+TPU-native counterpart of the reference CLPSO
+(``src/evox/algorithms/so/pso_variants/clpso.py:9-123``): each particle
+learns, per the learning probability ``P_c``, from the personal best of a
+random tournament winner instead of its own.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core import Algorithm, EvalFn, Parameter, State
+from .utils import min_by
+
+__all__ = ["CLPSO"]
+
+
+class CLPSO(Algorithm):
+    """Comprehensive-learning PSO."""
+
+    def __init__(
+        self,
+        pop_size: int,
+        lb: jax.Array,
+        ub: jax.Array,
+        inertia_weight: float = 0.5,
+        const_coefficient: float = 1.5,
+        learning_probability: float = 0.05,
+        dtype=jnp.float32,
+    ):
+        """
+        :param pop_size: population size.
+        :param lb: 1-D lower bounds. :param ub: 1-D upper bounds.
+        :param inertia_weight: inertia weight ``w``.
+        :param const_coefficient: acceleration coefficient ``c``.
+        :param learning_probability: comprehensive-learning probability ``P_c``.
+        """
+        lb = jnp.asarray(lb, dtype=dtype)
+        ub = jnp.asarray(ub, dtype=dtype)
+        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        self.pop_size = pop_size
+        self.dim = lb.shape[0]
+        self.lb = lb
+        self.ub = ub
+        self.dtype = dtype
+        self.w = inertia_weight
+        self.c = const_coefficient
+        self.P_c = learning_probability
+
+    def setup(self, key: jax.Array) -> State:
+        key, pop_key, v_key = jax.random.split(key, 3)
+        length = self.ub - self.lb
+        pop = (
+            jax.random.uniform(pop_key, (self.pop_size, self.dim), dtype=self.dtype)
+            * length
+            + self.lb
+        )
+        velocity = (
+            jax.random.uniform(v_key, (self.pop_size, self.dim), dtype=self.dtype) * 2
+            - 1
+        ) * length
+        return State(
+            key=key,
+            w=Parameter(self.w, dtype=self.dtype),
+            c=Parameter(self.c, dtype=self.dtype),
+            P_c=Parameter(self.P_c, dtype=self.dtype),
+            pop=pop,
+            fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
+            velocity=velocity,
+            personal_best_location=pop,
+            personal_best_fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
+            global_best_location=pop[0],
+            global_best_fit=jnp.asarray(jnp.inf, dtype=self.dtype),
+        )
+
+    def init_step(self, state: State, evaluate: EvalFn) -> State:
+        fit = evaluate(state.pop)
+        return state.replace(
+            fit=fit, personal_best_fit=fit, global_best_fit=jnp.min(fit)
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, coeff_key, r1_key, r2_key, p_key = jax.random.split(state.key, 5)
+        n, d = self.pop_size, self.dim
+        random_coefficient = jax.random.uniform(coeff_key, (n, d), dtype=self.dtype)
+        rand1 = jax.random.randint(r1_key, (n,), 0, n)
+        rand2 = jax.random.randint(r2_key, (n,), 0, n)
+        rand_possibility = jax.random.uniform(p_key, (n,), dtype=self.dtype)
+        learning_index = jnp.where(
+            state.personal_best_fit[rand1] < state.personal_best_fit[rand2],
+            rand1,
+            rand2,
+        )
+        compare = state.personal_best_fit > state.fit
+        personal_best_location = jnp.where(
+            compare[:, None], state.pop, state.personal_best_location
+        )
+        personal_best_fit = jnp.where(compare, state.fit, state.personal_best_fit)
+        global_best_location, global_best_fit = min_by(
+            [state.global_best_location[None, :], state.pop],
+            [state.global_best_fit[None], state.fit],
+        )
+        personal_best = jnp.where(
+            (rand_possibility < state.P_c)[:, None],
+            personal_best_location[learning_index],
+            personal_best_location,
+        )
+        velocity = state.w * state.velocity + state.c * random_coefficient * (
+            personal_best - state.pop
+        )
+        velocity = jnp.clip(velocity, self.lb, self.ub)
+        pop = jnp.clip(state.pop + velocity, self.lb, self.ub)
+        fit = evaluate(pop)
+        return state.replace(
+            key=key,
+            pop=pop,
+            fit=fit,
+            velocity=velocity,
+            personal_best_location=personal_best_location,
+            personal_best_fit=personal_best_fit,
+            global_best_location=global_best_location,
+            global_best_fit=global_best_fit,
+        )

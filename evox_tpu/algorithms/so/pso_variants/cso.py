@@ -1,0 +1,110 @@
+"""Competitive Swarm Optimizer.
+
+TPU-native counterpart of the reference CSO
+(``src/evox/algorithms/so/pso_variants/cso.py:7-105``): random pairwise
+competitions; losers learn from winners and (weighted by ``phi``) from the
+swarm center.  Only the losing half is re-evaluated each generation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core import Algorithm, EvalFn, Parameter, State
+
+__all__ = ["CSO"]
+
+
+class CSO(Algorithm):
+    """Competitive swarm optimizer."""
+
+    def __init__(
+        self,
+        pop_size: int,
+        lb: jax.Array,
+        ub: jax.Array,
+        phi: float = 0.0,
+        mean: jax.Array | None = None,
+        stdev: jax.Array | None = None,
+        dtype=jnp.float32,
+    ):
+        """
+        :param pop_size: population size (must be even: pairwise contests).
+        :param lb: 1-D lower bounds. :param ub: 1-D upper bounds.
+        :param phi: social factor toward the swarm center.
+        :param mean: optional Gaussian init mean.
+        :param stdev: optional Gaussian init stdev.
+        """
+        lb = jnp.asarray(lb, dtype=dtype)
+        ub = jnp.asarray(ub, dtype=dtype)
+        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        assert pop_size % 2 == 0, "CSO needs an even population for pairing"
+        self.pop_size = pop_size
+        self.dim = lb.shape[0]
+        self.lb = lb
+        self.ub = ub
+        self.phi = phi
+        self.mean = mean
+        self.stdev = stdev
+        self.dtype = dtype
+
+    def setup(self, key: jax.Array) -> State:
+        key, pop_key, v_key = jax.random.split(key, 3)
+        length = self.ub - self.lb
+        if self.mean is not None and self.stdev is not None:
+            pop = self.mean + self.stdev * jax.random.normal(
+                pop_key, (self.pop_size, self.dim), dtype=self.dtype
+            )
+            pop = jnp.clip(pop, self.lb, self.ub)
+        else:
+            pop = (
+                jax.random.uniform(pop_key, (self.pop_size, self.dim), dtype=self.dtype)
+                * length
+                + self.lb
+            )
+        velocity = (
+            jax.random.uniform(v_key, (self.pop_size, self.dim), dtype=self.dtype) * 2
+            - 1
+        ) * length
+        return State(
+            key=key,
+            phi=Parameter(self.phi, dtype=self.dtype),
+            pop=pop,
+            fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
+            velocity=velocity,
+        )
+
+    def init_step(self, state: State, evaluate: EvalFn) -> State:
+        return state.replace(fit=evaluate(state.pop))
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, pair_key, lam_key = jax.random.split(state.key, 3)
+        half = self.pop_size // 2
+        perm = jax.random.permutation(pair_key, self.pop_size).reshape(2, half)
+        left, right = perm[0], perm[1]
+        winner_is_left = state.fit[left] < state.fit[right]
+        teachers = jnp.where(winner_is_left, left, right)
+        students = jnp.where(winner_is_left, right, left)
+        center = jnp.mean(state.pop, axis=0)
+
+        lambda1, lambda2, lambda3 = jax.random.uniform(
+            lam_key, (3, half, self.dim), dtype=self.dtype
+        )
+        student_velocity = (
+            lambda1 * state.velocity[students]
+            + lambda2 * (state.pop[teachers] - state.pop[students])
+            + state.phi * lambda3 * (center - state.pop[students])
+        )
+        vel_range = self.ub - self.lb
+        student_velocity = jnp.clip(student_velocity, -vel_range, vel_range)
+        candidates = jnp.clip(
+            state.pop[students] + student_velocity, self.lb, self.ub
+        )
+        candidates_fit = evaluate(candidates)
+        return state.replace(
+            key=key,
+            pop=state.pop.at[students].set(candidates),
+            velocity=state.velocity.at[students].set(student_velocity),
+            fit=state.fit.at[students].set(candidates_fit),
+        )

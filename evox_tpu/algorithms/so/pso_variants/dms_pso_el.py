@@ -1,0 +1,247 @@
+"""Dynamic Multi-Swarm PSO with Elite Learning.
+
+TPU-native counterpart of the reference DMSPSOEL
+(``src/evox/algorithms/so/pso_variants/dms_pso_el.py:7-221``): several small
+dynamic sub-swarms plus one following sub-swarm, periodic random regrouping,
+and a switch to a global-best strategy in the last 10% of the run.  The
+reference's eager Python branches (``dms_pso_el.py:112-115,174-176`` — which
+would graph-break under ``torch.compile``) are ``lax.cond`` here, so the
+whole step stays inside one jitted program.
+
+Parity note: the reference does not permute ``fit`` when regrouping
+(``_regroup``, ``dms_pso_el.py:178-197``), leaving fitness transiently
+misaligned with positions for the regrouping generation; this implementation
+permutes ``fit`` alongside the rest — alignment is required for the pbest
+update that immediately follows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core import Algorithm, EvalFn, Parameter, State
+
+__all__ = ["DMSPSOEL"]
+
+
+class DMSPSOEL(Algorithm):
+    """Dynamic multi-swarm PSO with elite learning."""
+
+    def __init__(
+        self,
+        lb: jax.Array,
+        ub: jax.Array,
+        dynamic_sub_swarm_size: int = 10,
+        dynamic_sub_swarms_num: int = 5,
+        following_sub_swarm_size: int = 10,
+        regrouped_iteration_num: int = 50,
+        max_iteration: int = 100,
+        inertia_weight: float = 0.7,
+        pbest_coefficient: float = 1.5,
+        lbest_coefficient: float = 1.5,
+        rbest_coefficient: float = 1.0,
+        gbest_coefficient: float = 1.0,
+        dtype=jnp.float32,
+    ):
+        """
+        :param lb: 1-D lower bounds. :param ub: 1-D upper bounds.
+        :param dynamic_sub_swarm_size: particles per dynamic sub-swarm.
+        :param dynamic_sub_swarms_num: number of dynamic sub-swarms.
+        :param following_sub_swarm_size: particles in the following swarm.
+        :param regrouped_iteration_num: regroup every this many iterations.
+        :param max_iteration: total iterations (drives the strategy switch).
+        """
+        lb = jnp.asarray(lb, dtype=dtype)
+        ub = jnp.asarray(ub, dtype=dtype)
+        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        self.dim = lb.shape[0]
+        self.pop_size = (
+            dynamic_sub_swarm_size * dynamic_sub_swarms_num + following_sub_swarm_size
+        )
+        self.swarm_size = dynamic_sub_swarm_size
+        self.swarms_num = dynamic_sub_swarms_num
+        self.following_size = following_sub_swarm_size
+        self.regrouped_iteration_num = regrouped_iteration_num
+        self.max_iteration = max_iteration
+        self.lb = lb
+        self.ub = ub
+        self.dtype = dtype
+        self.hyper = dict(
+            w=inertia_weight,
+            c_pbest=pbest_coefficient,
+            c_lbest=lbest_coefficient,
+            c_rbest=rbest_coefficient,
+            c_gbest=gbest_coefficient,
+        )
+
+    def setup(self, key: jax.Array) -> State:
+        key, pop_key, v_key = jax.random.split(key, 3)
+        length = self.ub - self.lb
+        pop = (
+            jax.random.uniform(pop_key, (self.pop_size, self.dim), dtype=self.dtype)
+            * length
+            + self.lb
+        )
+        velocity = (
+            jax.random.uniform(v_key, (self.pop_size, self.dim), dtype=self.dtype) * 2
+            - 1
+        ) * length
+        dyn = self.swarm_size * self.swarms_num
+        return State(
+            key=key,
+            regrouped_iteration_num=Parameter(
+                self.regrouped_iteration_num, dtype=jnp.int32
+            ),
+            max_iteration=Parameter(self.max_iteration, dtype=jnp.int32),
+            w=Parameter(self.hyper["w"], dtype=self.dtype),
+            c_pbest=Parameter(self.hyper["c_pbest"], dtype=self.dtype),
+            c_lbest=Parameter(self.hyper["c_lbest"], dtype=self.dtype),
+            c_rbest=Parameter(self.hyper["c_rbest"], dtype=self.dtype),
+            c_gbest=Parameter(self.hyper["c_gbest"], dtype=self.dtype),
+            iteration=jnp.zeros((), dtype=jnp.int32),
+            pop=pop,
+            velocity=velocity,
+            fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
+            personal_best_location=pop,
+            personal_best_fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
+            local_best_location=pop[:dyn].reshape(
+                self.swarms_num, self.swarm_size, self.dim
+            )[:, 0, :],
+            local_best_fit=jnp.full((self.swarms_num,), jnp.inf, dtype=self.dtype),
+            regional_best_index=jnp.zeros((self.following_size,), dtype=jnp.int32),
+            global_best_location=jnp.zeros((self.dim,), dtype=self.dtype),
+            global_best_fit=jnp.asarray(jnp.inf, dtype=self.dtype),
+        )
+
+    def init_step(self, state: State, evaluate: EvalFn) -> State:
+        fit = evaluate(state.pop)
+        return state.replace(fit=fit, iteration=state.iteration + 1)
+
+    # -- periodic regroup ----------------------------------------------------
+    def _regroup(self, key: jax.Array, state: State) -> State:
+        dyn = self.swarm_size * self.swarms_num
+        sort_index = jnp.argsort(state.fit)
+        # Dynamic part is randomly shuffled; the following part takes the
+        # worst-ranked individuals (reference ``dms_pso_el.py:178-191``).
+        regroup_index = jnp.concatenate(
+            [jax.random.permutation(key, dyn), sort_index[dyn:]]
+        )
+        regional_best_index = jnp.argsort(state.fit[:dyn])[: self.following_size]
+        return state.replace(
+            pop=state.pop[regroup_index],
+            velocity=state.velocity[regroup_index],
+            fit=state.fit[regroup_index],
+            personal_best_location=state.personal_best_location[regroup_index],
+            personal_best_fit=state.personal_best_fit[regroup_index],
+            regional_best_index=regional_best_index.astype(jnp.int32),
+        )
+
+    # -- phase 1: multi-swarm search ----------------------------------------
+    def _strategy_1(self, state: State, rand_key: jax.Array) -> State:
+        dyn = self.swarm_size * self.swarms_num
+        swarm_shape = (self.swarms_num, self.swarm_size)
+        compare = state.personal_best_fit > state.fit
+        pbest_loc = jnp.where(compare[:, None], state.pop, state.personal_best_location)
+        pbest_fit = jnp.where(compare, state.fit, state.personal_best_fit)
+
+        dyn_loc = state.pop[:dyn].reshape(*swarm_shape, self.dim)
+        dyn_fit = state.fit[:dyn].reshape(*swarm_shape)
+        dyn_vel = state.velocity[:dyn].reshape(*swarm_shape, self.dim)
+        dyn_pbest = pbest_loc[:dyn].reshape(*swarm_shape, self.dim)
+        fol_loc = state.pop[dyn:]
+        fol_vel = state.velocity[dyn:]
+        fol_pbest = pbest_loc[dyn:]
+
+        local_best_fit = jnp.min(dyn_fit, axis=1)
+        local_best_idx = jnp.argmin(dyn_fit, axis=1)
+        local_best_location = jnp.take_along_axis(
+            dyn_loc, local_best_idx[:, None, None], axis=1
+        ).squeeze(1)
+        regional_best_location = state.pop[state.regional_best_index]
+
+        k1, k2, k3 = jax.random.split(rand_key, 3)
+        rand_pbest = jax.random.uniform(
+            k1, (self.pop_size, self.dim), dtype=self.dtype
+        )
+        rand_lbest = jax.random.uniform(
+            k2, (*swarm_shape, self.dim), dtype=self.dtype
+        )
+        rand_rbest = jax.random.uniform(
+            k3, (self.following_size, self.dim), dtype=self.dtype
+        )
+        dyn_vel = (
+            state.w * dyn_vel
+            + state.c_pbest
+            * rand_pbest[:dyn].reshape(*swarm_shape, self.dim)
+            * (dyn_pbest - dyn_loc)
+            + state.c_lbest * rand_lbest * (local_best_location[:, None, :] - dyn_loc)
+        )
+        fol_vel = (
+            state.w * fol_vel
+            + state.c_pbest * rand_pbest[dyn:] * (fol_pbest - fol_loc)
+            + state.c_rbest * rand_rbest * (regional_best_location - fol_loc)
+        )
+        velocity = jnp.concatenate([dyn_vel.reshape(dyn, self.dim), fol_vel])
+        pop = jnp.clip(state.pop + velocity, self.lb, self.ub)
+        velocity = jnp.clip(velocity, self.lb, self.ub)
+        return state.replace(
+            pop=pop,
+            velocity=velocity,
+            personal_best_location=pbest_loc,
+            personal_best_fit=pbest_fit,
+            local_best_location=local_best_location,
+            local_best_fit=local_best_fit,
+        )
+
+    # -- phase 2: global convergence ----------------------------------------
+    def _strategy_2(self, state: State, rand_key: jax.Array) -> State:
+        compare = state.personal_best_fit > state.fit
+        pbest_loc = jnp.where(compare[:, None], state.pop, state.personal_best_location)
+        pbest_fit = jnp.where(compare, state.fit, state.personal_best_fit)
+        gbest_idx = jnp.argmin(pbest_fit)
+        gbest_loc = pbest_loc[gbest_idx]
+        gbest_fit = pbest_fit[gbest_idx]
+        rand_pbest, rand_gbest = jax.random.uniform(
+            rand_key, (2, self.pop_size, self.dim), dtype=self.dtype
+        )
+        velocity = (
+            state.w * state.velocity
+            + state.c_pbest * rand_pbest * (pbest_loc - state.pop)
+            + state.c_gbest * rand_gbest * (gbest_loc - state.pop)
+        )
+        pop = jnp.clip(state.pop + velocity, self.lb, self.ub)
+        velocity = jnp.clip(velocity, self.lb, self.ub)
+        return state.replace(
+            pop=pop,
+            velocity=velocity,
+            personal_best_location=pbest_loc,
+            personal_best_fit=pbest_fit,
+            global_best_location=gbest_loc,
+            global_best_fit=gbest_fit,
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, regroup_key, rand_key = jax.random.split(state.key, 3)
+        state = state.replace(key=key)
+
+        def phase1(s):
+            s = jax.lax.cond(
+                s.iteration % s.regrouped_iteration_num == 0,
+                lambda st: self._regroup(regroup_key, st),
+                lambda st: st,
+                s,
+            )
+            return self._strategy_1(s, rand_key)
+
+        def phase2(s):
+            return self._strategy_2(s, rand_key)
+
+        state = jax.lax.cond(
+            state.iteration < (0.9 * state.max_iteration).astype(jnp.int32),
+            phase1,
+            phase2,
+            state,
+        )
+        fit = evaluate(state.pop)
+        return state.replace(fit=fit, iteration=state.iteration + 1)

@@ -1,0 +1,155 @@
+"""Feature-Selection PSO.
+
+TPU-native counterpart of the reference FSPSO
+(``src/evox/algorithms/so/pso_variants/fs_pso.py:9-144``): each generation
+keeps the elite half (standard PSO update) and regenerates the other half by
+tournament-selected mutation of elites.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core import Algorithm, EvalFn, Parameter, State
+from .utils import min_by
+
+__all__ = ["FSPSO"]
+
+
+class FSPSO(Algorithm):
+    """Feature-selection PSO with elite enhancement + mutation extension."""
+
+    def __init__(
+        self,
+        pop_size: int,
+        lb: jax.Array,
+        ub: jax.Array,
+        inertia_weight: float = 0.6,
+        cognitive_coefficient: float = 2.5,
+        social_coefficient: float = 0.8,
+        mean: jax.Array | None = None,
+        stdev: jax.Array | None = None,
+        mutate_rate: float = 0.01,
+        dtype=jnp.float32,
+    ):
+        """
+        :param pop_size: population size (must be even: elite/offspring split).
+        :param lb: 1-D lower bounds. :param ub: 1-D upper bounds.
+        :param mutate_rate: per-gene mutation probability of the offspring half.
+        """
+        lb = jnp.asarray(lb, dtype=dtype)
+        ub = jnp.asarray(ub, dtype=dtype)
+        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        assert pop_size % 2 == 0, "FSPSO needs an even population"
+        self.pop_size = pop_size
+        self.dim = lb.shape[0]
+        self.lb = lb
+        self.ub = ub
+        self.w = inertia_weight
+        self.phi_p = cognitive_coefficient
+        self.phi_g = social_coefficient
+        self.mean = mean
+        self.stdev = stdev
+        self.mutate_rate = mutate_rate
+        self.dtype = dtype
+
+    def setup(self, key: jax.Array) -> State:
+        key, pop_key, v_key = jax.random.split(key, 3)
+        length = self.ub - self.lb
+        if self.mean is not None and self.stdev is not None:
+            pop = self.mean + self.stdev * jax.random.normal(
+                pop_key, (self.pop_size, self.dim), dtype=self.dtype
+            )
+            pop = jnp.clip(pop, self.lb, self.ub)
+            velocity = self.stdev * jax.random.normal(
+                v_key, (self.pop_size, self.dim), dtype=self.dtype
+            )
+        else:
+            pop = (
+                jax.random.uniform(pop_key, (self.pop_size, self.dim), dtype=self.dtype)
+                * length
+                + self.lb
+            )
+            velocity = (
+                jax.random.uniform(v_key, (self.pop_size, self.dim), dtype=self.dtype)
+                * 2
+                - 1
+            ) * length
+        return State(
+            key=key,
+            w=Parameter(self.w, dtype=self.dtype),
+            phi_p=Parameter(self.phi_p, dtype=self.dtype),
+            phi_g=Parameter(self.phi_g, dtype=self.dtype),
+            mutate_rate=Parameter(self.mutate_rate, dtype=self.dtype),
+            pop=pop,
+            fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
+            velocity=velocity,
+            local_best_location=pop,
+            local_best_fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
+            global_best_location=pop[0],
+            global_best_fit=jnp.asarray(jnp.inf, dtype=self.dtype),
+        )
+
+    def init_step(self, state: State, evaluate: EvalFn) -> State:
+        fit = evaluate(state.pop)
+        return state.replace(
+            fit=fit, local_best_fit=fit, global_best_fit=jnp.min(fit)
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, vel_key, t1_key, t2_key, off_key, mask_key = jax.random.split(state.key, 6)
+        half = self.pop_size // 2
+        # Elite enhancement: standard PSO update of the best half.
+        elite_index = jnp.argsort(state.fit)[:half]
+        elite_pop = state.pop[elite_index]
+        elite_velocity = state.velocity[elite_index]
+        elite_fit = state.fit[elite_index]
+        elite_lb_loc = state.local_best_location[elite_index]
+        elite_lb_fit = state.local_best_fit[elite_index]
+
+        compare = elite_lb_fit > elite_fit
+        local_best_location = jnp.where(compare[:, None], elite_pop, elite_lb_loc)
+        local_best_fit = jnp.where(compare, elite_fit, elite_lb_fit)
+        global_best_location, global_best_fit = min_by(
+            [state.global_best_location[None, :], elite_pop],
+            [state.global_best_fit[None], elite_fit],
+        )
+        rg, rp = jax.random.uniform(vel_key, (2, half, self.dim), dtype=self.dtype)
+        updated_velocity = (
+            state.w * elite_velocity
+            + state.phi_p * rp * (elite_lb_loc - elite_pop)
+            + state.phi_g * rg * (global_best_location - elite_pop)
+        )
+        updated_pop = jnp.clip(elite_pop + updated_velocity, self.lb, self.ub)
+        updated_velocity = jnp.clip(updated_velocity, self.lb, self.ub)
+
+        # Extension: mutated tournament winners refill the other half.
+        t1 = jax.random.randint(t1_key, (half,), 0, half)
+        t2 = jax.random.randint(t2_key, (half,), 0, half)
+        mutating_pool = jnp.where(elite_fit[t1] < elite_fit[t2], t1, t2)
+        original = elite_pop[mutating_pool]
+        offspring_velocity = elite_velocity[mutating_pool]
+        offset = (
+            2 * jax.random.uniform(off_key, (half, self.dim), dtype=self.dtype) - 1
+        ) * (self.ub - self.lb)
+        mask = (
+            jax.random.uniform(mask_key, (half, self.dim), dtype=self.dtype)
+            < state.mutate_rate
+        )
+        offspring = jnp.clip(original + jnp.where(mask, offset, 0), self.lb, self.ub)
+
+        pop = jnp.concatenate([updated_pop, offspring])
+        fit = evaluate(pop)
+        return state.replace(
+            key=key,
+            pop=pop,
+            fit=fit,
+            velocity=jnp.concatenate([updated_velocity, offspring_velocity]),
+            local_best_location=jnp.concatenate([local_best_location, offspring]),
+            local_best_fit=jnp.concatenate(
+                [local_best_fit, jnp.full((half,), jnp.inf, dtype=self.dtype)]
+            ),
+            global_best_location=global_best_location,
+            global_best_fit=global_best_fit,
+        )

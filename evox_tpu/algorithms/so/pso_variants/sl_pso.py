@@ -1,0 +1,139 @@
+"""Social-Learning PSO: Gaussian- and uniform-sampled demonstrator choice.
+
+TPU-native counterparts of the reference SLPSOGS / SLPSOUS
+(``src/evox/algorithms/so/pso_variants/sl_pso_gs.py:9-108`` and
+``sl_pso_us.py:9-112``): each particle imitates a demonstrator drawn from the
+better-ranked part of the swarm — by a folded-Gaussian index distribution
+(GS) or a uniform range whose lower end rises with the particle's own rank
+(US) — plus attraction to the swarm mean.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core import Algorithm, EvalFn, Parameter, State
+from .utils import min_by
+
+__all__ = ["SLPSOGS", "SLPSOUS"]
+
+
+class _SLPSOBase(Algorithm):
+    def __init__(
+        self,
+        pop_size: int,
+        lb: jax.Array,
+        ub: jax.Array,
+        social_influence_factor: float = 0.2,
+        demonstrator_choice_factor: float = 0.7,
+        dtype=jnp.float32,
+    ):
+        """
+        :param pop_size: population size.
+        :param lb: 1-D lower bounds. :param ub: 1-D upper bounds.
+        :param social_influence_factor: ``epsilon``, pull toward the mean.
+        :param demonstrator_choice_factor: ``theta``, demonstrator spread.
+        """
+        lb = jnp.asarray(lb, dtype=dtype)
+        ub = jnp.asarray(ub, dtype=dtype)
+        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        self.pop_size = pop_size
+        self.dim = lb.shape[0]
+        self.lb = lb
+        self.ub = ub
+        self.epsilon = social_influence_factor
+        self.theta = demonstrator_choice_factor
+        self.dtype = dtype
+
+    def setup(self, key: jax.Array) -> State:
+        key, pop_key, v_key = jax.random.split(key, 3)
+        length = self.ub - self.lb
+        pop = (
+            jax.random.uniform(pop_key, (self.pop_size, self.dim), dtype=self.dtype)
+            * length
+            + self.lb
+        )
+        velocity = (
+            jax.random.uniform(v_key, (self.pop_size, self.dim), dtype=self.dtype) * 2
+            - 1
+        ) * length
+        return State(
+            key=key,
+            social_influence_factor=Parameter(self.epsilon, dtype=self.dtype),
+            demonstrator_choice_factor=Parameter(self.theta, dtype=self.dtype),
+            pop=pop,
+            fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
+            velocity=velocity,
+            global_best_location=pop[0],
+            global_best_fit=jnp.asarray(jnp.inf, dtype=self.dtype),
+        )
+
+    def init_step(self, state: State, evaluate: EvalFn) -> State:
+        fit = evaluate(state.pop)
+        return state.replace(fit=fit, global_best_fit=jnp.min(fit))
+
+    def _demonstrator_index(self, key: jax.Array, state: State) -> jax.Array:
+        raise NotImplementedError
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, demo_key, r_key = jax.random.split(state.key, 3)
+        global_best_location, global_best_fit = min_by(
+            [state.global_best_location[None, :], state.pop],
+            [state.global_best_fit[None], state.fit],
+        )
+        # Worst-to-best ranking; demonstrators are drawn near the best end.
+        ranked_population = state.pop[jnp.argsort(-state.fit)]
+        index_k = self._demonstrator_index(demo_key, state)
+        x_k = ranked_population[index_k]
+        x_avg = jnp.mean(state.pop, axis=0)
+        r1, r2, r3 = jax.random.uniform(
+            r_key, (3, self.pop_size, self.dim), dtype=self.dtype
+        )
+        velocity = (
+            r1 * state.velocity
+            + r2 * (x_k - state.pop)
+            + r3 * state.social_influence_factor * (x_avg - state.pop)
+        )
+        pop = jnp.clip(state.pop + velocity, self.lb, self.ub)
+        velocity = jnp.clip(velocity, self.lb, self.ub)
+        fit = evaluate(pop)
+        return state.replace(
+            key=key,
+            pop=pop,
+            fit=fit,
+            velocity=velocity,
+            global_best_location=global_best_location,
+            global_best_fit=global_best_fit,
+        )
+
+
+class SLPSOGS(_SLPSOBase):
+    """Social-learning PSO with Gaussian-sampled demonstrator choice."""
+
+    def _demonstrator_index(self, key: jax.Array, state: State) -> jax.Array:
+        n = self.pop_size
+        sigma = state.demonstrator_choice_factor * (
+            n - (jnp.arange(n, dtype=self.dtype) + 1)
+        )
+        std_normal = jax.random.normal(key, (n,), dtype=self.dtype)
+        normal = sigma * (-jnp.abs(std_normal)) + n
+        return jnp.clip(normal, 1, n).astype(jnp.int32) - 1
+
+
+class SLPSOUS(_SLPSOBase):
+    """Social-learning PSO with uniform-sampled demonstrator choice."""
+
+    def _demonstrator_index(self, key: jax.Array, state: State) -> jax.Array:
+        n = self.pop_size
+        q = jnp.clip(
+            n
+            - jnp.ceil(
+                state.demonstrator_choice_factor
+                * (n - (jnp.arange(n, dtype=self.dtype) + 1) - 1)
+            ),
+            1,
+            n,
+        )
+        uniform = jax.random.uniform(key, (n,), dtype=self.dtype) * (n + 1 - q) + q
+        return jnp.clip(jnp.floor(uniform).astype(jnp.int32) - 1, 0, n - 1)

@@ -12,9 +12,6 @@ def igd(objs: jax.Array, pf: jax.Array, p: int = 1) -> jax.Array:
     """IGD between a solution set ``objs`` (n, m) and the true Pareto front
     ``pf`` (k, m): mean L^p-aggregated distance from each front point to its
     nearest solution.  Lower is better.
-
-    The (k, n) distance matrix is one MXU-friendly
-    ``|a|² + |b|² - 2 a·bᵀ`` expansion via ``jnp.linalg`` broadcasting.
     """
     dist = jnp.linalg.norm(pf[:, None, :] - objs[None, :, :], axis=-1)
     min_dis = jnp.min(dist, axis=1)

@@ -83,7 +83,19 @@ class DTLZ(Problem):
         self.m = m
         self.ref_num = ref_num
         self.dtype = dtype
-        self.sample = uniform_sampling(ref_num * m, m)[0].astype(dtype)
+        self._sample = None
+
+    @property
+    def sample(self) -> jax.Array:
+        # Lazy: the host-side Das-Dennis enumeration only runs if pf() is
+        # actually requested (and not at all for subclasses that override
+        # _make_sample with a different lattice).
+        if self._sample is None:
+            self._sample = self._make_sample()
+        return self._sample
+
+    def _make_sample(self) -> jax.Array:
+        return uniform_sampling(self.ref_num * self.m, self.m)[0].astype(self.dtype)
 
     @property
     def lb(self) -> jax.Array:
@@ -192,7 +204,9 @@ class DTLZ7(DTLZ):
 
     def __init__(self, d: int = 21, m: int = 3, ref_num: int = 1000, dtype=jnp.float32):
         super().__init__(d, m, ref_num, dtype)
-        self.sample = grid_sampling(ref_num * m, m - 1)[0].astype(dtype)
+
+    def _make_sample(self) -> jax.Array:
+        return grid_sampling(self.ref_num * self.m, self.m - 1)[0].astype(self.dtype)
 
     def _eval(self, x: jax.Array) -> jax.Array:
         m = self.m

@@ -3,7 +3,7 @@
 import jax.numpy as jnp
 import pytest
 
-from evox_tpu.algorithms import PSO
+from evox_tpu.algorithms import CLPSO, CSO, DMSPSOEL, FSPSO, PSO, SLPSOGS, SLPSOUS
 
 from test_base_algorithms import check_improvement, contract_test
 
@@ -12,10 +12,34 @@ POP = 20
 LB = -10.0 * jnp.ones(DIM)
 UB = 10.0 * jnp.ones(DIM)
 
+FACTORIES = {
+    "pso": lambda: PSO(POP, LB, UB),
+    "clpso": lambda: CLPSO(POP, LB, UB),
+    "cso": lambda: CSO(POP, LB, UB),
+    "fspso": lambda: FSPSO(POP, LB, UB),
+    "slpsogs": lambda: SLPSOGS(POP, LB, UB),
+    "slpsous": lambda: SLPSOUS(POP, LB, UB),
+    "dmspsoel": lambda: DMSPSOEL(
+        LB,
+        UB,
+        dynamic_sub_swarm_size=5,
+        dynamic_sub_swarms_num=3,
+        following_sub_swarm_size=5,
+        regrouped_iteration_num=3,
+        max_iteration=20,
+    ),
+}
 
-def test_pso_contract():
-    contract_test(lambda: PSO(POP, LB, UB))
+
+@pytest.mark.parametrize("name", FACTORIES)
+def test_pso_contract(name):
+    contract_test(FACTORIES[name])
 
 
-def test_pso_converges():
+@pytest.mark.parametrize("name", ["pso", "clpso", "cso", "slpsogs", "dmspsoel"])
+def test_pso_converges(name):
+    check_improvement(FACTORIES[name](), steps=30)
+
+
+def test_pso_converges_large():
     check_improvement(PSO(50, LB, UB), steps=50)
