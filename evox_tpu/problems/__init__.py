@@ -1,3 +1,3 @@
 """Problem library (reference: ``src/evox/problems/__init__.py``)."""
 
-from . import numerical
+from . import neuroevolution, numerical

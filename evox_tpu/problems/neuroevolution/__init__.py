@@ -1,0 +1,26 @@
+"""Neuroevolution problems (reference:
+``src/evox/problems/neuroevolution/``).
+
+``BraxProblem`` / ``MujocoProblem`` require their optional physics packages
+and raise a clear ImportError at construction when absent; everything else
+is dependency-free JAX.
+"""
+
+__all__ = [
+    "BraxProblem",
+    "Env",
+    "MLPPolicy",
+    "MujocoProblem",
+    "RolloutProblem",
+    "SupervisedLearningProblem",
+    "cartpole",
+    "pendulum",
+    "stack_model_params",
+]
+
+from .brax import BraxProblem
+from .envs import Env, cartpole, pendulum
+from .mujoco_playground import MujocoProblem
+from .rollout import RolloutProblem
+from .supervised_learning import SupervisedLearningProblem
+from .utils import MLPPolicy, stack_model_params

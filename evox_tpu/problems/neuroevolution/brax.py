@@ -1,0 +1,115 @@
+"""Brax RL problem: evaluate a population of policies in Brax physics.
+
+TPU-native counterpart of the reference BraxProblem
+(``src/evox/problems/neuroevolution/brax.py:203-405``).  The reference keeps
+the policy in torch and bridges to the JAX-side env via DLPack twice per
+step inside a host ``while`` loop, wrapping everything in a
+``torch.library.custom_op`` so it survives compile and HPO-vmap; here the
+policy is JAX, so the whole thing is a :class:`RolloutProblem` whose
+``lax.scan`` runs policy and physics in one fused program on TPU — and it
+supports HPO-vmap out of the box (the reference cannot; its warning at
+``brax.py:259-263``).
+
+Requires the optional ``brax`` package.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ...core import State
+from .envs import Env
+from .rollout import RolloutProblem
+
+__all__ = ["BraxProblem"]
+
+try:
+    from brax import envs as brax_envs
+
+    _HAS_BRAX = True
+except ImportError:  # pragma: no cover - optional dependency
+    brax_envs = None
+    _HAS_BRAX = False
+
+
+class BraxProblem(RolloutProblem):
+    """Population policy evaluation in a Brax environment."""
+
+    def __init__(
+        self,
+        policy: Callable[[Any, jax.Array], jax.Array],
+        env_name: str,
+        max_episode_length: int,
+        num_episodes: int = 1,
+        rotate_key: bool = True,
+        reduce_fn: Callable[[jax.Array], jax.Array] = jnp.mean,
+        backend: str | None = None,
+        maximize_reward: bool = True,
+    ):
+        """
+        :param policy: pure ``(params, obs) -> action``.
+        :param env_name: Brax environment name (``brax.envs`` registry).
+        :param max_episode_length: maximum time steps per episode.
+        :param num_episodes: episodes per individual (shared keys across the
+            population, like the reference).
+        :param rotate_key: fresh evaluation keys each generation.
+        :param reduce_fn: per-individual episode-return reduction.
+        :param backend: Brax physics backend (``generalized``/``spring``/...).
+        """
+        if not _HAS_BRAX:
+            raise ImportError(
+                "BraxProblem requires the optional `brax` package "
+                "(pip install brax)."
+            )
+        env = (
+            brax_envs.get_environment(env_name=env_name)
+            if backend is None
+            else brax_envs.get_environment(env_name=env_name, backend=backend)
+        )
+        self._brax_env = env
+
+        def reset(key):
+            s = env.reset(key)
+            return s, s.obs
+
+        def step(s, action):
+            s = env.step(s, action)
+            return s, s.obs, s.reward, s.done.astype(bool)
+
+        super().__init__(
+            policy=policy,
+            env=Env(reset, step, env.observation_size, env.action_size),
+            max_episode_length=max_episode_length,
+            num_episodes=num_episodes,
+            rotate_key=rotate_key,
+            reduce_fn=reduce_fn,
+            maximize_reward=maximize_reward,
+        )
+
+    def visualize(
+        self,
+        state: State,
+        params: Any,
+        output_type: str = "HTML",
+    ):
+        """Render one episode of a single policy (reference
+        ``brax.py:367-405``)."""
+        assert output_type in ("HTML", "rgb_array")
+        env_state, obs = self.env.reset(state.key)
+        trajectory = [env_state.pipeline_state]
+        for _ in range(self.max_episode_length):
+            action = self.policy(params, obs)
+            env_state, obs, _, done = self.env.step(env_state, action)
+            trajectory.append(env_state.pipeline_state)
+            if bool(done):
+                break
+        if output_type == "HTML":
+            from brax.io import html
+
+            return html.render(self._brax_env.sys, trajectory)
+        from brax.io import image
+
+        return image.render_array(self._brax_env.sys, trajectory)

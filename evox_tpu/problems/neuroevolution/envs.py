@@ -1,0 +1,98 @@
+"""Built-in pure-JAX control environments for neuroevolution.
+
+The reference delegates physics to external Brax/MJX packages
+(``src/evox/problems/neuroevolution/brax.py``); this module provides small
+classic-control environments written directly in jnp so the rollout
+machinery (`RolloutProblem`) is exercisable — and testable — with zero
+external dependencies.  Each factory returns an :class:`Env` of pure
+functions, so episodes run entirely inside ``lax.scan`` on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Env", "pendulum", "cartpole"]
+
+
+class Env(NamedTuple):
+    """A JAX environment: pure ``reset``/``step`` plus static sizes.
+
+    * ``reset(key) -> (env_state, obs)``
+    * ``step(env_state, action) -> (env_state, obs, reward, done)``
+    """
+
+    reset: Callable[[jax.Array], tuple[Any, jax.Array]]
+    step: Callable[[Any, jax.Array], tuple[Any, jax.Array, jax.Array, jax.Array]]
+    obs_size: int
+    action_size: int
+
+
+def pendulum(max_torque: float = 2.0, dt: float = 0.05) -> Env:
+    """Torque-controlled pendulum swing-up (reward = -(θ² + 0.1·θ̇² +
+    0.001·u²)); observation = (cos θ, sin θ, θ̇)."""
+
+    g, m, length = 10.0, 1.0, 1.0
+
+    def _obs(state):
+        th, thdot = state
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot])
+
+    def reset(key):
+        th_key, thdot_key = jax.random.split(key)
+        th = jax.random.uniform(th_key, (), minval=-jnp.pi, maxval=jnp.pi)
+        thdot = jax.random.uniform(thdot_key, (), minval=-1.0, maxval=1.0)
+        state = (th, thdot)
+        return state, _obs(state)
+
+    def step(state, action):
+        th, thdot = state
+        u = jnp.clip(action.reshape(()), -max_torque, max_torque)
+        norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        thdot = thdot + (3 * g / (2 * length) * jnp.sin(th) + 3.0 / (m * length**2) * u) * dt
+        thdot = jnp.clip(thdot, -8.0, 8.0)
+        th = th + thdot * dt
+        state = (th, thdot)
+        return state, _obs(state), -cost, jnp.asarray(False)
+
+    return Env(reset, step, obs_size=3, action_size=1)
+
+
+def cartpole(dt: float = 0.02) -> Env:
+    """Cart-pole balancing with a continuous force in [-10, 10]; reward 1 per
+    step alive; done when |x| > 2.4 or |θ| > 12°."""
+
+    gravity, m_cart, m_pole, length = 9.8, 1.0, 0.1, 0.5
+    total_mass = m_cart + m_pole
+    polemass_length = m_pole * length
+
+    def _obs(state):
+        return jnp.stack(state)
+
+    def reset(key):
+        vals = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        state = (vals[0], vals[1], vals[2], vals[3])
+        return state, _obs(state)
+
+    def step(state, action):
+        x, x_dot, th, th_dot = state
+        force = jnp.clip(action.reshape(()), -1.0, 1.0) * 10.0
+        cos_th, sin_th = jnp.cos(th), jnp.sin(th)
+        temp = (force + polemass_length * th_dot**2 * sin_th) / total_mass
+        th_acc = (gravity * sin_th - cos_th * temp) / (
+            length * (4.0 / 3.0 - m_pole * cos_th**2 / total_mass)
+        )
+        x_acc = temp - polemass_length * th_acc * cos_th / total_mass
+        x = x + dt * x_dot
+        x_dot = x_dot + dt * x_acc
+        th = th + dt * th_dot
+        th_dot = th_dot + dt * th_acc
+        state = (x, x_dot, th, th_dot)
+        done = (jnp.abs(x) > 2.4) | (jnp.abs(th) > 12 * jnp.pi / 180)
+        return state, _obs(state), jnp.asarray(1.0), done
+
+    return Env(reset, step, obs_size=4, action_size=1)

@@ -1,0 +1,84 @@
+"""Mujoco-Playground (MJX) RL problem.
+
+TPU-native counterpart of the reference MujocoProblem
+(``src/evox/problems/neuroevolution/mujoco_playground.py:216-434``) — same
+architecture as :class:`BraxProblem`: the MJX env's reset/step become a
+pure-JAX :class:`RolloutProblem`, with the observation pytree reduced to its
+``"state"`` entry exactly as the reference does
+(``mujoco_playground.py`` obs handling).
+
+Requires the optional ``mujoco_playground`` package.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .envs import Env
+from .rollout import RolloutProblem
+
+__all__ = ["MujocoProblem"]
+
+try:
+    from mujoco_playground import registry as _mjx_registry
+
+    _HAS_MJX = True
+except ImportError:  # pragma: no cover - optional dependency
+    _mjx_registry = None
+    _HAS_MJX = False
+
+
+class MujocoProblem(RolloutProblem):
+    """Population policy evaluation in a Mujoco-Playground (MJX) env."""
+
+    def __init__(
+        self,
+        policy: Callable[[Any, jax.Array], jax.Array],
+        env_name: str,
+        max_episode_length: int,
+        num_episodes: int = 1,
+        rotate_key: bool = True,
+        reduce_fn: Callable[[jax.Array], jax.Array] = jnp.mean,
+        maximize_reward: bool = True,
+    ):
+        """
+        :param policy: pure ``(params, obs) -> action``.
+        :param env_name: Mujoco-Playground registry name.
+        :param max_episode_length: maximum time steps per episode.
+        :param num_episodes: episodes per individual.
+        """
+        if not _HAS_MJX:
+            raise ImportError(
+                "MujocoProblem requires the optional `mujoco_playground` "
+                "package (pip install playground)."
+            )
+        env = _mjx_registry.load(env_name)
+
+        def _obs_of(raw):
+            # Observations may be a pytree; the policy consumes obs["state"]
+            # (reference parity).
+            return raw["state"] if isinstance(raw, dict) else raw
+
+        def reset(key):
+            s = env.reset(key)
+            return s, _obs_of(s.obs)
+
+        def step(s, action):
+            s = env.step(s, action)
+            return s, _obs_of(s.obs), s.reward, s.done.astype(bool)
+
+        obs_size = env.observation_size
+        if isinstance(obs_size, dict):
+            obs_size = obs_size["state"]
+        super().__init__(
+            policy=policy,
+            env=Env(reset, step, obs_size, env.action_size),
+            max_episode_length=max_episode_length,
+            num_episodes=num_episodes,
+            rotate_key=rotate_key,
+            reduce_fn=reduce_fn,
+            maximize_reward=maximize_reward,
+        )

@@ -1,0 +1,99 @@
+"""Supervised-learning fitness: loss of a population of model weights.
+
+TPU-native counterpart of the reference SupervisedLearningProblem
+(``src/evox/problems/neuroevolution/supervised_learning.py:15-165``).  The
+reference streams batches from a torch ``DataLoader`` through a host-side
+iterator (an un-jittable side effect it must hide behind custom ops); here
+the dataset lives on device as arrays and the batch cursor is part of the
+problem *state*, so evaluation — vmapped model forward over the stacked
+population included — is one pure jitted function, HPO-vmappable for free
+(the reference explicitly cannot support that; its warning at
+``supervised_learning.py:38-40``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ...core import Problem, State
+
+__all__ = ["SupervisedLearningProblem"]
+
+
+class SupervisedLearningProblem(Problem):
+    """Fitness = criterion(model(inputs), labels) for each candidate weight
+    set, over ``n_batch_per_eval`` successive minibatches."""
+
+    def __init__(
+        self,
+        apply_fn: Callable[[Any, jax.Array], jax.Array],
+        inputs: jax.Array,
+        labels: jax.Array,
+        criterion: Callable[[jax.Array, jax.Array], jax.Array],
+        batch_size: int | None = None,
+        n_batch_per_eval: int = 1,
+        reduction: str = "mean",
+    ):
+        """
+        :param apply_fn: pure model forward ``(params, batched_inputs) ->
+            predictions`` (e.g. ``flax_module.apply`` or a pytree-MLP fn).
+        :param inputs: full input array, leading axis = examples.
+        :param labels: full label array, aligned with ``inputs``.
+        :param criterion: per-example loss ``(pred, label) -> (batch,)`` or a
+            scalar-reducing loss; non-scalar outputs are reduced here per
+            ``reduction``.
+        :param batch_size: minibatch size; ``None`` uses the whole dataset.
+        :param n_batch_per_eval: batches consumed per evaluation; ``-1``
+            sweeps the full dataset every evaluation.
+        :param reduction: ``"mean"`` or ``"sum"`` over examples.
+        """
+        assert reduction in ("mean", "sum")
+        n = inputs.shape[0]
+        if batch_size is None:
+            batch_size = n
+        self.apply_fn = apply_fn
+        self.inputs = jnp.asarray(inputs)
+        self.labels = jnp.asarray(labels)
+        self.batch_size = batch_size
+        self.num_batches = max(n // batch_size, 1)
+        if n_batch_per_eval == -1:
+            n_batch_per_eval = self.num_batches
+        self.n_batch_per_eval = n_batch_per_eval
+        self.reduction = reduction
+        self.criterion = criterion
+
+    def setup(self, key: jax.Array) -> State:
+        del key
+        return State(batch_cursor=jnp.zeros((), dtype=jnp.int32))
+
+    def _batch(self, batch_idx: jax.Array) -> tuple[jax.Array, jax.Array]:
+        start = (batch_idx % self.num_batches) * self.batch_size
+        x = jax.lax.dynamic_slice_in_dim(self.inputs, start, self.batch_size)
+        y = jax.lax.dynamic_slice_in_dim(self.labels, start, self.batch_size)
+        return x, y
+
+    def evaluate(self, state: State, pop_params: Any) -> tuple[jax.Array, State]:
+        def one_model_loss(params):
+            def batch_loss(i):
+                x, y = self._batch(state.batch_cursor + i)
+                loss = self.criterion_value(self.apply_fn(params, x), y)
+                return loss
+
+            losses = jax.vmap(batch_loss)(jnp.arange(self.n_batch_per_eval))
+            return jnp.mean(losses) if self.reduction == "mean" else jnp.sum(losses)
+
+        fitness = jax.vmap(one_model_loss)(pop_params)
+        new_state = state.replace(
+            batch_cursor=(state.batch_cursor + self.n_batch_per_eval)
+            % self.num_batches
+        )
+        return fitness, new_state
+
+    def criterion_value(self, pred: jax.Array, label: jax.Array) -> jax.Array:
+        out = self.criterion(pred, label)
+        if out.ndim > 0:
+            out = jnp.mean(out) if self.reduction == "mean" else jnp.sum(out)
+        return out

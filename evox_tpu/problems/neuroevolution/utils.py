@@ -1,0 +1,73 @@
+"""Neuroevolution helpers: tiny pytree MLP + population stacking.
+
+Counterpart of the reference's ``get_vmap_model_state_forward``
+(``src/evox/problems/neuroevolution/utils.py:21-43``), which stacks a torch
+module's state dicts and vmaps a functionalized forward.  In JAX a "model"
+is already (params pytree, pure apply), so stacking a population is one
+``vmap`` of the initializer — no functionalization machinery.
+
+``MLPPolicy`` is a dependency-free network for tests, examples and policy
+search; for anything fancier use flax/haiku modules, whose ``apply``
+functions plug into the same Problem APIs directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MLPPolicy", "stack_model_params"]
+
+
+class MLPPolicy:
+    """A minimal tanh MLP: ``init(key) -> params``, ``apply(params, x) ->
+    out``.  Output activation ``tanh`` keeps actions bounded in [-1, 1]."""
+
+    def __init__(
+        self,
+        layer_sizes: Sequence[int],
+        output_activation: Callable | None = jnp.tanh,
+        dtype=jnp.float32,
+    ):
+        assert len(layer_sizes) >= 2
+        self.layer_sizes = tuple(layer_sizes)
+        self.output_activation = output_activation
+        self.dtype = dtype
+
+    def init(self, key: jax.Array) -> dict:
+        params = {}
+        for i, (fan_in, fan_out) in enumerate(
+            zip(self.layer_sizes[:-1], self.layer_sizes[1:])
+        ):
+            key, w_key = jax.random.split(key)
+            scale = jnp.sqrt(2.0 / fan_in).astype(self.dtype)
+            params[f"w{i}"] = (
+                jax.random.normal(w_key, (fan_in, fan_out), dtype=self.dtype) * scale
+            )
+            params[f"b{i}"] = jnp.zeros((fan_out,), dtype=self.dtype)
+        return params
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        n_layers = len(self.layer_sizes) - 1
+        h = x.astype(self.dtype)
+        for i in range(n_layers):
+            h = h @ params[f"w{i}"] + params[f"b{i}"]
+            if i < n_layers - 1:
+                h = jnp.tanh(h)
+        if self.output_activation is not None:
+            h = self.output_activation(h)
+        return h
+
+    def __call__(self, params: dict, x: jax.Array) -> jax.Array:
+        return self.apply(params, x)
+
+
+def stack_model_params(
+    init_fn: Callable[[jax.Array], Any], key: jax.Array, pop_size: int
+) -> Any:
+    """Initialize a population of model parameters: a stacked pytree with a
+    leading ``pop_size`` axis (the JAX analogue of the reference's
+    ``torch.func.stack_module_state``)."""
+    return jax.vmap(init_fn)(jax.random.split(key, pop_size))

@@ -1,0 +1,167 @@
+"""Neuroevolution tests (reference pattern: ``unit_test/problems/test_brax.py``
+and ``test_supervised_learning.py``) — run on the built-in pure-JAX envs so
+no optional physics package is needed.  Includes a real policy-search run:
+OpenES must actually learn pendulum swing-up beyond the initial random
+population.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu.algorithms import OpenES
+from evox_tpu.problems.neuroevolution import (
+    MLPPolicy,
+    RolloutProblem,
+    SupervisedLearningProblem,
+    cartpole,
+    pendulum,
+    stack_model_params,
+)
+from evox_tpu.utils import ParamsAndVector
+from evox_tpu.workflows import StdWorkflow
+
+
+def test_rollout_shapes(key):
+    env = pendulum()
+    policy = MLPPolicy([env.obs_size, 8, env.action_size])
+    prob = RolloutProblem(policy, env, max_episode_length=20, num_episodes=2)
+    pop = stack_model_params(policy.init, key, 5)
+    fit, new_state = prob.evaluate(prob.setup(key), pop)
+    assert fit.shape == (5,)
+    assert jnp.all(jnp.isfinite(fit))
+    # rotate_key advances the problem key.
+    assert not jnp.array_equal(new_state.key, key)
+
+
+def test_rollout_deterministic_without_rotate(key):
+    env = pendulum()
+    policy = MLPPolicy([env.obs_size, 8, env.action_size])
+    prob = RolloutProblem(
+        policy, env, max_episode_length=20, num_episodes=2, rotate_key=False
+    )
+    pop = stack_model_params(policy.init, key, 3)
+    state = prob.setup(key)
+    fit1, state = prob.evaluate(state, pop)
+    fit2, _ = prob.evaluate(state, pop)
+    assert jnp.array_equal(fit1, fit2)
+
+
+def test_rollout_done_stops_reward(key):
+    # Cartpole terminates; episode return must be <= max_episode_length.
+    env = cartpole()
+    policy = MLPPolicy([env.obs_size, 8, env.action_size])
+    prob = RolloutProblem(policy, env, max_episode_length=100)
+    pop = stack_model_params(policy.init, key, 4)
+    fit, _ = prob.evaluate(prob.setup(key), pop)
+    returns = -fit  # maximize_reward negates
+    assert jnp.all(returns >= 0) and jnp.all(returns <= 100)
+
+
+def test_policy_search_learns_pendulum():
+    env = pendulum()
+    policy = MLPPolicy([env.obs_size, 16, env.action_size])
+    base_params = policy.init(jax.random.key(0))
+    adapter = ParamsAndVector(base_params)
+    algo = OpenES(
+        pop_size=64,
+        center_init=adapter.to_vector(base_params),
+        learning_rate=0.05,
+        noise_stdev=0.1,
+        optimizer="adam",
+    )
+    prob = RolloutProblem(
+        policy, env, max_episode_length=200, num_episodes=2, rotate_key=False
+    )
+
+    def center_return(state):
+        params = adapter.to_params(state.algorithm.center)
+        fit, _ = prob.evaluate(
+            prob.setup(jax.random.key(9)), jax.tree.map(lambda x: x[None], params)
+        )
+        return -float(fit[0])
+
+    # Raw episode returns are ~1e3; standardize per generation so the ES
+    # gradient scale is policy-independent (the usual OpenES recipe).
+    wf = StdWorkflow(
+        algo,
+        prob,
+        solution_transform=adapter,
+        fitness_transform=lambda f: (f - jnp.mean(f)) / (jnp.std(f) + 1e-8),
+    )
+    state = wf.init(jax.random.key(1))
+    state = jax.jit(wf.init_step)(state)
+    first = center_return(state)
+    step = jax.jit(wf.step)
+    for _ in range(100):
+        state = step(state)
+    final = center_return(state)
+    assert final > first + 200, (first, final)
+
+
+def test_supervised_learning_problem(key):
+    # Population loss on a linear regression task: the true weights member
+    # must get (near-)zero loss and rank first.
+    w_true = jnp.asarray([[2.0], [-1.0]])
+    x = jax.random.normal(key, (64, 2))
+    y = x @ w_true
+
+    def apply_fn(params, inputs):
+        return inputs @ params["w"]
+
+    prob = SupervisedLearningProblem(
+        apply_fn,
+        x,
+        y,
+        criterion=lambda pred, label: jnp.mean((pred - label) ** 2),
+        batch_size=16,
+        n_batch_per_eval=2,
+    )
+    pop = {
+        "w": jnp.stack([w_true, jnp.zeros((2, 1)), jnp.ones((2, 1))])
+    }
+    state = prob.setup(key)
+    fit, state = prob.evaluate(state, pop)
+    assert fit.shape == (3,)
+    # Tolerance must hold at the TPU backend's default (bf16-class)
+    # matmul precision, not just CPU f32.
+    assert fit[0] < 1e-4
+    assert jnp.argmin(fit) == 0
+    # Cursor advances and wraps.
+    assert state.batch_cursor == 2
+    fit2, state = prob.evaluate(state, pop)
+    assert state.batch_cursor == 0
+    assert fit2[0] < 1e-4
+
+
+def test_supervised_full_sweep(key):
+    x = jax.random.normal(key, (32, 4))
+    y = jnp.sum(x, axis=1, keepdims=True)
+
+    def apply_fn(params, inputs):
+        return inputs @ params["w"]
+
+    prob = SupervisedLearningProblem(
+        apply_fn,
+        x,
+        y,
+        criterion=lambda p, l: jnp.mean((p - l) ** 2),
+        batch_size=8,
+        n_batch_per_eval=-1,
+    )
+    pop = {"w": jnp.ones((2, 4, 1))}
+    fit, _ = jax.jit(prob.evaluate)(prob.setup(key), pop)
+    assert jnp.allclose(fit, 0.0, atol=1e-4)
+
+
+def test_optional_deps_raise_cleanly():
+    from evox_tpu.problems.neuroevolution import BraxProblem, MujocoProblem
+    from evox_tpu.problems.neuroevolution.brax import _HAS_BRAX
+    from evox_tpu.problems.neuroevolution.mujoco_playground import _HAS_MJX
+
+    if not _HAS_BRAX:
+        with pytest.raises(ImportError):
+            BraxProblem(lambda p, o: o, "ant", 10)
+    if not _HAS_MJX:
+        with pytest.raises(ImportError):
+            MujocoProblem(lambda p, o: o, "CartpoleBalance", 10)
