@@ -1,3 +1,13 @@
 """Problem library (reference: ``src/evox/problems/__init__.py``)."""
 
-from . import neuroevolution, numerical
+__all__ = [
+    "HPOFitnessMonitor",
+    "HPOMonitor",
+    "HPOProblemWrapper",
+    "hpo_wrapper",
+    "neuroevolution",
+    "numerical",
+]
+
+from . import hpo_wrapper, neuroevolution, numerical
+from .hpo_wrapper import HPOFitnessMonitor, HPOMonitor, HPOProblemWrapper

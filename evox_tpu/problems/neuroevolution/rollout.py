@@ -95,13 +95,16 @@ class RolloutProblem(Problem):
                 env_state, obs, total, done = carry
                 action = self.policy(params, obs)
                 env_state, obs, reward, step_done = self.env.step(env_state, action)
-                total = total + jnp.where(done, 0.0, reward)
+                # Accumulate in f32 regardless of env dtypes: bf16 returns
+                # stop growing past ~256, and integer rewards would clash
+                # with the float carry at trace time.
+                total = total + jnp.where(done, 0.0, reward.astype(jnp.float32))
                 done = done | step_done
                 return (env_state, obs, total, done), None
 
             (_, _, total, _), _ = jax.lax.scan(
                 step_fn,
-                (env_state, obs, jnp.asarray(0.0, obs.dtype), jnp.asarray(False)),
+                (env_state, obs, jnp.asarray(0.0, jnp.float32), jnp.asarray(False)),
                 None,
                 length=self.max_episode_length,
                 unroll=self.unroll,

@@ -54,6 +54,9 @@ class SupervisedLearningProblem(Problem):
         n = inputs.shape[0]
         if batch_size is None:
             batch_size = n
+        assert batch_size <= n, (
+            f"batch_size ({batch_size}) exceeds the dataset size ({n})"
+        )
         self.apply_fn = apply_fn
         self.inputs = jnp.asarray(inputs)
         self.labels = jnp.asarray(labels)

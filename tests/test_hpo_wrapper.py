@@ -1,0 +1,114 @@
+"""HPO wrapper tests (reference: ``unit_test/problems/test_hpo_wrapper.py``):
+inner workflow instances vmapped as an outer problem, single- and
+multi-objective inner monitors, repeats, and a meta-optimization run that
+must actually find better hyper-parameters.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from evox_tpu.algorithms import PSO
+from evox_tpu.core import Algorithm, EvalFn, Parameter, Problem, State
+from evox_tpu.metrics import igd
+from evox_tpu.problems.hpo_wrapper import HPOFitnessMonitor, HPOProblemWrapper
+from evox_tpu.problems.numerical import DTLZ1, Sphere
+from evox_tpu.workflows import StdWorkflow
+
+
+class BasicAlgorithm(Algorithm):
+    """Random search whose scale is the tunable hyper-parameter ``hp``
+    (reference ``test_hpo_wrapper.py:20-39``)."""
+
+    def __init__(self, pop_size: int, lb, ub):
+        self.pop_size = pop_size
+        self.lb = jnp.asarray(lb)
+        self.ub = jnp.asarray(ub)
+        self.dim = self.lb.shape[0]
+
+    def setup(self, key):
+        return State(
+            key=key,
+            hp=Parameter(jnp.asarray([1.0, 2.0])),
+            pop=jnp.zeros((self.pop_size, self.dim)),
+            fit=jnp.full((self.pop_size,), jnp.inf),
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        key, pop_key = jax.random.split(state.key)
+        pop = jax.random.uniform(pop_key, (self.pop_size, self.dim))
+        pop = pop * (self.ub - self.lb) + self.lb
+        pop = pop * state.hp[0]
+        fit = evaluate(pop)
+        return state.replace(key=key, pop=pop, fit=fit)
+
+
+def _make_hpo(prob, monitor, iterations=9, num_instances=7, num_repeats=1):
+    algo = BasicAlgorithm(10, -10 * jnp.ones(2), 10 * jnp.ones(2))
+    wf = StdWorkflow(algo, prob, monitor=monitor)
+    return HPOProblemWrapper(
+        iterations=iterations,
+        num_instances=num_instances,
+        workflow=wf,
+        num_repeats=num_repeats,
+    )
+
+
+def test_get_init_params(key):
+    hpo = _make_hpo(Sphere(), HPOFitnessMonitor())
+    state = hpo.setup(key)
+    params = hpo.get_init_params(state)
+    assert "algorithm.hp" in params
+    assert params["algorithm.hp"].shape == (7, 2)
+
+
+def test_evaluate(key):
+    hpo = _make_hpo(Sphere(), HPOFitnessMonitor())
+    state = hpo.setup(key)
+    params = hpo.get_init_params(state)
+    params["algorithm.hp"] = jax.random.uniform(key, (7, 2))
+    fit, _ = jax.jit(hpo.evaluate)(state, params)
+    assert fit.shape == (7,)
+    assert jnp.all(jnp.isfinite(fit))
+
+
+def test_evaluate_mo(key):
+    prob = DTLZ1(d=2, m=2)
+    monitor = HPOFitnessMonitor(multi_obj_metric=lambda f: igd(f, prob.pf()))
+    hpo = _make_hpo(prob, monitor)
+    state = hpo.setup(key)
+    params = hpo.get_init_params(state)
+    fit, _ = jax.jit(hpo.evaluate)(state, params)
+    assert fit.shape == (7,)
+    assert jnp.all(jnp.isfinite(fit))
+
+
+def test_evaluate_repeats(key):
+    hpo = _make_hpo(Sphere(), HPOFitnessMonitor(), num_repeats=3)
+    state = hpo.setup(key)
+    params = hpo.get_init_params(state)
+    assert params["algorithm.hp"].shape == (7, 2)
+    fit, _ = jax.jit(hpo.evaluate)(state, params)
+    assert fit.shape == (7,)
+    assert jnp.all(jnp.isfinite(fit))
+
+
+def test_outer_workflow(key):
+    # Full meta-optimization: PSO searches the inner algorithm's `hp`.
+    # Smaller |hp[0]| shrinks the random-search envelope around 0 and thus
+    # the attainable Sphere fitness — the outer optimizer must discover it.
+    hpo = _make_hpo(Sphere(), HPOFitnessMonitor(), iterations=6, num_instances=8)
+    outer_algo = PSO(8, lb=0.05 * jnp.ones(2), ub=3.0 * jnp.ones(2))
+    outer_wf = StdWorkflow(
+        outer_algo,
+        hpo,
+        solution_transform=lambda x: {"algorithm.hp": x},
+    )
+    state = outer_wf.init(key)
+    state = jax.jit(outer_wf.init_step)(state)
+    step = jax.jit(outer_wf.step)
+    for _ in range(10):
+        state = step(state)
+    assert jnp.all(jnp.isfinite(state.algorithm.fit))
+    best_hp = state.algorithm.global_best_location
+    # The best found scale must be small (the optimum is hp[0] -> 0.05).
+    assert jnp.abs(best_hp[0]) < 1.0, best_hp
