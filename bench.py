@@ -25,7 +25,10 @@ def bench_pso(pop_size: int = 100_000, dim: int = 1000, n_steps: int = 100) -> d
     ub = jnp.full((dim,), 10.0)
     wf = StdWorkflow(PSO(pop_size, lb, ub), Sphere())
     state = wf.init(jax.random.key(0))
-    init_step = jax.jit(wf.init_step, donate_argnums=0)
+    # No donation on init_step: it runs once, and on the axon TPU backend
+    # donating it breaks the later constant fetch when `step` is lowered
+    # (closure constants like lb/ub become unfetchable after the donation).
+    init_step = jax.jit(wf.init_step)
     step = jax.jit(wf.step, donate_argnums=0)
 
     # Warm-up: compile both programs and run a couple of steps.

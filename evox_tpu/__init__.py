@@ -47,7 +47,7 @@ __all__ = [
 
 # Plugin autoload (reference: ``src/evox/__init__.py:27-29``).
 try:
-    from evox_tpu_ext import auto_load_extensions
+    from evox_tpu_ext.autoload_ext import auto_load_extensions
 
     auto_load_extensions()
 except ImportError:

@@ -1,0 +1,13 @@
+"""Visualization tools (reference: ``src/evox/vis_tools/``): plotly plots
+(optional dependency) and the ``.exv`` EvoXVision streaming format."""
+
+__all__ = [
+    "EvoXVisionAdapter",
+    "new_exv_metadata",
+    "read_exv",
+    "exv",
+    "plot",
+]
+
+from . import exv, plot
+from .exv import EvoXVisionAdapter, new_exv_metadata, read_exv

@@ -1,0 +1,159 @@
+"""EvoXVision streaming storage (`.exv`) writer and reader.
+
+Implements the exv v1 binary format (documented at the top of the
+reference module, ``src/evox/vis_tools/exv.py:1-56``):
+
+| magic ``"exv1"`` (4B) | header length u32 LE (4B) | JSON metadata | chunks |
+
+The metadata JSON carries two schemas — one for the initial iteration
+(algorithms may emit a differently-sized first generation) and one for all
+following iterations; each chunk is the concatenation of the schema's
+fields (population then fitness, row-major bytes).  This implementation
+adds :func:`read_exv`, a full reader used for round-trip verification —
+the reference ships only the writer.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Union
+
+import numpy as np
+
+__all__ = ["EvoXVisionAdapter", "new_exv_metadata", "read_exv"]
+
+_MAGIC = b"exv1"
+
+_DTYPE_NAMES = {
+    np.dtype(np.uint8): "u8",
+    np.dtype(np.uint16): "u16",
+    np.dtype(np.uint32): "u32",
+    np.dtype(np.uint64): "u64",
+    np.dtype(np.int16): "i16",
+    np.dtype(np.int32): "i32",
+    np.dtype(np.int64): "i64",
+    np.dtype(np.float16): "f16",
+    np.dtype(np.float32): "f32",
+    np.dtype(np.float64): "f64",
+}
+_NAME_DTYPES = {v: k for k, v in _DTYPE_NAMES.items()}
+
+
+def _type_name(dtype) -> str:
+    dtype = np.dtype(dtype)
+    if dtype not in _DTYPE_NAMES:
+        raise ValueError(f"Unsupported dtype: {dtype}")
+    return _DTYPE_NAMES[dtype]
+
+
+def _field_schema(arrays: dict[str, np.ndarray]) -> dict:
+    fields = []
+    offset = 0
+    for name, arr in arrays.items():
+        size = arr.nbytes
+        fields.append(
+            {
+                "name": name,
+                "type": _type_name(arr.dtype),
+                "size": size,
+                "offset": offset,
+                "shape": list(arr.shape),
+            }
+        )
+        offset += size
+    return {
+        "population_size": next(iter(arrays.values())).shape[0],
+        "chunk_size": offset,
+        "fields": fields,
+    }
+
+
+def new_exv_metadata(
+    population1: np.ndarray,
+    population2: np.ndarray,
+    fitness1: np.ndarray,
+    fitness2: np.ndarray,
+) -> dict:
+    """Build the exv metadata from the first two iterations' data (the
+    schema is inferred, so writing starts after two generations)."""
+    n_objs = 1 if fitness1.ndim == 1 else fitness1.shape[1]
+    return {
+        "version": "v1",
+        "n_objs": n_objs,
+        "initial_iteration": _field_schema(
+            {"population": population1, "fitness": fitness1}
+        ),
+        "rest_iterations": _field_schema(
+            {"population": population2, "fitness": fitness2}
+        ),
+    }
+
+
+class EvoXVisionAdapter:
+    """Streams optimization data to an ``.exv`` file for the external
+    EvoXVision viewer (reference ``exv.py:160-222``)."""
+
+    def __init__(self, file_path: Union[str, Path], buffering: int = 0):
+        """
+        :param file_path: output path.
+        :param buffering: passed to ``open``; 0 = unbuffered (each write
+            lands immediately — the format is designed for streaming).
+        """
+        self.writer = open(file_path, "wb", buffering=buffering)
+        self.metadata: dict | None = None
+        self.header_written = False
+
+    def set_metadata(self, metadata: dict) -> None:
+        self.metadata = metadata
+
+    def write_header(self) -> None:
+        assert self.metadata is not None, "Metadata must be set before writing the header."
+        blob = json.dumps(self.metadata).encode("utf-8")
+        self.writer.write(_MAGIC)
+        self.writer.write(len(blob).to_bytes(4, byteorder="little", signed=False))
+        self.writer.write(blob)
+        self.header_written = True
+
+    def write(self, *fields) -> None:
+        """Append one chunk: the byte strings of each schema field in
+        order."""
+        assert self.header_written, "Header must be written before writing data."
+        self.writer.writelines(fields)
+
+    def flush(self) -> None:
+        if self.writer:
+            self.writer.flush()
+
+    def close(self) -> None:
+        if self.writer:
+            self.writer.close()
+
+
+def _decode_chunk(schema: dict, blob: bytes) -> dict[str, np.ndarray]:
+    out = {}
+    for field in schema["fields"]:
+        raw = blob[field["offset"] : field["offset"] + field["size"]]
+        out[field["name"]] = np.frombuffer(
+            raw, dtype=_NAME_DTYPES[field["type"]]
+        ).reshape(field["shape"])
+    return out
+
+
+def read_exv(file_path: Union[str, Path]) -> tuple[dict, list[dict[str, np.ndarray]]]:
+    """Read back an exv file: ``(metadata, [per-iteration field dicts])``."""
+    data = Path(file_path).read_bytes()
+    assert data[:4] == _MAGIC, f"Not an exv file: magic {data[:4]!r}"
+    header_len = int.from_bytes(data[4:8], byteorder="little", signed=False)
+    metadata = json.loads(data[8 : 8 + header_len].decode("utf-8"))
+    pos = 8 + header_len
+    iterations = []
+    init_schema = metadata["initial_iteration"]
+    rest_schema = metadata["rest_iterations"]
+    if pos < len(data):
+        iterations.append(_decode_chunk(init_schema, data[pos : pos + init_schema["chunk_size"]]))
+        pos += init_schema["chunk_size"]
+    while pos + rest_schema["chunk_size"] <= len(data):
+        iterations.append(_decode_chunk(rest_schema, data[pos : pos + rest_schema["chunk_size"]]))
+        pos += rest_schema["chunk_size"]
+    return metadata, iterations

@@ -258,11 +258,8 @@ class EvalMonitor(Monitor):
         if not self.fitness_history and not self.aux_history:
             warnings.warn("No fitness history recorded, return None")
             return None
-        try:
-            from ..vis_tools import plot
-        except ImportError as e:
-            warnings.warn(f"No visualization tool available ({e}), return None")
-            return None
+        from ..vis_tools import plot
+
         if source == "pop":
             fitness_history = [np.asarray(f) for f in self.aux_history["fit"]]
         elif source == "eval":
@@ -273,11 +270,17 @@ class EvalMonitor(Monitor):
             warnings.warn(f"No data recorded for source={source!r}, return None")
             return None
         n_objs = 1 if fitness_history[0].ndim == 1 else fitness_history[0].shape[1]
-        if n_objs == 1:
-            return plot.plot_obj_space_1d(fitness_history, **kwargs)
-        if n_objs == 2:
-            return plot.plot_obj_space_2d(fitness_history, problem_pf, **kwargs)
-        if n_objs == 3:
-            return plot.plot_obj_space_3d(fitness_history, problem_pf, **kwargs)
+        try:
+            if n_objs == 1:
+                return plot.plot_obj_space_1d(fitness_history, **kwargs)
+            if n_objs == 2:
+                return plot.plot_obj_space_2d(fitness_history, problem_pf, **kwargs)
+            if n_objs == 3:
+                return plot.plot_obj_space_3d(fitness_history, problem_pf, **kwargs)
+        except ImportError as e:
+            # plotly is optional; degrade gracefully (reference parity:
+            # ``eval_monitor.py:345-349``).
+            warnings.warn(f"No visualization tool available ({e}), return None")
+            return None
         warnings.warn("Not supported yet.")
         return None

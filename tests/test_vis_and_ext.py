@@ -1,0 +1,138 @@
+"""Tests for vis_tools (.exv round-trip, plot gating) and the extension
+autoloader (reference: plugin layer §1.8 of SURVEY.md)."""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from evox_tpu.vis_tools import EvoXVisionAdapter, new_exv_metadata, read_exv
+
+
+def test_exv_round_trip(tmp_path):
+    rng = np.random.RandomState(0)
+    pops = [rng.rand(8, 4).astype(np.float32) for _ in range(5)]
+    fits = [rng.rand(8, 2).astype(np.float32) for _ in range(5)]
+
+    path = tmp_path / "run.exv"
+    adapter = EvoXVisionAdapter(path)
+    meta = new_exv_metadata(pops[0], pops[1], fits[0], fits[1])
+    adapter.set_metadata(meta)
+    adapter.write_header()
+    for p, f in zip(pops, fits):
+        adapter.write(p.tobytes(), f.tobytes())
+    adapter.close()
+
+    meta_back, iterations = read_exv(path)
+    assert meta_back["version"] == "v1"
+    assert meta_back["n_objs"] == 2
+    assert len(iterations) == 5
+    for it, p, f in zip(iterations, pops, fits):
+        np.testing.assert_array_equal(it["population"], p)
+        np.testing.assert_array_equal(it["fitness"], f)
+
+
+def test_exv_magic_and_header_layout(tmp_path):
+    # The on-disk prefix must match the published format exactly:
+    # "exv1" magic then u32-LE header length (reference exv.py:1-10).
+    path = tmp_path / "x.exv"
+    a = EvoXVisionAdapter(path)
+    pop = np.zeros((2, 3), dtype=np.float32)
+    fit = np.zeros((2,), dtype=np.float32)
+    a.set_metadata(new_exv_metadata(pop, pop, fit, fit))
+    a.write_header()
+    a.close()
+    raw = path.read_bytes()
+    assert raw[:4] == b"exv1"
+    header_len = int.from_bytes(raw[4:8], "little")
+    assert len(raw) == 8 + header_len
+
+
+def test_exv_different_init_schema(tmp_path):
+    # Initial iteration may have a different population size.
+    pop1 = np.zeros((16, 3), dtype=np.float32)
+    pop2 = np.zeros((8, 3), dtype=np.float32)
+    fit1 = np.zeros((16,), dtype=np.float64)
+    fit2 = np.zeros((8,), dtype=np.float64)
+    meta = new_exv_metadata(pop1, pop2, fit1, fit2)
+    assert meta["initial_iteration"]["population_size"] == 16
+    assert meta["rest_iterations"]["population_size"] == 8
+    assert meta["initial_iteration"]["fields"][1]["type"] == "f64"
+
+    path = tmp_path / "y.exv"
+    a = EvoXVisionAdapter(path)
+    a.set_metadata(meta)
+    a.write_header()
+    a.write(pop1.tobytes(), fit1.tobytes())
+    a.write(pop2.tobytes(), fit2.tobytes())
+    a.close()
+    _, iters = read_exv(path)
+    assert iters[0]["population"].shape == (16, 3)
+    assert iters[1]["population"].shape == (8, 3)
+
+
+def test_plot_requires_plotly():
+    from evox_tpu.vis_tools import plot
+
+    try:
+        import plotly  # noqa: F401
+
+        has_plotly = True
+    except ImportError:
+        has_plotly = False
+    if not has_plotly:
+        with pytest.raises(ImportError):
+            plot.plot_obj_space_1d([np.zeros(4)])
+
+
+def test_extension_autoload(monkeypatch):
+    # Simulate an installed extension distribution providing
+    # evox_tpu_ext.algorithms.myalgo with one public class.
+    import evox_tpu.algorithms
+    from evox_tpu_ext.autoload_ext import load_extension
+
+    ext_pkg = types.ModuleType("fake_ext_algorithms")
+    ext_pkg.__path__ = []  # no submodules
+
+    class MyExtAlgo:
+        pass
+
+    ext_pkg.MyExtAlgo = MyExtAlgo
+    load_extension(ext_pkg, evox_tpu.algorithms)
+    try:
+        assert evox_tpu.algorithms.MyExtAlgo is MyExtAlgo
+        assert "MyExtAlgo" in evox_tpu.algorithms.__all__
+    finally:
+        delattr(evox_tpu.algorithms, "MyExtAlgo")
+        evox_tpu.algorithms.__all__.remove("MyExtAlgo")
+
+
+def test_extension_autoload_submodule(tmp_path, monkeypatch):
+    # A real namespace package on disk: evox_tpu_ext.metrics with a module
+    # exposing a function; auto_load_extensions grafts it into
+    # evox_tpu.metrics.
+    ext_root = tmp_path / "distro" / "evox_tpu_ext" / "metrics"
+    ext_root.mkdir(parents=True)
+    (ext_root / "__init__.py").write_text("")
+    (ext_root / "extra_metric.py").write_text("def spacing(f):\n    return 0.0\n")
+
+    monkeypatch.syspath_prepend(str(tmp_path / "distro"))
+    # Invalidate caches so the new namespace portion is discoverable.
+    import importlib
+
+    importlib.invalidate_caches()
+    for mod in ["evox_tpu_ext.metrics", "evox_tpu_ext.metrics.extra_metric"]:
+        sys.modules.pop(mod, None)
+
+    import evox_tpu.metrics
+    from evox_tpu_ext.autoload_ext import load_extension
+
+    ext = importlib.import_module("evox_tpu_ext.metrics")
+    load_extension(ext, evox_tpu.metrics)
+    try:
+        assert hasattr(evox_tpu.metrics, "extra_metric")
+        assert evox_tpu.metrics.extra_metric.spacing(None) == 0.0
+    finally:
+        delattr(evox_tpu.metrics, "extra_metric")
+        evox_tpu.metrics.__all__.remove("extra_metric")
