@@ -1,0 +1,20 @@
+"""Parallelism layer: device meshes, sharded evaluation, multi-host init.
+
+The reference's parallel surface is population-data-parallel evaluation
+over ``torch.distributed`` plus nested ``vmap`` batching (SURVEY §2.8).
+Here both axes are first-class JAX constructs: meshes + ``shard_map`` for
+cross-device population sharding (collectives ride ICI/DCN as the mesh
+dictates) and ``jax.vmap`` for intra-device batching, which composes with
+the mesh natively.
+"""
+
+__all__ = [
+    "ShardedProblem",
+    "init_multi_host",
+    "make_pop_mesh",
+    "replicate",
+    "shard_population",
+]
+
+from .mesh import init_multi_host, make_pop_mesh, replicate, shard_population
+from .sharded_problem import ShardedProblem

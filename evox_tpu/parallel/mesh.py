@@ -1,0 +1,70 @@
+"""Device-mesh helpers for population-parallel evolutionary computation.
+
+The reference's entire communication backend is three ``torch.distributed``
+call sites (SURVEY §2.8; ``std_workflow.py:139-161``): rank-sliced
+population evaluation plus one NCCL ``all_gather``, launched via
+``torchrun``.  The TPU-native equivalent is declarative: build a
+``jax.sharding.Mesh`` over the population axis and let XLA place the
+all-gather on ICI (intra-slice) or DCN (cross-slice).  These helpers cover
+the full lifecycle:
+
+* :func:`init_multi_host` — one call per host process on a multi-host pod
+  (replaces ``torchrun`` + ``init_process_group``).
+* :func:`make_pop_mesh` — a 1-D mesh over all (or ``n``) global devices.
+* :func:`shard_population` / :func:`replicate` — placement of the two kinds
+  of workflow data: the population axis is sharded, algorithm state is
+  replicated (the reference's replicated-state contract).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "init_multi_host",
+    "make_pop_mesh",
+    "shard_population",
+    "replicate",
+]
+
+
+def init_multi_host(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize the JAX distributed runtime for a multi-host pod: call once
+    per host before any other JAX API (the TPU-native replacement for the
+    reference's ``torchrun`` + ``init_process_group`` flow,
+    ``distributed_workflow.md:20-29``).  On Cloud TPU all arguments are
+    auto-detected from the environment."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_pop_mesh(n_devices: int | None = None, axis_name: str = "pop") -> Mesh:
+    """A 1-D mesh over ``n_devices`` global devices (default: all), with the
+    population axis as its only dimension."""
+    devices = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def shard_population(pop, mesh: Mesh, axis_name: str = "pop"):
+    """Place a population pytree with its leading (population) axis sharded
+    over the mesh.  Use on the initial population so per-generation work
+    starts device-local instead of being re-scattered each step."""
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), pop)
+
+
+def replicate(state, mesh: Mesh):
+    """Place a pytree fully replicated over the mesh — the contract for
+    algorithm state in population-parallel evaluation (every device steps
+    the identical algorithm; only evaluation is sharded)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), state)

@@ -1,0 +1,67 @@
+"""Sharded-evaluation Problem wrapper.
+
+Generalizes ``StdWorkflow``'s built-in distributed path
+(``workflows/std_workflow.py``; reference ``std_workflow.py:139-161``) into
+a standalone composition: wrap ANY problem so its ``evaluate`` runs under
+``shard_map`` with the population split over a mesh axis and the fitness
+all-gathered — usable with custom workflows, the HPO wrapper, or directly.
+
+Contract (same as the reference's distributed mode): the wrapped problem is
+evaluated shard-locally; if it keeps a PRNG key in its state, each shard
+folds in its mesh position so stochastic evaluations decorrelate across
+shards while the replicated state advances identically everywhere.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import Problem, State
+
+__all__ = ["ShardedProblem"]
+
+
+class ShardedProblem(Problem):
+    """Wraps a Problem so evaluation is population-sharded over a mesh."""
+
+    def __init__(self, problem: Problem, mesh: Mesh, axis_name: str = "pop"):
+        """
+        :param problem: the inner problem; its ``evaluate`` must be pure.
+        :param mesh: device mesh with ``axis_name`` as a mesh axis.
+        :param axis_name: mesh axis to shard the population's leading axis
+            over; the population size must be divisible by its size.
+        """
+        self.problem = problem
+        self.mesh = mesh
+        self.axis_name = axis_name
+
+    def setup(self, key: jax.Array) -> State:
+        return self.problem.setup(key)
+
+    def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
+        n_shards = self.mesh.shape[self.axis_name]
+        assert pop.shape[0] % n_shards == 0, (
+            f"population size {pop.shape[0]} must divide over the "
+            f"{n_shards}-way '{self.axis_name}' mesh axis"
+        )
+        axis = self.axis_name
+
+        def local_eval(pop_shard):
+            local_state = state
+            if "key" in state:
+                idx = jax.lax.axis_index(axis)
+                local_state = state.replace(key=jax.random.fold_in(state.key, idx))
+            fit, _ = self.problem.evaluate(local_state, pop_shard)
+            return jax.lax.all_gather(fit, axis, axis=0, tiled=True)
+
+        fit = jax.shard_map(
+            local_eval,
+            mesh=self.mesh,
+            in_specs=P(axis),
+            out_specs=P(),
+            check_vma=False,
+        )(pop)
+        if "key" in state:
+            state = state.replace(key=jax.random.fold_in(state.key, 0x5EED))
+        return fit, state

@@ -19,6 +19,7 @@ from .ops import (
     randint,
     switch,
 )
+from .checkpoint import load_state, save_state
 from .params_vector import ParamsAndVector
 from .vmap_ops import host_op, register_vmap_op
 
@@ -39,6 +40,8 @@ __all__ = [
     "nanmax",
     "randint",
     "ParamsAndVector",
+    "save_state",
+    "load_state",
     "register_vmap_op",
     "host_op",
     "tree_flatten",

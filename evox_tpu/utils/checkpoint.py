@@ -1,0 +1,81 @@
+"""Checkpoint / resume for workflow state.
+
+The reference has no dedicated checkpoint subsystem — every component is an
+``nn.Module`` so checkpointing is ``state_dict()``/``load_state_dict()``
+(SURVEY §5; used that way in ``unit_test/algorithms/test_base.py:28,37``).
+Here the equivalent primitive is even simpler: all evolving state is one
+:class:`~evox_tpu.core.State` pytree, so a checkpoint is the pytree's
+leaves keyed by path.
+
+:func:`save_state` / :func:`load_state` write/read a single ``.npz`` file —
+dependency-free, host-portable, and exact (bit-identical resume is tested).
+For sharded multi-host state, prefer ``orbax.checkpoint`` with the same
+pytree (it handles per-shard async writes); these helpers cover the
+single-host case and small HPO/monitor states.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Union
+
+import jax
+import numpy as np
+
+__all__ = ["save_state", "load_state"]
+
+
+def _path_str(key_path) -> str:
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def save_state(path: Union[str, Path], state: Any) -> None:
+    """Save a (nested) State / pytree of arrays to ``path`` as ``.npz``.
+
+    PRNG-key arrays are stored via their raw ``uint32`` key data, so the
+    random stream resumes exactly."""
+    leaves_with_paths, _ = jax.tree_util.tree_flatten_with_path(state)
+    out = {}
+    for key_path, leaf in leaves_with_paths:
+        name = _path_str(key_path)
+        arr = leaf
+        if isinstance(arr, jax.Array) and jax.dtypes.issubdtype(
+            arr.dtype, jax.dtypes.prng_key
+        ):
+            out["__key__/" + name] = np.asarray(jax.random.key_data(arr))
+        else:
+            out[name] = np.asarray(arr)
+    np.savez(path, **out)
+
+
+def load_state(path: Union[str, Path], like: Any) -> Any:
+    """Load a checkpoint written by :func:`save_state` into the structure of
+    ``like`` (a template state with the same shape — e.g. a freshly
+    ``setup()`` state).  Returns a new pytree; ``like`` is unchanged."""
+    data = np.load(path)
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+    new_leaves = []
+    for key_path, leaf in leaves_with_paths:
+        name = _path_str(key_path)
+        if "__key__/" + name in data:
+            raw = data["__key__/" + name]
+            impl = jax.random.key_impl(leaf)
+            new_leaves.append(jax.random.wrap_key_data(raw, impl=impl))
+        elif name in data:
+            arr = data[name]
+            if hasattr(leaf, "dtype"):
+                arr = arr.astype(leaf.dtype)
+            new_leaves.append(jax.numpy.asarray(arr))
+        else:
+            raise KeyError(
+                f"checkpoint {path} has no entry for state leaf {name!r}"
+            )
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

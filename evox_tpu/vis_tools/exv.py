@@ -150,9 +150,12 @@ def read_exv(file_path: Union[str, Path]) -> tuple[dict, list[dict[str, np.ndarr
     iterations = []
     init_schema = metadata["initial_iteration"]
     rest_schema = metadata["rest_iterations"]
-    if pos < len(data):
-        iterations.append(_decode_chunk(init_schema, data[pos : pos + init_schema["chunk_size"]]))
-        pos += init_schema["chunk_size"]
+    # Truncated chunks (a streaming writer may die mid-chunk) are dropped;
+    # a truncated INITIAL chunk means no complete iteration exists at all.
+    if pos + init_schema["chunk_size"] > len(data):
+        return metadata, []
+    iterations.append(_decode_chunk(init_schema, data[pos : pos + init_schema["chunk_size"]]))
+    pos += init_schema["chunk_size"]
     while pos + rest_schema["chunk_size"] <= len(data):
         iterations.append(_decode_chunk(rest_schema, data[pos : pos + rest_schema["chunk_size"]]))
         pos += rest_schema["chunk_size"]

@@ -32,6 +32,12 @@ def _go():
 
 
 def _padded_range(v: np.ndarray) -> list:
+    # Non-finite entries (inf-penalized fitness early in a run) are dropped;
+    # with nothing finite fall back to a unit range instead of a NaN axis.
+    v = np.asarray(v)
+    v = v[np.isfinite(v)]
+    if v.size == 0:
+        return [0.0, 1.0]
     lo, hi = float(np.min(v)), float(np.max(v))
     span = hi - lo
     return [lo - 0.1 * span, hi + 0.1 * span]
@@ -187,12 +193,11 @@ def plot_obj_space_2d(
         for f in fitness_history
     ]
     all_fit = np.concatenate(fitness_history, axis=0)
-    finite = all_fit[np.isfinite(all_fit).all(axis=1)]
     return _animated_scatter(
         frames,
         dict(
-            xaxis={"range": _padded_range(finite[:, 0])},
-            yaxis={"range": _padded_range(finite[:, 1])},
+            xaxis={"range": _padded_range(all_fit[:, 0])},
+            yaxis={"range": _padded_range(all_fit[:, 1])},
             **kwargs,
         ),
     )

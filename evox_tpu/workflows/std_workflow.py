@@ -26,8 +26,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..core import Algorithm, Monitor, Problem, State, Workflow
 
@@ -94,6 +93,12 @@ class StdWorkflow(Workflow):
                     f"'{pop_axis}' mesh axis; pop_size={pop_size} must be "
                     f"divisible by the {n_shards} devices on that axis."
                 )
+            # One implementation of the sharded-eval logic: wrap the problem
+            # (see ``parallel/sharded_problem.py`` for the shard_map body).
+            from ..parallel import ShardedProblem
+
+            if not isinstance(self.problem, ShardedProblem):
+                self.problem = ShardedProblem(self.problem, mesh, pop_axis)
 
     # -- state -------------------------------------------------------------
     def setup(self, key: jax.Array) -> State:
@@ -108,38 +113,7 @@ class StdWorkflow(Workflow):
 
     # -- evaluation pipeline ----------------------------------------------
     def _problem_eval(self, prob_state: State, pop: Any) -> tuple[jax.Array, State]:
-        if not self.enable_distributed:
-            return self.problem.evaluate(prob_state, pop)
-
-        # Population-sharded evaluation: each mesh shard evaluates its slice
-        # of the population with an independent problem key, then the fitness
-        # is all-gathered over the mesh axis (ICI/DCN chosen by the mesh).
-        mesh, axis = self.mesh, self.pop_axis
-
-        def local_eval(pop_shard):
-            local_state = prob_state
-            if "key" in prob_state:
-                idx = jax.lax.axis_index(axis)
-                local_state = prob_state.replace(
-                    key=jax.random.fold_in(prob_state.key, idx)
-                )
-            fit, _ = self.problem.evaluate(local_state, pop_shard)
-            return jax.lax.all_gather(fit, axis, axis=0, tiled=True)
-
-        fit = jax.shard_map(
-            local_eval,
-            mesh=mesh,
-            in_specs=P(axis),
-            out_specs=P(),
-            check_vma=False,
-        )(pop)
-        # Advance the replicated problem key once so successive generations
-        # draw fresh per-shard streams (the reference's fork_rng analogue).
-        if "key" in prob_state:
-            prob_state = prob_state.replace(
-                key=jax.random.fold_in(prob_state.key, 0x5EED)
-            )
-        return fit, prob_state
+        return self.problem.evaluate(prob_state, pop)
 
     def _make_evaluate(self, carrier: dict) -> Callable:
         def evaluate(pop):

@@ -40,6 +40,10 @@ def load_extension(package: types.ModuleType, exposed_module: types.ModuleType) 
         existing = exposed_module.__dict__.get(module_name)
         if isinstance(existing, types.ModuleType):
             load_extension(external_module, existing)
+        elif existing is not None:
+            # Never shadow a non-module core attribute (e.g. the `igd`
+            # function in evox_tpu.metrics) with an extension module.
+            continue
         else:
             setattr(exposed_module, module_name, external_module)
             exposed_module.__all__ = list(
