@@ -69,11 +69,19 @@ def non_dominate_rank(f: jax.Array) -> jax.Array:
 
 
 def _dominance_matrix(f: jax.Array) -> jax.Array:
-    """Dominance matrix with automatic Pallas dispatch for large populations
-    on TPU (``evox_tpu.ops.dominance``); XLA's fused broadcast-compare
-    elsewhere."""
+    """Dominance matrix: XLA's fused broadcast-compare by default; the
+    Pallas blocked kernel (``evox_tpu.ops.dominance``) for large populations
+    when ``EVOX_TPU_PALLAS=1``.  Opt-in rather than automatic: Pallas/Mosaic
+    compilation is not supported on every TPU attachment (notably remote
+    tunnels), and a silent dispatch there can hang the whole program."""
+    import os
+
     n = f.shape[0]
-    if n >= 4096 and jax.default_backend() == "tpu":
+    if (
+        n >= 4096
+        and jax.default_backend() == "tpu"
+        and os.environ.get("EVOX_TPU_PALLAS") == "1"
+    ):
         from ...ops.dominance import dominance_matrix as pallas_dom
 
         return pallas_dom(f)
