@@ -10,31 +10,81 @@ states are already pytrees, so *N instances* is one ``jax.vmap``, the inner
 iterations are one ``lax.fori_loop``, and per-instance randomness is free
 because every instance carries its own PRNG key (SURVEY §3.3).
 
-Semantics deviation (documented for the judge): with ``num_repeats > 1``
-the reference aggregates fitness *across repeats inside every generation*
-(best-of-mean, via a vmap-aware custom op, ``hpo_wrapper.py:19-38``) —
-cross-lane communication inside vmap that JAX lanes cannot do.  This
-implementation runs repeats as independent lanes and aggregates their
-*final* ``tell_fitness`` values (mean-of-best by default), the estimator
-normally reported for repeated stochastic runs; pass ``fit_aggregation``
-to change the reduction.
+``num_repeats`` semantics match the reference exactly: with repeats, the
+*algorithm* in each repeat lane adapts on its own raw fitness, while the
+*monitor* aggregates fitness across repeats **inside every generation**
+(mean by default) before updating its best — "best of per-generation mean"
+(reference ``hpo_wrapper.py:19-38`` custom-op aggregation + ``:83-96``).
+The reference needs a vmap-aware ``torch.library`` custom op for that
+cross-lane mean; in JAX it is a named-axis collective: the repeat vmap
+carries ``axis_name=HPO_REPEAT_AXIS`` and the monitor reduces over it with
+``lax.all_gather``.  The simpler end-of-run estimator (aggregate each lane's
+final best) remains available as ``aggregation="final"``.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Mapping
+from typing import Any, Callable, Literal, Mapping
 
 import jax
 import jax.numpy as jnp
 
 from ..core import Monitor, Problem, State, Workflow, get_params, set_params
 
-__all__ = ["HPOMonitor", "HPOFitnessMonitor", "HPOProblemWrapper"]
+__all__ = ["HPOMonitor", "HPOFitnessMonitor", "HPOProblemWrapper", "HPO_REPEAT_AXIS"]
+
+#: vmap axis name carried by the repeats axis inside
+#: :meth:`HPOProblemWrapper.evaluate`; HPO monitors reduce over it.
+HPO_REPEAT_AXIS = "hpo_repeat"
+
+
+def _reduce_axis(fn: Callable, arr: jax.Array, axis: int) -> jax.Array:
+    """Apply a repeats reduction.  Preferred contract is ``fn(arr, axis=...)``
+    (like ``jnp.mean``); 1-D reducers ``fn(vec) -> scalar`` are accepted for
+    back-compat and applied along ``axis``."""
+    try:
+        return fn(arr, axis=axis)
+    except TypeError:
+        return jnp.apply_along_axis(fn, axis, arr)
 
 
 class HPOMonitor(Monitor):
     """Base monitor for HPO inner workflows: must expose the inner run's
-    final score via ``tell_fitness`` (reference ``hpo_wrapper.py:41-58``)."""
+    final score via ``tell_fitness`` (reference ``hpo_wrapper.py:41-58``).
+
+    :param num_repeats: set by :class:`HPOProblemWrapper` (per-generation
+        mode); when > 1, subclasses should aggregate fitness across the
+        ``HPO_REPEAT_AXIS`` vmap axis in ``pre_tell`` via
+        :meth:`aggregate_repeats`.
+    :param fit_aggregation: reduction over the repeats axis, called as
+        ``fit_aggregation(stacked, axis=0)`` (default ``jnp.mean`` — the
+        reference's mean-of-repeats, ``hpo_wrapper.py:19-38``).
+    """
+
+    def __init__(
+        self,
+        num_repeats: int = 1,
+        fit_aggregation: Callable = jnp.mean,
+    ):
+        self.num_repeats = num_repeats
+        self.fit_aggregation = fit_aggregation
+
+    def aggregate_repeats(self, fitness: jax.Array) -> jax.Array:
+        """Cross-repeat aggregation of this generation's fitness.  Inside the
+        wrapper's repeat vmap this is a collective over the named axis: every
+        lane receives the same aggregated tensor (the JAX-native equivalent
+        of the reference's vmap-registered mean custom op)."""
+        if self.num_repeats <= 1:
+            return fitness
+        try:
+            stacked = jax.lax.all_gather(fitness, HPO_REPEAT_AXIS, axis=0)
+        except NameError:
+            # The repeat axis is only bound inside HPOProblemWrapper's
+            # per-generation vmap; running the same (already-wired) monitor
+            # standalone or under "final" aggregation traces with no such
+            # axis — degrade to the raw per-lane fitness.
+            return fitness
+        return _reduce_axis(self.fit_aggregation, stacked, 0)
 
     def tell_fitness(self, state: State) -> jax.Array:
         raise NotImplementedError(
@@ -46,7 +96,12 @@ class HPOFitnessMonitor(HPOMonitor):
     """Tracks the best fitness value seen by the inner workflow
     (reference ``hpo_wrapper.py:61-103``)."""
 
-    def __init__(self, multi_obj_metric: Callable | None = None):
+    def __init__(
+        self,
+        multi_obj_metric: Callable | None = None,
+        num_repeats: int = 1,
+        fit_aggregation: Callable = jnp.mean,
+    ):
         """
         :param multi_obj_metric: scalarizing metric for multi-objective inner
             problems, e.g. ``lambda f: igd(f, problem.pf())``; unused for
@@ -55,6 +110,7 @@ class HPOFitnessMonitor(HPOMonitor):
         assert multi_obj_metric is None or callable(multi_obj_metric), (
             f"Expect `multi_obj_metric` to be `None` or callable, got {multi_obj_metric}"
         )
+        super().__init__(num_repeats, fit_aggregation)
         self.multi_obj_metric = multi_obj_metric
 
     def setup(self, key: jax.Array) -> State:
@@ -62,6 +118,7 @@ class HPOFitnessMonitor(HPOMonitor):
         return State(best_fitness=jnp.asarray(jnp.inf))
 
     def pre_tell(self, state: State, fitness: jax.Array) -> State:
+        fitness = self.aggregate_repeats(fitness)
         if fitness.ndim == 1:
             value = jnp.min(fitness)
         else:
@@ -99,7 +156,8 @@ class HPOProblemWrapper(Problem):
         num_instances: int,
         workflow: Workflow,
         num_repeats: int = 1,
-        fit_aggregation: Callable[[jax.Array], jax.Array] = jnp.mean,
+        fit_aggregation: Callable = jnp.mean,
+        aggregation: Literal["per_generation", "final"] = "per_generation",
     ):
         """
         :param iterations: total inner generations per evaluation (including
@@ -109,10 +167,18 @@ class HPOProblemWrapper(Problem):
         :param workflow: the inner workflow; its monitor must be an
             :class:`HPOMonitor`.
         :param num_repeats: independent repeats per instance (distinct PRNG
-            streams); their final scores are reduced by ``fit_aggregation``.
+            streams); hyper-parameters are shared across repeats.
+        :param fit_aggregation: reduction over the repeats axis, called as
+            ``fit_aggregation(stacked, axis=0)``; default ``jnp.mean``.
+        :param aggregation: ``"per_generation"`` (reference-faithful: the
+            monitor sees repeat-aggregated fitness every generation and
+            tracks best-of-mean) or ``"final"`` (each repeat lane tracks its
+            own best; the lanes' final scores are aggregated once at the end
+            — the estimator for "report mean of K independent runs").
         """
         assert iterations >= 2, f"`iterations` should be at least 2, got {iterations}"
         assert num_instances > 0
+        assert aggregation in ("per_generation", "final")
         monitor = getattr(workflow, "monitor", None)
         assert isinstance(monitor, HPOMonitor), (
             f"Expect workflow monitor to be `HPOMonitor`, got {type(monitor)}"
@@ -122,6 +188,7 @@ class HPOProblemWrapper(Problem):
         self.num_repeats = num_repeats
         self.workflow = workflow
         self.fit_aggregation = fit_aggregation
+        self.aggregation = aggregation
 
     def setup(self, key: jax.Array) -> State:
         n = self.num_instances * self.num_repeats
@@ -162,13 +229,37 @@ class HPOProblemWrapper(Problem):
             wf_state = wf.final_step(wf_state)
             return wf.monitor.tell_fitness(wf_state.monitor)
 
-        if self.num_repeats == 1:
-            fit = jax.vmap(run_one)(state.instances, dict(hyper_parameters))
-        else:
-            fit = jax.vmap(
-                lambda ws, hp: jax.vmap(lambda w: run_one(w, hp))(ws)
-            )(state.instances, dict(hyper_parameters))
-            fit = jax.vmap(self.fit_aggregation)(fit)
+        # Wire the monitor's repeat aggregation for the duration of this
+        # trace only (the reference wires it permanently at construction,
+        # ``hpo_wrapper.py:204`` — but several wrappers may share one
+        # workflow object, so config must not leak across them).
+        monitor = wf.monitor
+        per_gen = self.aggregation == "per_generation" and self.num_repeats > 1
+        saved = (monitor.num_repeats, monitor.fit_aggregation)
+        monitor.num_repeats = self.num_repeats if per_gen else 1
+        if per_gen:
+            monitor.fit_aggregation = self.fit_aggregation
+        try:
+            if self.num_repeats == 1:
+                fit = jax.vmap(run_one)(state.instances, dict(hyper_parameters))
+            elif per_gen:
+                # Repeat lanes run under a *named* vmap axis; the monitor's
+                # ``aggregate_repeats`` all-gathers over it each generation,
+                # so every lane's best tracks the aggregated (mean) fitness
+                # and the lanes' final tells are identical — read lane 0.
+                fit = jax.vmap(
+                    lambda ws, hp: jax.vmap(
+                        lambda w: run_one(w, hp), axis_name=HPO_REPEAT_AXIS
+                    )(ws)
+                )(state.instances, dict(hyper_parameters))
+                fit = fit[:, 0]
+            else:  # "final": aggregate each lane's independent end-of-run best
+                fit = jax.vmap(
+                    lambda ws, hp: jax.vmap(lambda w: run_one(w, hp))(ws)
+                )(state.instances, dict(hyper_parameters))
+                fit = _reduce_axis(self.fit_aggregation, fit, 1)
+        finally:
+            monitor.num_repeats, monitor.fit_aggregation = saved
         # The inner states are consumed per evaluation (fresh instances each
         # call evaluate identical init states, matching the reference's
         # copy_init_state behavior).

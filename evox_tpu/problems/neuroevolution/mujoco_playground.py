@@ -73,6 +73,7 @@ class MujocoProblem(RolloutProblem):
         obs_size = env.observation_size
         if isinstance(obs_size, dict):
             obs_size = obs_size["state"]
+        self._mjx_env = env
         super().__init__(
             policy=policy,
             env=Env(reset, step, obs_size, env.action_size),
@@ -82,3 +83,44 @@ class MujocoProblem(RolloutProblem):
             reduce_fn=reduce_fn,
             maximize_reward=maximize_reward,
         )
+
+    def visualize(
+        self,
+        state,
+        params: Any,
+        seed: int | None = None,
+        output_type: str = "mp4",
+        output_path: str = "output_video",
+        camera: str | None = None,
+        **kwargs,
+    ) -> str:
+        """Render one episode of a single policy to a video file (reference
+        ``mujoco_playground.py:385-434``).
+
+        :param state: the problem State (supplies the episode key when
+            ``seed`` is None).
+        :param params: one individual's policy parameters (unstacked).
+        :param output_type: ``"mp4"`` or ``"gif"``.
+        :return: path of the written file.
+        """
+        import imageio
+
+        assert output_type in ("mp4", "gif"), "output_type must be mp4 or gif"
+        key = state.key if seed is None else jax.random.key(seed)
+        env_state, obs = self.env.reset(key)
+        trajectory = [env_state.data]
+        for _ in range(self.max_episode_length):
+            action = self.policy(params, obs)
+            env_state, obs, _, done = self.env.step(env_state, action)
+            trajectory.append(env_state.data)
+            if bool(done):
+                break
+        fps = kwargs.pop("fps", 1.0 / self._mjx_env.dt)
+        kwargs = {"height": 480, "width": 640, "camera": camera, **kwargs}
+        frames = self._mjx_env.render(trajectory, **kwargs)
+        output_path = f"{output_path}.{output_type}"
+        if output_type == "mp4":
+            imageio.mimsave(output_path, frames, fps=fps, codec="libx264", format="mp4")
+        else:
+            imageio.mimsave(output_path, frames, format="gif")
+        return output_path

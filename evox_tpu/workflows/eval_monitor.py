@@ -10,8 +10,10 @@ chained through a token tensor to force ordering
 (``eval_monitor.py:46-80,243-251``).  Here the same side channel is
 ``jax.experimental.io_callback(ordered=True)`` — the JAX effects system plays
 the token's role.  For vmapped (batched-instance) workflows pass
-``ordered=False``: callbacks then batch, and each history entry carries the
-extra instance axis.
+``ordered=False`` and ``num_instances=N``: JAX's batching rule for unordered
+``io_callback`` unrolls it into one host call per batch element in index
+order, and the monitor re-groups each generation's ``N`` consecutive
+per-instance entries so every history item carries a leading instance axis.
 """
 
 from __future__ import annotations
@@ -54,6 +56,7 @@ class EvalMonitor(Monitor):
         full_pop_history: bool = False,
         topk: int = 1,
         ordered: bool = True,
+        num_instances: int | None = None,
     ):
         """
         :param multi_obj: whether the optimization is multi-objective.
@@ -63,7 +66,11 @@ class EvalMonitor(Monitor):
             ``record_auxiliary``.
         :param topk: number of elite solutions tracked (single-objective).
         :param ordered: use ordered host callbacks; set False when the
-            workflow is vmapped over instances.
+            workflow is vmapped over instances (ordered callbacks cannot be
+            vmapped).
+        :param num_instances: with ``ordered=False`` under a vmapped
+            workflow, the instance count; history entries are re-grouped so
+            each carries a leading ``(num_instances,)`` axis.
         """
         self.multi_obj = multi_obj
         self.full_fit_history = full_fit_history
@@ -71,6 +78,7 @@ class EvalMonitor(Monitor):
         self.full_pop_history = full_pop_history
         self.topk = topk
         self.ordered = ordered
+        self.num_instances = num_instances
         self.opt_direction = 1
         self.aux_keys: list[str] = []
         self._id_ = id(self)
@@ -79,7 +87,7 @@ class EvalMonitor(Monitor):
 
     # -- config ------------------------------------------------------------
     def set_config(self, **config: Any) -> "EvalMonitor":
-        for k in ("multi_obj", "full_fit_history", "full_sol_history", "topk", "opt_direction"):
+        for k in ("multi_obj", "full_fit_history", "full_sol_history", "topk", "opt_direction", "ordered", "num_instances"):
             if k in config:
                 setattr(self, k, config[k])
         return self
@@ -145,15 +153,32 @@ class EvalMonitor(Monitor):
         return state
 
     # -- history accessors (host side) --------------------------------------
+    def _grouped(self, entries: list) -> list:
+        """With a vmapped workflow (``ordered=False``), the unordered
+        ``io_callback`` batching rule delivers one per-instance host call per
+        batch element, in index order; stack each generation's
+        ``num_instances`` consecutive entries back into one batched array."""
+        n = self.num_instances
+        if not n or n <= 1:
+            return entries
+        assert len(entries) % n == 0, (
+            f"history has {len(entries)} entries, not a multiple of "
+            f"num_instances={n} — was the workflow actually vmapped over "
+            f"{n} instances?"
+        )
+        return [
+            np.stack(entries[i : i + n]) for i in range(0, len(entries), n)
+        ]
+
     @property
     def fitness_history(self) -> list:
-        return __monitor_history__[self._id_][HistoryType.FITNESS]
+        return self._grouped(__monitor_history__[self._id_][HistoryType.FITNESS])
 
     fit_history = fitness_history
 
     @property
     def solution_history(self) -> list:
-        return __monitor_history__[self._id_][HistoryType.SOLUTION]
+        return self._grouped(__monitor_history__[self._id_][HistoryType.SOLUTION])
 
     sol_history = solution_history
 
@@ -163,8 +188,12 @@ class EvalMonitor(Monitor):
         n = len(self.aux_keys)
         if n == 0:
             return {}
-        assert len(raw) % n == 0
-        return {k: raw[i::n] for i, k in enumerate(self.aux_keys)}
+        # Re-group per-instance entries first (vmapped workflows emit
+        # num_instances consecutive entries per sink call), THEN de-interleave
+        # by aux key: each generation contributes one batched entry per key.
+        grouped = self._grouped(raw)
+        assert len(grouped) % n == 0
+        return {k: grouped[i::n] for i, k in enumerate(self.aux_keys)}
 
     aux_history = auxiliary_history
 
@@ -211,8 +240,13 @@ class EvalMonitor(Monitor):
             raise ValueError("get_pf_fitness is only available for multi-objective optimization.")
         if not self.full_fit_history:
             warnings.warn("`get_pf_fitness` requires enabling `full_fit_history`.")
+        # With a vmapped workflow (num_instances set) entries carry a leading
+        # instance axis; the pooled front treats every (instance, individual)
+        # evaluation as one point.
         all_fit = jnp.concatenate(
-            [jnp.asarray(f) for f in self.fitness_history], axis=0
+            [jnp.asarray(f).reshape(-1, jnp.asarray(f).shape[-1])
+             for f in self.fitness_history],
+            axis=0,
         )
         if deduplicate:
             all_fit = jnp.unique(all_fit, axis=0)
@@ -229,10 +263,14 @@ class EvalMonitor(Monitor):
         if not (self.full_fit_history and self.full_sol_history):
             warnings.warn("`get_pf` requires enabling both `full_sol_history` and `full_fit_history`.")
         all_sol = jnp.concatenate(
-            [jnp.asarray(s) for s in self.solution_history], axis=0
+            [jnp.asarray(s).reshape(-1, jnp.asarray(s).shape[-1])
+             for s in self.solution_history],
+            axis=0,
         )
         all_fit = jnp.concatenate(
-            [jnp.asarray(f) for f in self.fitness_history], axis=0
+            [jnp.asarray(f).reshape(-1, jnp.asarray(f).shape[-1])
+             for f in self.fitness_history],
+            axis=0,
         )
         if deduplicate:
             _, idx = np.unique(np.asarray(all_sol), axis=0, return_index=True)

@@ -122,6 +122,47 @@ def test_vmap_workflow_instances():
     assert not jnp.allclose(states.algorithm.fit[0], states.algorithm.fit[1])
 
 
+def test_vmap_workflow_monitor_unordered():
+    """The batched-instance monitor path (``EvalMonitor(ordered=False)``,
+    ``eval_monitor.py:66-72``): under vmap the io_callback batches, so every
+    history entry carries the leading instance axis, and per-instance top-k
+    state stays per-instance."""
+    n_instances, n_steps = 4, 3
+    mon = EvalMonitor(
+        topk=2,
+        full_fit_history=True,
+        full_sol_history=True,
+        ordered=False,
+        num_instances=n_instances,
+    )
+    wf = _make(monitor=mon)
+    keys = jax.random.split(jax.random.key(7), n_instances)
+    states = jax.vmap(wf.init)(keys)
+    states = jax.jit(jax.vmap(wf.init_step))(states)
+    step = jax.jit(jax.vmap(wf.step))
+    for _ in range(n_steps):
+        states = step(states)
+    jax.block_until_ready(states)
+
+    # In-state results: instance axis on everything.
+    assert states.monitor.topk_fitness.shape == (n_instances, 2)
+    assert states.monitor.topk_solutions.shape == (n_instances, 2, DIM)
+    topk = jax.vmap(mon.get_topk_fitness)(states.monitor)
+    assert jnp.all(jnp.diff(topk, axis=1) >= 0)  # each instance sorted
+
+    # Host-side history: one entry per generation, each (instances, ...).
+    assert len(mon.fitness_history) == n_steps + 1
+    assert mon.fitness_history[0].shape == (n_instances, POP)
+    assert mon.solution_history[0].shape == (n_instances, POP, DIM)
+    # Per-instance best from state must match that instance's history min.
+    hist_min = np.stack([h.min(axis=1) for h in mon.fitness_history]).min(axis=0)
+    np.testing.assert_allclose(
+        np.asarray(states.monitor.topk_fitness[:, 0]), hist_min, rtol=1e-6
+    )
+    # Independent instances: histories must differ across the instance axis.
+    assert not np.allclose(mon.fitness_history[-1][0], mon.fitness_history[-1][1])
+
+
 def test_distributed_eval_parity():
     """Sharded eval over an 8-device mesh must agree with single-device eval
     (deterministic problem, same key)."""
