@@ -26,14 +26,6 @@ from .rollout import RolloutProblem
 
 __all__ = ["BraxProblem"]
 
-try:
-    from brax import envs as brax_envs
-
-    _HAS_BRAX = True
-except ImportError:  # pragma: no cover - optional dependency
-    brax_envs = None
-    _HAS_BRAX = False
-
 
 class BraxProblem(RolloutProblem):
     """Population policy evaluation in a Brax environment."""
@@ -59,11 +51,15 @@ class BraxProblem(RolloutProblem):
         :param reduce_fn: per-individual episode-return reduction.
         :param backend: Brax physics backend (``generalized``/``spring``/...).
         """
-        if not _HAS_BRAX:
+        # Imported lazily (not at module load) so tests can execute this
+        # adapter against a contract mock injected into ``sys.modules``.
+        try:
+            from brax import envs as brax_envs
+        except ImportError as e:
             raise ImportError(
                 "BraxProblem requires the optional `brax` package "
                 "(pip install brax)."
-            )
+            ) from e
         env = (
             brax_envs.get_environment(env_name=env_name)
             if backend is None

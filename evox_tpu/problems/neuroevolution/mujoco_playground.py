@@ -22,14 +22,6 @@ from .rollout import RolloutProblem
 
 __all__ = ["MujocoProblem"]
 
-try:
-    from mujoco_playground import registry as _mjx_registry
-
-    _HAS_MJX = True
-except ImportError:  # pragma: no cover - optional dependency
-    _mjx_registry = None
-    _HAS_MJX = False
-
 
 class MujocoProblem(RolloutProblem):
     """Population policy evaluation in a Mujoco-Playground (MJX) env."""
@@ -50,11 +42,15 @@ class MujocoProblem(RolloutProblem):
         :param max_episode_length: maximum time steps per episode.
         :param num_episodes: episodes per individual.
         """
-        if not _HAS_MJX:
+        # Imported lazily (not at module load) so tests can execute this
+        # adapter against a contract mock injected into ``sys.modules``.
+        try:
+            from mujoco_playground import registry as _mjx_registry
+        except ImportError as e:
             raise ImportError(
                 "MujocoProblem requires the optional `mujoco_playground` "
                 "package (pip install playground)."
-            )
+            ) from e
         env = _mjx_registry.load(env_name)
 
         def _obs_of(raw):
@@ -116,11 +112,15 @@ class MujocoProblem(RolloutProblem):
             if bool(done):
                 break
         fps = kwargs.pop("fps", 1.0 / self._mjx_env.dt)
-        kwargs = {"height": 480, "width": 640, "camera": camera, **kwargs}
-        frames = self._mjx_env.render(trajectory, **kwargs)
-        output_path = f"{output_path}.{output_type}"
+        render_opts = dict(kwargs)
+        render_opts.setdefault("height", 480)
+        render_opts.setdefault("width", 640)
+        render_opts.setdefault("camera", camera)
+        frames = self._mjx_env.render(trajectory, **render_opts)
+        out = f"{output_path}.{output_type}"
         if output_type == "mp4":
-            imageio.mimsave(output_path, frames, fps=fps, codec="libx264", format="mp4")
+            save_opts = {"fps": fps, "codec": "libx264", "format": "mp4"}
         else:
-            imageio.mimsave(output_path, frames, format="gif")
-        return output_path
+            save_opts = {"format": "gif"}
+        imageio.mimsave(out, frames, **save_opts)
+        return out

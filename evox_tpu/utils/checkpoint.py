@@ -56,10 +56,20 @@ def save_state(path: Union[str, Path], state: Any) -> None:
     np.savez(path, **out)
 
 
-def load_state(path: Union[str, Path], like: Any) -> Any:
+def load_state(
+    path: Union[str, Path], like: Any, allow_missing: bool = False
+) -> Any:
     """Load a checkpoint written by :func:`save_state` into the structure of
     ``like`` (a template state with the same shape — e.g. a freshly
-    ``setup()`` state).  Returns a new pytree; ``like`` is unchanged."""
+    ``setup()`` state).  Returns a new pytree; ``like`` is unchanged.
+
+    :param allow_missing: state schemas can gain leaves between versions
+        (e.g. a monitor adding a counter).  With ``allow_missing=True`` a
+        leaf absent from the checkpoint keeps the template's value (with a
+        warning) instead of raising ``KeyError``.
+    """
+    import warnings
+
     data = np.load(path)
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
     new_leaves = []
@@ -74,8 +84,16 @@ def load_state(path: Union[str, Path], like: Any) -> Any:
             if hasattr(leaf, "dtype"):
                 arr = arr.astype(leaf.dtype)
             new_leaves.append(jax.numpy.asarray(arr))
+        elif allow_missing:
+            warnings.warn(
+                f"checkpoint {path} has no entry for state leaf {name!r}; "
+                f"keeping the template value"
+            )
+            new_leaves.append(leaf)
         else:
             raise KeyError(
-                f"checkpoint {path} has no entry for state leaf {name!r}"
+                f"checkpoint {path} has no entry for state leaf {name!r} "
+                f"(pass allow_missing=True to keep the template value for "
+                f"leaves added since the checkpoint was written)"
             )
     return jax.tree_util.tree_unflatten(treedef, new_leaves)

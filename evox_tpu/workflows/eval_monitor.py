@@ -11,9 +11,14 @@ chained through a token tensor to force ordering
 ``jax.experimental.io_callback(ordered=True)`` — the JAX effects system plays
 the token's role.  For vmapped (batched-instance) workflows pass
 ``ordered=False`` and ``num_instances=N``: JAX's batching rule for unordered
-``io_callback`` unrolls it into one host call per batch element in index
-order, and the monitor re-groups each generation's ``N`` consecutive
-per-instance entries so every history item carries a leading instance axis.
+``io_callback`` emits one host call per batch element, and — because
+*unordered* callbacks are explicitly allowed to arrive in any order under
+async dispatch — every payload carries an explicit ``(generation,
+instance_id)`` tag that the host-side accessors re-sort by.  Arrival order is
+never trusted.  Instance ids are assigned by ``StdWorkflow.init(key,
+instance_id=...)`` (e.g. ``jax.vmap(wf.init)(keys, jnp.arange(N))``); without
+them, entries are grouped by generation tag only (arrival order within a
+generation), which is only safe on effectively-synchronous backends.
 """
 
 from __future__ import annotations
@@ -101,21 +106,36 @@ class EvalMonitor(Monitor):
             latest_fitness=empty,
             topk_solutions=empty,
             topk_fitness=empty,
+            generation=jnp.int32(0),
+            # Instance label for history tagging; assigned by
+            # ``StdWorkflow.setup(key, instance_id=...)`` when vmapping.
+            instance_id=jnp.int32(-1),
         )
 
     # -- host side channel --------------------------------------------------
-    def _sink(self, data: jax.Array, data_type: int) -> None:
-        def append(x):
-            __monitor_history__[self._id_][int(data_type)].append(np.asarray(x))
+    def _sink(self, data: jax.Array, data_type: int, state: State, slot: int = 0) -> None:
+        """Stream ``data`` to host history, tagged ``(generation, instance,
+        slot)`` so accessors can re-sort: unordered callbacks carry no
+        delivery-order guarantee (see module docstring)."""
 
-        io_callback(append, None, data, ordered=self.ordered)
+        def append(x, gen, inst):
+            __monitor_history__[self._id_][int(data_type)].append(
+                (int(gen), int(inst), slot, np.asarray(x))
+            )
+
+        io_callback(
+            append, None, data, state.generation, state.instance_id,
+            ordered=self.ordered,
+        )
 
     # -- hooks --------------------------------------------------------------
     def post_ask(self, state: State, population: jax.Array) -> State:
         return state.replace(latest_solution=population)
 
     def pre_tell(self, state: State, fitness: jax.Array) -> State:
-        state = state.replace(latest_fitness=fitness)
+        state = state.replace(
+            latest_fitness=fitness, generation=state.generation + 1
+        )
         if fitness.ndim == 1:
             # Single-objective: maintain running top-k. The first call (empty
             # placeholder state) and later calls are separate traces, so the
@@ -139,35 +159,60 @@ class EvalMonitor(Monitor):
         # Multi-objective: no single top-k; the pareto front is recovered from
         # history on demand (``get_pf``).
         if self.full_sol_history:
-            self._sink(state.latest_solution, HistoryType.SOLUTION)
+            self._sink(state.latest_solution, HistoryType.SOLUTION, state)
         if self.full_fit_history:
-            self._sink(fitness, HistoryType.FITNESS)
+            self._sink(fitness, HistoryType.FITNESS, state)
         return state
 
     def record_auxiliary(self, state: State, aux: dict[str, jax.Array]) -> State:
         if self.full_pop_history:
             if not self.aux_keys:
                 self.aux_keys = list(aux.keys())
-            for k in self.aux_keys:
-                self._sink(aux[k], HistoryType.AUXILIARY)
+            for slot, k in enumerate(self.aux_keys):
+                self._sink(aux[k], HistoryType.AUXILIARY, state, slot=slot)
         return state
 
     # -- history accessors (host side) --------------------------------------
     def _grouped(self, entries: list) -> list:
-        """With a vmapped workflow (``ordered=False``), the unordered
-        ``io_callback`` batching rule delivers one per-instance host call per
-        batch element, in index order; stack each generation's
-        ``num_instances`` consecutive entries back into one batched array."""
+        """Entries are ``(generation, instance, slot, array)`` tuples in
+        arrival order.
+
+        ``ordered=True``: the JAX effects system guarantees arrival order ==
+        program order, so entries are returned as they arrived (this also
+        keeps sequential re-runs of a reused monitor appended end-to-end).
+
+        ``ordered=False``: unordered callbacks may be delivered in any order,
+        so entries are re-sorted by their ``(generation, instance)`` payload
+        tags, then (``num_instances=N``) each generation's ``N`` per-instance
+        entries are stacked into one batched array.  A reused monitor must be
+        ``clear_history()``-ed between runs — duplicate tags are detected and
+        raise rather than silently mis-grouping."""
+        if self.ordered:
+            return [arr for (_, _, _, arr) in entries]
         n = self.num_instances
+        # Untagged entries (instance_id=-1, workflow init'ed without ids)
+        # can't be distinguished — they fall through to the stable-sort
+        # fallback below and are exempt from the duplicate check.
+        tags = [(g, i) for (g, i, _, _) in entries if i != -1]
+        if len(set(tags)) != len(tags):
+            raise RuntimeError(
+                "duplicate (generation, instance) history tags — this "
+                "monitor recorded more than one run; call clear_history() "
+                "(or use a fresh monitor) between unordered/vmapped runs"
+            )
+        # Stable sort: entries without instance ids (-1) keep arrival order
+        # within a generation.
+        entries = sorted(entries, key=lambda e: (e[0], e[1]))
         if not n or n <= 1:
-            return entries
+            return [arr for (_, _, _, arr) in entries]
         assert len(entries) % n == 0, (
             f"history has {len(entries)} entries, not a multiple of "
             f"num_instances={n} — was the workflow actually vmapped over "
             f"{n} instances?"
         )
         return [
-            np.stack(entries[i : i + n]) for i in range(0, len(entries), n)
+            np.stack([arr for (_, _, _, arr) in entries[i : i + n]])
+            for i in range(0, len(entries), n)
         ]
 
     @property
@@ -185,15 +230,14 @@ class EvalMonitor(Monitor):
     @property
     def auxiliary_history(self) -> dict[str, list]:
         raw = __monitor_history__[self._id_][HistoryType.AUXILIARY]
-        n = len(self.aux_keys)
-        if n == 0:
+        if not self.aux_keys:
             return {}
-        # Re-group per-instance entries first (vmapped workflows emit
-        # num_instances consecutive entries per sink call), THEN de-interleave
-        # by aux key: each generation contributes one batched entry per key.
-        grouped = self._grouped(raw)
-        assert len(grouped) % n == 0
-        return {k: grouped[i::n] for i, k in enumerate(self.aux_keys)}
+        # De-interleave by the slot tag (one slot per aux key), then group
+        # each slot's entries by generation/instance like the main histories.
+        return {
+            k: self._grouped([e for e in raw if e[2] == slot])
+            for slot, k in enumerate(self.aux_keys)
+        }
 
     aux_history = auxiliary_history
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ..core import Algorithm, Monitor, Problem, State, Workflow
@@ -101,12 +102,26 @@ class StdWorkflow(Workflow):
                 self.problem = ShardedProblem(self.problem, mesh, pop_axis)
 
     # -- state -------------------------------------------------------------
-    def setup(self, key: jax.Array) -> State:
+    def setup(self, key: jax.Array, instance_id: jax.Array | None = None) -> State:
+        """Build the initial workflow state.
+
+        :param instance_id: optional integer label for this workflow instance,
+            stored in the monitor state and attached to every host-side
+            history payload.  Pass it when vmapping over instances so history
+            grouping does not depend on callback delivery order::
+
+                states = jax.vmap(wf.init)(keys, jnp.arange(n_instances))
+        """
         algo_key, prob_key, mon_key = jax.random.split(key, 3)
+        mon_state = self.monitor.setup(mon_key)
+        if instance_id is not None and "instance_id" in mon_state:
+            mon_state = mon_state.replace(
+                instance_id=jnp.asarray(instance_id, jnp.int32)
+            )
         return State(
             algorithm=self.algorithm.setup(algo_key),
             problem=self.problem.setup(prob_key),
-            monitor=self.monitor.setup(mon_key),
+            monitor=mon_state,
         )
 
     init = setup  # convenience alias

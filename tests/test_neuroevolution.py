@@ -155,13 +155,13 @@ def test_supervised_full_sweep(key):
 
 
 def test_optional_deps_raise_cleanly():
-    from evox_tpu.problems.neuroevolution import BraxProblem, MujocoProblem
-    from evox_tpu.problems.neuroevolution.brax import _HAS_BRAX
-    from evox_tpu.problems.neuroevolution.mujoco_playground import _HAS_MJX
+    import importlib.util
 
-    if not _HAS_BRAX:
+    from evox_tpu.problems.neuroevolution import BraxProblem, MujocoProblem
+
+    if importlib.util.find_spec("brax") is None:
         with pytest.raises(ImportError):
             BraxProblem(lambda p, o: o, "ant", 10)
-    if not _HAS_MJX:
+    if importlib.util.find_spec("mujoco_playground") is None:
         with pytest.raises(ImportError):
             MujocoProblem(lambda p, o: o, "CartpoleBalance", 10)

@@ -1,0 +1,243 @@
+"""Execute the Brax/Mujoco-Playground adapters against contract mocks.
+
+The real ``brax`` / ``mujoco_playground`` packages are not installable in
+this image, so — matching the behavioral surface the reference exercises in
+``/root/reference/unit_test/problems/test_brax.py:49-140`` — these tests
+inject tiny fake modules into ``sys.modules`` that honour the adapters'
+structural contracts:
+
+* mujoco_playground: ``registry.load(name)`` -> env with ``reset``/``step``
+  (dict observations ``{"state": ...}``), ``observation_size`` (dict),
+  ``action_size``, ``dt``, and ``render(trajectory, ...)`` returning RGB
+  frames.  ``MujocoProblem.evaluate`` and ``visualize()`` (writes a real
+  .gif through the installed imageio) both execute for real.
+* brax: ``envs.get_environment(env_name=...)`` -> env with ``reset``/
+  ``step`` (attribute-style states carrying ``obs``/``reward``/``done``/
+  ``pipeline_state``) plus ``brax.io.html.render`` / ``io.image.
+  render_array``.  ``BraxProblem.evaluate`` and both ``visualize`` output
+  types execute for real.
+
+The fake physics is a 2-D point mass driven by the policy's force output —
+pure jnp, so the adapters' ``lax.scan`` rollout path runs unmodified.
+"""
+
+import os
+import sys
+import types
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.problems.neuroevolution import MLPPolicy
+
+OBS = 4
+ACT = 2
+
+
+class _MjxState(NamedTuple):
+    data: jax.Array  # "physics" state the adapter collects per frame
+    obs: dict
+    reward: jax.Array
+    done: jax.Array  # float, like MJX; adapter casts to bool
+
+
+class _FakePlaygroundEnv:
+    """Structural contract of a mujoco_playground env, on point-mass physics."""
+
+    def __init__(self):
+        self.dt = 0.05
+        self.action_size = ACT
+        # Playground reports dict observation sizes for dict observations.
+        self.observation_size = {"state": OBS}
+        self.n_render_calls = 0
+
+    def reset(self, key):
+        pos = jax.random.uniform(key, (2,), minval=-1.0, maxval=1.0)
+        data = jnp.concatenate([pos, jnp.zeros(2)])
+        return _MjxState(
+            data=data,
+            obs={"state": data, "privileged": jnp.zeros(7)},
+            reward=jnp.asarray(0.0),
+            done=jnp.asarray(0.0),
+        )
+
+    def step(self, s, action):
+        pos, vel = s.data[:2], s.data[2:]
+        vel = 0.9 * vel + self.dt * jnp.clip(action, -1.0, 1.0)
+        pos = pos + self.dt * vel
+        data = jnp.concatenate([pos, vel])
+        dist = jnp.linalg.norm(pos)
+        return _MjxState(
+            data=data,
+            obs={"state": data, "privileged": jnp.zeros(7)},
+            reward=-dist,
+            done=(dist > 4.0).astype(jnp.float32),
+        )
+
+    def render(self, trajectory, height=240, width=320, camera=None, **kw):
+        self.n_render_calls += 1
+        assert camera is None or isinstance(camera, str)
+        frames = []
+        for i, data in enumerate(trajectory):
+            frame = np.zeros((height, width, 3), dtype=np.uint8)
+            x = int((float(data[0]) + 2.0) / 4.0 * (width - 1))
+            y = int((float(data[1]) + 2.0) / 4.0 * (height - 1))
+            frame[max(y, 0) % height, max(x, 0) % width] = 255
+            # Distinct per-frame marker so GIF encoders can't collapse
+            # visually identical consecutive frames.
+            frame[0, i % width] = (255, 0, 0)
+            frames.append(frame)
+        return frames
+
+
+class _BraxState(NamedTuple):
+    pipeline_state: jax.Array
+    obs: jax.Array
+    reward: jax.Array
+    done: jax.Array
+
+
+class _FakeBraxEnv:
+    observation_size = OBS
+    action_size = ACT
+    sys = "fake-brax-system"
+
+    def reset(self, key):
+        pos = jax.random.uniform(key, (2,), minval=-1.0, maxval=1.0)
+        q = jnp.concatenate([pos, jnp.zeros(2)])
+        return _BraxState(q, q, jnp.asarray(0.0), jnp.asarray(0.0))
+
+    def step(self, s, action):
+        pos, vel = s.pipeline_state[:2], s.pipeline_state[2:]
+        vel = 0.9 * vel + 0.05 * jnp.clip(action, -1.0, 1.0)
+        pos = pos + 0.05 * vel
+        q = jnp.concatenate([pos, vel])
+        dist = jnp.linalg.norm(pos)
+        return _BraxState(q, q, -dist, (dist > 4.0).astype(jnp.float32))
+
+
+@pytest.fixture
+def fake_playground(monkeypatch):
+    env = _FakePlaygroundEnv()
+    registry = types.SimpleNamespace(load=lambda name: env)
+    mod = types.ModuleType("mujoco_playground")
+    mod.registry = registry
+    monkeypatch.setitem(sys.modules, "mujoco_playground", mod)
+    return env
+
+
+@pytest.fixture
+def fake_brax(monkeypatch):
+    env = _FakeBraxEnv()
+    brax = types.ModuleType("brax")
+    envs_mod = types.ModuleType("brax.envs")
+    envs_mod.get_environment = lambda env_name, backend=None: env
+    io_mod = types.ModuleType("brax.io")
+    html_mod = types.ModuleType("brax.io.html")
+    html_mod.render = lambda sys_, traj: f"<html>{sys_}:{len(traj)}</html>"
+    image_mod = types.ModuleType("brax.io.image")
+    image_mod.render_array = lambda sys_, traj: np.zeros(
+        (len(traj), 8, 8, 3), dtype=np.uint8
+    )
+    io_mod.html, io_mod.image = html_mod, image_mod
+    brax.envs, brax.io = envs_mod, io_mod
+    for name, m in {
+        "brax": brax,
+        "brax.envs": envs_mod,
+        "brax.io": io_mod,
+        "brax.io.html": html_mod,
+        "brax.io.image": image_mod,
+    }.items():
+        monkeypatch.setitem(sys.modules, name, m)
+    return env
+
+
+def _policy_and_pop(n_pop):
+    policy = MLPPolicy((OBS, 8, ACT))
+    keys = jax.random.split(jax.random.key(0), n_pop)
+    pop = jax.vmap(policy.init)(keys)
+    return policy, pop
+
+
+def test_mujoco_problem_evaluate(fake_playground):
+    from evox_tpu.problems.neuroevolution import MujocoProblem
+
+    policy, pop = _policy_and_pop(6)
+    prob = MujocoProblem(policy, "PointMass", max_episode_length=20, num_episodes=2)
+    # Dict observation sizes reduce to the "state" entry.
+    assert prob.env.obs_size == OBS
+    state = prob.setup(jax.random.key(1))
+    fit, state2 = jax.jit(prob.evaluate)(state, pop)
+    assert fit.shape == (6,)
+    assert np.all(np.isfinite(np.asarray(fit)))
+    # maximize_reward=True negates: reward <= 0 so fitness >= 0 here.
+    assert np.all(np.asarray(fit) >= 0.0)
+    # Distinct individuals get distinct fitness.
+    assert len(np.unique(np.asarray(fit))) > 1
+    # rotate_key advanced the state key.
+    assert not np.array_equal(
+        jax.random.key_data(state.key), jax.random.key_data(state2.key)
+    )
+
+
+def test_mujoco_visualize_writes_gif(fake_playground, tmp_path):
+    from evox_tpu.problems.neuroevolution import MujocoProblem
+
+    policy, pop = _policy_and_pop(2)
+    prob = MujocoProblem(policy, "PointMass", max_episode_length=8)
+    state = prob.setup(jax.random.key(2))
+    one = jax.tree.map(lambda x: x[0], pop)
+    out = prob.visualize(
+        state, one, seed=3, output_type="gif",
+        output_path=str(tmp_path / "rollout"),
+    )
+    assert out.endswith(".gif")
+    assert os.path.getsize(out) > 0
+    assert fake_playground.n_render_calls == 1
+    import imageio.v3 as iio
+
+    frames = iio.imread(out, index=None)
+    assert frames.shape[0] == 9  # initial frame + 8 steps
+
+
+def test_brax_problem_evaluate(fake_brax):
+    from evox_tpu.problems.neuroevolution import BraxProblem
+
+    policy, pop = _policy_and_pop(5)
+    prob = BraxProblem(policy, "pointmass", max_episode_length=16)
+    state = prob.setup(jax.random.key(4))
+    fit, _ = jax.jit(prob.evaluate)(state, pop)
+    assert fit.shape == (5,)
+    assert np.all(np.isfinite(np.asarray(fit)))
+    assert len(np.unique(np.asarray(fit))) > 1
+
+
+def test_brax_problem_vmap_hpo_nesting(fake_brax):
+    """The adapter must survive an extra vmap level (HPO-style batching) —
+    the capability the reference warns it lacks (`brax.py:259-263`)."""
+    from evox_tpu.problems.neuroevolution import BraxProblem
+
+    policy, pop = _policy_and_pop(6)
+    # 2 instances x 3 individuals
+    pop2 = jax.tree.map(lambda x: x.reshape((2, 3) + x.shape[1:]), pop)
+    prob = BraxProblem(policy, "pointmass", max_episode_length=8)
+    states = jax.vmap(prob.setup)(jax.random.split(jax.random.key(5), 2))
+    fit, _ = jax.jit(jax.vmap(prob.evaluate))(states, pop2)
+    assert fit.shape == (2, 3)
+    assert np.all(np.isfinite(np.asarray(fit)))
+
+
+def test_brax_visualize_both_outputs(fake_brax):
+    from evox_tpu.problems.neuroevolution import BraxProblem
+
+    policy, pop = _policy_and_pop(2)
+    prob = BraxProblem(policy, "pointmass", max_episode_length=5)
+    state = prob.setup(jax.random.key(6))
+    one = jax.tree.map(lambda x: x[0], pop)
+    html = prob.visualize(state, one, output_type="HTML")
+    assert html.startswith("<html>fake-brax-system:")
+    arr = prob.visualize(state, one, output_type="rgb_array")
+    assert arr.shape[1:] == (8, 8, 3)

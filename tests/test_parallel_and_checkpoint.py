@@ -6,6 +6,7 @@ device CPU mesh) and checkpoint/resume."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from evox_tpu.algorithms import PSO
 from evox_tpu.core import State
@@ -110,3 +111,15 @@ def test_checkpoint_missing_leaf_raises(tmp_path, key):
         assert False, "expected KeyError"
     except KeyError:
         pass
+
+
+def test_checkpoint_allow_missing_keeps_template(tmp_path, key):
+    """Schema evolution: leaves added after a checkpoint was written fall
+    back to the template's value under ``allow_missing=True``."""
+    state = State(a=jnp.zeros(3))
+    save_state(tmp_path / "s.npz", state)
+    bigger = State(a=jnp.full(3, 7.0), b=jnp.ones(2))
+    with pytest.warns(UserWarning, match="keeping the template value"):
+        restored = load_state(tmp_path / "s.npz", bigger, allow_missing=True)
+    np.testing.assert_array_equal(np.asarray(restored.a), np.zeros(3))
+    np.testing.assert_array_equal(np.asarray(restored.b), np.ones(2))

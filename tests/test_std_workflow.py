@@ -137,12 +137,23 @@ def test_vmap_workflow_monitor_unordered():
     )
     wf = _make(monitor=mon)
     keys = jax.random.split(jax.random.key(7), n_instances)
-    states = jax.vmap(wf.init)(keys)
+    states = jax.vmap(wf.init)(keys, jnp.arange(n_instances))
     states = jax.jit(jax.vmap(wf.init_step))(states)
     step = jax.jit(jax.vmap(wf.step))
     for _ in range(n_steps):
         states = step(states)
     jax.block_until_ready(states)
+
+    # Unordered callbacks may be delivered in ANY order; grouping must depend
+    # only on the (generation, instance) payload tags. Simulate an adversarial
+    # delivery order by shuffling the raw host-side entry list in place.
+    import random
+
+    from evox_tpu.workflows.eval_monitor import __monitor_history__
+
+    rng = random.Random(0)
+    for entries in __monitor_history__[mon._id_].values():
+        rng.shuffle(entries)
 
     # In-state results: instance axis on everything.
     assert states.monitor.topk_fitness.shape == (n_instances, 2)
@@ -161,6 +172,26 @@ def test_vmap_workflow_monitor_unordered():
     )
     # Independent instances: histories must differ across the instance axis.
     assert not np.allclose(mon.fitness_history[-1][0], mon.fitness_history[-1][1])
+
+
+def test_unordered_monitor_rejects_reuse_across_runs():
+    """An unordered monitor reused for a second run (generation tags restart)
+    must fail loudly instead of silently mis-grouping (sorted-by-tag grouping
+    cannot distinguish runs)."""
+    mon = EvalMonitor(full_fit_history=True, ordered=False, num_instances=2)
+    wf = _make(monitor=mon)
+    keys = jax.random.split(jax.random.key(11), 2)
+    for _ in range(2):  # two separate runs, no clear_history between
+        states = jax.vmap(wf.init)(keys, jnp.arange(2))
+        states = jax.jit(jax.vmap(wf.init_step))(states)
+        jax.block_until_ready(states)
+    with pytest.raises(RuntimeError, match="clear_history"):
+        _ = mon.fitness_history
+    mon.clear_history()
+    states = jax.vmap(wf.init)(keys, jnp.arange(2))
+    states = jax.jit(jax.vmap(wf.init_step))(states)
+    jax.block_until_ready(states)
+    assert len(mon.fitness_history) == 1
 
 
 def test_distributed_eval_parity():
