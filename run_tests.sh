@@ -1,8 +1,20 @@
 #!/bin/bash
 # CPU-only test runner: bypasses the axon TPU-tunnel sitecustomize hook
 # (single-client relay) so unit tests never claim TPU hardware.
-if [ $# -eq 0 ]; then set -- tests/ -q; fi
+#
+#   ./run_tests.sh              fast lane (deselects @pytest.mark.slow)
+#   ./run_tests.sh --all        everything, incl. the convergence-quality lane
+#   ./run_tests.sh <pytest args>   passthrough
+ARGS=()
+if [ $# -eq 0 ]; then
+  ARGS=(tests/ -q -m "not slow")
+elif [ "$1" = "--all" ]; then
+  shift
+  ARGS=(tests/ -q "$@")
+else
+  ARGS=("$@")
+fi
 exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   _EVOX_TPU_TEST_REEXEC=1 \
-  python -m pytest "$@"
+  python -m pytest "${ARGS[@]}"

@@ -14,18 +14,42 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+# Quality assertions need real generation counts -> whole-program compiles +
+# many steps; they live in the slow lane (run_tests.sh --all / -m slow).
+pytestmark = pytest.mark.slow
+
 from evox_tpu.algorithms import (
+    ARS,
+    ASEBO,
+    CLPSO,
     CMAES,
+    CSO,
     DE,
+    DES,
+    DMSPSOEL,
+    ESMC,
+    FSPSO,
+    ODE,
+    PSO,
     SHADE,
+    SLPSOGS,
+    SLPSOUS,
+    SNES,
+    XNES,
+    CoDE,
+    GuidedES,
     HypE,
     JaDE,
     MOEAD,
+    NoiseReuseES,
     NSGA2,
     NSGA3,
     OpenES,
-    PSO,
+    PersistentES,
     RVEA,
+    RVEAa,
+    SaDE,
+    SeparableNES,
 )
 from evox_tpu.metrics import igd
 from evox_tpu.problems.numerical import CEC2022, DTLZ2, Ackley, Sphere
@@ -117,9 +141,58 @@ DTLZ2_3 = DTLZ2(d=12, m=3)
         (NSGA2, 0.15),  # observed 0.069
         (NSGA3, 0.12),  # observed 0.054
         (RVEA, 0.12),  # observed 0.054
+        (RVEAa, 0.12),  # observed 0.044
         (MOEAD, 0.12),  # observed 0.055
         (HypE, 0.25),  # observed 0.106 (Monte-Carlo HV selection is noisier)
     ],
 )
 def test_moea_igd_dtlz2(algo_cls, threshold):
     assert _igd(algo_cls(100, 3, Z12, O12), DTLZ2_3) < threshold
+
+
+# -- full-library quality sweep ----------------------------------------------
+# Every remaining exported algorithm gets a seeded quality bar (observed
+# seed-42 value in the comment; threshold ~3x so backend-numerics drift
+# doesn't flake, while a broken estimator — which typically lands orders of
+# magnitude off — still fails).
+
+C5_10 = jnp.full(10, 5.0)  # ES center start: f(center)=250 on Sphere
+
+
+@pytest.mark.parametrize(
+    "name,factory,gens,threshold",
+    [
+        # ES family on Sphere D=10 (from f=250 at the start center)
+        ("xnes", lambda: XNES(C5_10, 2.0 * jnp.eye(10)), 100, 5.0),  # 0.64
+        ("sep_nes", lambda: SeparableNES(C5_10, 2.0 * D10), 100, 0.05),  # 1.3e-3
+        ("snes", lambda: SNES(100, C5_10, sigma=2.0), 100, 1e-3),  # 2.5e-6
+        ("des", lambda: DES(100, C5_10), 200, 0.01),  # 2.1e-4
+        ("ars", lambda: ARS(100, C5_10, lr=0.5, sigma=0.1), 200, 10.0),  # 2.96
+        ("asebo", lambda: ASEBO(100, C5_10, lr=0.5, sigma=0.3), 200, 25.0),  # 7.2
+        ("guided_es", lambda: GuidedES(100, C5_10, sigma=0.3, lr=0.5), 200, 0.5),  # 0.014
+        ("persistent_es", lambda: PersistentES(100, C5_10, lr=0.3, sigma=0.3), 200, 2.0),  # 0.18
+        ("noise_reuse_es", lambda: NoiseReuseES(100, C5_10, lr=0.3, sigma=0.3), 200, 2.0),  # 0.35
+        ("esmc", lambda: ESMC(101, C5_10, lr=0.3, sigma=0.3), 200, 2.0),  # 0.24
+        # PSO family on Sphere D=10 in [-10, 10]
+        ("clpso", lambda: CLPSO(100, -10 * D10, 10 * D10), 150, 3.0),  # 0.32
+        ("cso", lambda: CSO(100, -10 * D10, 10 * D10), 150, 0.01),  # 7.4e-5
+        ("dmspsoel", lambda: DMSPSOEL(-10 * D10, 10 * D10, max_iteration=150), 150, 0.1),  # 1.2e-3
+        ("fspso", lambda: FSPSO(100, -10 * D10, 10 * D10), 150, 1e-3),  # 3.2e-7
+        ("slpsogs", lambda: SLPSOGS(100, -10 * D10, 10 * D10), 150, 0.1),  # 7.7e-4
+        ("slpsous", lambda: SLPSOUS(100, -10 * D10, 10 * D10), 150, 1e-3),  # 4.6e-17
+    ],
+)
+def test_es_pso_quality_sphere(name, factory, gens, threshold):
+    assert _best(factory(), Sphere(), gens) < threshold
+
+
+@pytest.mark.parametrize(
+    "name,factory,gens,threshold",
+    [
+        ("ode", lambda: ODE(100, -32 * D10, 32 * D10), 150, 0.5),  # 0.022
+        ("sade", lambda: SaDE(100, -32 * D10, 32 * D10), 150, 0.1),  # 2.7e-5
+        ("code", lambda: CoDE(100, -32 * D10, 32 * D10), 150, 0.1),  # 1.1e-5
+    ],
+)
+def test_de_quality_ackley(name, factory, gens, threshold):
+    assert _best(factory(), Ackley(), gens) < threshold

@@ -219,3 +219,55 @@ def test_grid_sampling():
     assert np.isclose(np.asarray(w).min(), 0.0) and np.isclose(
         np.asarray(w).max(), 1.0
     )
+
+
+def _ref_vec_guided_dense(x, f, v, theta):
+    """Naive dense RVEA selection (the reference's (n, r) APD-matrix
+    formulation, `rvea_selection.py:59-99`) as an oracle for the
+    segment-min production implementation."""
+    n, m = f.shape
+    nv = v.shape[0]
+    obj = f - jnp.nanmin(f, axis=0, keepdims=True)
+    obj = jnp.maximum(obj, 1e-32)
+
+    def cos_sim(a, b):
+        a_n = a / jnp.maximum(jnp.linalg.norm(a, axis=-1, keepdims=True), 1e-12)
+        b_n = b / jnp.maximum(jnp.linalg.norm(b, axis=-1, keepdims=True), 1e-12)
+        return a_n @ b_n.T
+
+    vv = jnp.clip(jnp.where(jnp.eye(nv, dtype=bool), 0.0, cos_sim(v, v)), 0.0, 1.0)
+    gamma = jnp.min(jnp.arccos(vv), axis=1)
+    angle = jnp.arccos(jnp.clip(cos_sim(obj, v), 0.0, 1.0))
+    nan_mask = jnp.isnan(obj).any(axis=1)
+    associate = jnp.where(nan_mask, -1, jnp.argmin(angle, axis=1))
+    mask = associate[:, None] != jnp.arange(nv)[None, :]
+    apd = (1 + m * theta * angle) / gamma[None, :] * jnp.linalg.norm(obj, axis=1)[:, None]
+    apd = jnp.where(mask, jnp.inf, apd)
+    mask_null = jnp.all(mask, axis=0)
+    next_ind = jnp.argmin(apd, axis=0)
+    next_x = jnp.where(mask_null[:, None], jnp.nan, x[next_ind])
+    next_f = jnp.where(mask_null[:, None], jnp.nan, f[next_ind])
+    return next_x, next_f
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_ref_vec_guided_matches_dense_oracle(seed):
+    from evox_tpu.operators.sampling import uniform_sampling
+    from evox_tpu.operators.selection import ref_vec_guided
+
+    key = jax.random.key(seed)
+    k1, k2, k3 = jax.random.split(key, 3)
+    n, m, dim = 120, 3, 7
+    v, nv = uniform_sampling(40, m)
+    x = jax.random.uniform(k1, (n, dim))
+    f = jax.random.uniform(k2, (n, m)) + 0.1
+    # NaN-pad some rows like a mid-run RVEA population has.
+    nan_rows = jax.random.bernoulli(k3, 0.2, (n,))
+    f = jnp.where(nan_rows[:, None], jnp.nan, f)
+    x = jnp.where(nan_rows[:, None], jnp.nan, x)
+    theta = jnp.float32(0.4)
+
+    gx, gf = jax.jit(ref_vec_guided)(x, f, v, theta)
+    ex, ef = _ref_vec_guided_dense(x, f, v, theta)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(ex), rtol=1e-5, equal_nan=True)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(ef), rtol=1e-5, equal_nan=True)
