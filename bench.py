@@ -522,6 +522,11 @@ def run_child(config: str, platform: str, profile: bool) -> dict:
         result = json.load(f)
     result["platform"] = platform
     result["wall_s"] = round(time.perf_counter() - t0, 1)
+    result["n_steps"] = n_steps
+    if platform != "tpu":
+        # Few-step single-core CPU numbers are noise relative to the TPU
+        # targets; mark them so they are never read as baseline data.
+        result["indicative_only"] = True
     return result
 
 
@@ -539,7 +544,14 @@ def _apply_baseline(result: dict, platform: str) -> dict:
     entry = history.get(metric)
     if result.get("value", 0) and platform == "tpu":
         if entry is None:
-            history[metric] = {"baseline": result["value"], "platform": platform}
+            # Record measurement conditions with the baseline so future
+            # vs_baseline deltas can be judged against run-to-run noise.
+            history[metric] = {
+                "baseline": result["value"],
+                "platform": platform,
+                "n_steps": result.get("n_steps"),
+                "n_runs": 1,
+            }
             with open(_HISTORY_PATH, "w") as f:
                 json.dump(history, f, indent=1, sort_keys=True)
             result["vs_baseline"] = 1.0
@@ -559,6 +571,11 @@ def main() -> int:
     p.add_argument("--config", default=None, choices=sorted(CONFIGS))
     p.add_argument("--platform", default="auto", choices=["auto", "tpu", "cpu"])
     p.add_argument("--profile", action="store_true")
+    p.add_argument(
+        "--runs", type=int, default=1,
+        help="repeat each config N times; report the median with min/max "
+        "spread so vs_baseline deltas can be judged against noise",
+    )
     # child-mode internals
     p.add_argument("--child", default=None, help=argparse.SUPPRESS)
     p.add_argument("--steps", type=int, default=None, help=argparse.SUPPRESS)
@@ -603,8 +620,20 @@ def main() -> int:
     results = {}
     for name in configs:
         _log(f"=== {name} ({platform}) ===")
-        results[name] = _apply_baseline(run_child(name, platform, args.profile),
-                                        platform)
+        runs = [
+            run_child(name, platform, args.profile)
+            for _ in range(max(args.runs, 1))
+        ]
+        ok = sorted((r for r in runs if r.get("value", 0)),
+                    key=lambda r: r["value"])
+        result = ok[len(ok) // 2] if ok else runs[0]  # median (else failure)
+        if len(ok) > 1:
+            result["runs"] = {
+                "n": len(ok),
+                "min": ok[0]["value"],
+                "max": ok[-1]["value"],
+            }
+        results[name] = _apply_baseline(result, platform)
         _log(json.dumps(results[name]))
 
     if args.all:
