@@ -78,10 +78,17 @@ def _timed_steps(wf, n_steps: int, warmup: int = 2, profile_dir: str | None = No
 
     if profile_dir:
         os.makedirs(profile_dir, exist_ok=True)
-        # The "torch._dynamo.explain" role: dump the optimized HLO.
-        txt = step.lower(state).compile().as_text()
+        # The "torch._dynamo.explain" role: dump the optimized HLO, plus
+        # XLA's own cost model (flops / bytes accessed) for roofline math.
+        compiled = step.lower(state).compile()
         with open(os.path.join(profile_dir, "step_hlo.txt"), "w") as f:
-            f.write(txt)
+            f.write(compiled.as_text())
+        try:
+            cost = compiled.cost_analysis()
+            with open(os.path.join(profile_dir, "cost_analysis.json"), "w") as f:
+                json.dump({k: v for k, v in sorted(cost.items())}, f, indent=1)
+        except Exception as e:  # cost model coverage varies by backend
+            _log(f"cost_analysis unavailable: {e!r}")
         ctx = jax.profiler.trace(profile_dir)
     else:
         ctx = None
@@ -136,18 +143,13 @@ def bench_pso_northstar(n_steps, profile_dir=None):
     }
 
 
-def bench_pso_northstar_fused(n_steps, profile_dir=None):
-    """Same config, but all generations inside ONE compiled ``lax.fori_loop``
+def _timed_fused(wf, n_steps: int, metric: str) -> dict:
+    """All generations inside ONE compiled ``lax.fori_loop``
     (``StdWorkflow.run``) — zero per-generation dispatch; the TPU-side win
-    the reference cannot express (it pays a compiled-graph launch per step)."""
+    the reference cannot express (it pays a compiled-graph launch per
+    step)."""
     import jax
 
-    from evox_tpu.algorithms import PSO
-    from evox_tpu.problems.numerical import Sphere
-    from evox_tpu.workflows import StdWorkflow
-
-    lb, ub = _box(1000)
-    wf = StdWorkflow(PSO(100_000, lb, ub), Sphere())
     state0 = wf.init(jax.random.key(0))
     run = jax.jit(lambda s: wf.run(s, n_steps))
     jax.block_until_ready(run(state0))  # compile + warm-up run
@@ -155,13 +157,41 @@ def bench_pso_northstar_fused(n_steps, profile_dir=None):
     jax.block_until_ready(run(state0))
     elapsed = time.perf_counter() - t0
     return {
-        "metric": (
-            "PSO generations/sec/chip, fused fori_loop "
-            "(pop=100000, dim=1000, Sphere)"
-        ),
+        "metric": metric,
         "value": round(n_steps / elapsed, 3),
         "unit": "generations/sec",
     }
+
+
+def bench_pso_northstar_fused(n_steps, profile_dir=None):
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    lb, ub = _box(1000)
+    return _timed_fused(
+        StdWorkflow(PSO(100_000, lb, ub), Sphere()),
+        n_steps,
+        "PSO generations/sec/chip, fused fori_loop "
+        "(pop=100000, dim=1000, Sphere)",
+    )
+
+
+def bench_pso_small_fused(n_steps, profile_dir=None):
+    """Small-population fused run: at pop=1024 each per-step dispatch costs
+    more than the on-chip math (bench_pso_small measured 1.9 ms/gen over the
+    tunnel), so folding all generations into ONE compiled ``fori_loop`` is
+    where the zero-dispatch design shows."""
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.problems.numerical import Ackley
+    from evox_tpu.workflows import StdWorkflow
+
+    lb, ub = _box(100, -32.0, 32.0)
+    return _timed_fused(
+        StdWorkflow(PSO(1024, lb, ub), Ackley()),
+        n_steps,
+        "PSO generations/sec/chip, fused fori_loop (pop=1024, dim=100, Ackley)",
+    )
 
 
 def bench_cmaes_cec(n_steps, profile_dir=None):
@@ -381,6 +411,7 @@ def bench_smoke(n_steps, profile_dir=None):
 CONFIGS = {
     "smoke": (bench_smoke, 1, 1),
     "pso_small": (bench_pso_small, 300, 100),
+    "pso_small_fused": (bench_pso_small_fused, 2000, 100),
     "pso_northstar": (bench_pso_northstar, 100, 3),
     "pso_northstar_fused": (bench_pso_northstar_fused, 100, 3),
     "cmaes_cec": (bench_cmaes_cec, 200, 50),
@@ -550,7 +581,12 @@ def _apply_baseline(result: dict, platform: str) -> dict:
                 "baseline": result["value"],
                 "platform": platform,
                 "n_steps": result.get("n_steps"),
-                "n_runs": 1,
+                "n_runs": result.get("runs", {}).get("n_ok", 1),
+                **(
+                    {"spread": [result["runs"]["min"], result["runs"]["max"]]}
+                    if "runs" in result
+                    else {}
+                ),
             }
             with open(_HISTORY_PATH, "w") as f:
                 json.dump(history, f, indent=1, sort_keys=True)
@@ -620,18 +656,18 @@ def main() -> int:
     results = {}
     for name in configs:
         _log(f"=== {name} ({platform}) ===")
-        runs = [
-            run_child(name, platform, args.profile)
-            for _ in range(max(args.runs, 1))
-        ]
+        n_runs = max(args.runs, 1)
+        runs = [run_child(name, platform, args.profile) for _ in range(n_runs)]
         ok = sorted((r for r in runs if r.get("value", 0)),
                     key=lambda r: r["value"])
-        result = ok[len(ok) // 2] if ok else runs[0]  # median (else failure)
-        if len(ok) > 1:
+        # Lower median (conservative for even counts; never the max).
+        result = ok[(len(ok) - 1) // 2] if ok else runs[0]
+        if n_runs > 1:
             result["runs"] = {
-                "n": len(ok),
-                "min": ok[0]["value"],
-                "max": ok[-1]["value"],
+                "n_ok": len(ok),
+                "n_failed": n_runs - len(ok),
+                "min": ok[0]["value"] if ok else 0.0,
+                "max": ok[-1]["value"] if ok else 0.0,
             }
         results[name] = _apply_baseline(result, platform)
         _log(json.dumps(results[name]))
