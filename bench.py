@@ -194,6 +194,48 @@ def bench_pso_small_fused(n_steps, profile_dir=None):
     )
 
 
+def bench_pso_northstar_bf16(n_steps, profile_dir=None):
+    """North-star config in bfloat16: PSO at pop=100k x dim=1000 is HBM-
+    bandwidth-bound (6 population-sized arrays touched per generation), so
+    halving the element size is the single biggest lever the hardware
+    offers.  Fitness accumulation stays f32 (Sphere reduces with an f32
+    accumulator via jnp.sum dtype promotion rules on TPU)."""
+    import jax.numpy as jnp
+
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    lb, ub = _box(1000)
+    wf = StdWorkflow(
+        PSO(100_000, lb.astype(jnp.bfloat16), ub.astype(jnp.bfloat16),
+            dtype=jnp.bfloat16),
+        Sphere(),
+    )
+    gps, _ = _timed_steps(wf, n_steps, profile_dir=profile_dir)
+    return {
+        "metric": (
+            "PSO generations/sec/chip, bf16 (pop=100000, dim=1000, Sphere)"
+        ),
+        "value": round(gps, 3),
+        "unit": "generations/sec",
+    }
+
+
+def bench_pso_northstar_rbg(n_steps, profile_dir=None):
+    """North-star config with JAX's ``rbg`` PRNG: the PSO step draws
+    2 x pop x dim ~= 200M random words per generation, and Threefry (the
+    default) is a long ALU chain per word on the VPU; ``rbg`` uses the
+    TPU's hardware RNG.  Trades bit-exact key-derivation semantics for
+    throughput — measured here to quantify the Threefry tax."""
+    import jax
+
+    jax.config.update("jax_default_prng_impl", "rbg")
+    result = bench_pso_northstar(n_steps, profile_dir=profile_dir)
+    result["metric"] = result["metric"].replace("Sphere", "Sphere, rbg PRNG")
+    return result
+
+
 def bench_cmaes_cec(n_steps, profile_dir=None):
     import jax.numpy as jnp
 
@@ -414,6 +456,8 @@ CONFIGS = {
     "pso_small_fused": (bench_pso_small_fused, 2000, 100),
     "pso_northstar": (bench_pso_northstar, 100, 3),
     "pso_northstar_fused": (bench_pso_northstar_fused, 100, 3),
+    "pso_northstar_rbg": (bench_pso_northstar_rbg, 100, 3),
+    "pso_northstar_bf16": (bench_pso_northstar_bf16, 100, 3),
     "cmaes_cec": (bench_cmaes_cec, 200, 50),
     "de_cec": (bench_de_cec, 200, 20),
     "openes_cec": (bench_openes_cec, 300, 50),
