@@ -656,6 +656,12 @@ def main() -> int:
         help="repeat each config N times; report the median with min/max "
         "spread so vs_baseline deltas can be judged against noise",
     )
+    p.add_argument(
+        "--no-probe", action="store_true",
+        help="with --platform tpu: trust that the TPU is reachable instead "
+        "of probing first (a timed-out probe kill can wedge single-client "
+        "relays; use when a fresh external probe just succeeded)",
+    )
     # child-mode internals
     p.add_argument("--child", default=None, help=argparse.SUPPRESS)
     p.add_argument("--steps", type=int, default=None, help=argparse.SUPPRESS)
@@ -689,7 +695,7 @@ def main() -> int:
             _log("probe: TPU unavailable -> CPU fallback (reduced steps)")
     else:
         platform = args.platform
-        if platform == "tpu" and not probe_tpu():
+        if platform == "tpu" and not args.no_probe and not probe_tpu():
             platform = "cpu"
 
     configs = (
