@@ -10,6 +10,10 @@ the identity because all component methods are already pure.
 from jax import jit, vmap  # re-export: the reference exports compile/vmap
 
 from .components import Algorithm, EvalFn, Monitor, Problem, Workflow
+from .components import _Component as ModuleBase  # reference base-class name:
+# components here are plain static-config objects (all evolving values live
+# in State), so the reference's ``ModuleBase`` (``core/module.py:61-84``)
+# maps to the shared component base.
 from .state import Mutable, Parameter, State, get_params, set_params, use_state
 
 compile = jit  # reference name (``evox.core.compile``)
@@ -19,6 +23,7 @@ __all__ = [
     "Problem",
     "Workflow",
     "Monitor",
+    "ModuleBase",
     "EvalFn",
     "State",
     "Parameter",

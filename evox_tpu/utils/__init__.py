@@ -21,7 +21,7 @@ from .ops import (
 )
 from .checkpoint import load_state, save_state
 from .params_vector import ParamsAndVector
-from .vmap_ops import host_op, register_vmap_op
+from .vmap_ops import VmapInfo, host_op, register_vmap_op
 
 __all__ = [
     "switch",
@@ -44,6 +44,7 @@ __all__ = [
     "load_state",
     "register_vmap_op",
     "host_op",
+    "VmapInfo",
     "tree_flatten",
     "tree_unflatten",
 ]

@@ -20,12 +20,29 @@ In JAX the same needs decompose into two native mechanisms:
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["register_vmap_op", "host_op"]
+__all__ = ["register_vmap_op", "host_op", "VmapInfo"]
+
+
+class VmapInfo(NamedTuple):
+    """Batching metadata handed to custom vmap rules.
+
+    API-parity counterpart of the ``torch._functorch`` ``VmapInfo`` the
+    reference re-exports (``src/evox/utils/op_register.py:4``, consumed by
+    its Brax/MJX custom-op vmap rules at ``brax.py:158``).  In JAX,
+    ``jax.custom_batching.custom_vmap`` passes ``axis_size`` and
+    ``in_batched`` to the rule directly; rules written against this type
+    carry the same two facts (``randomness`` mirrors the functorch field —
+    JAX's explicit keys make every vmapped instance's randomness
+    "different" by construction).
+    """
+
+    batch_size: int
+    randomness: str = "different"
 
 
 def register_vmap_op(vmap_fn: Callable | None = None):
