@@ -1,0 +1,68 @@
+"""Roofline math from bench profile artifacts.
+
+Reads a ``bench_artifacts/profile_<config>/cost_analysis.json`` (written by
+``bench.py --profile``: XLA's own per-program cost model) plus a measured
+generations/sec and prints achieved HBM bandwidth and FLOP throughput
+against the chip's peaks — the analysis VERDICT round 2 asked for
+("turn the north-star into a roofline story").
+
+Usage::
+
+    python tools/roofline.py bench_artifacts/profile_pso_northstar 139.4
+    python tools/roofline.py <profile_dir> <gen_per_sec> [--hbm-gbps 819]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("profile_dir")
+    p.add_argument("gen_per_sec", type=float)
+    p.add_argument(
+        "--hbm-gbps", type=float, default=819.0,
+        help="HBM peak GB/s (819 for the v5 lite chip this box tunnels to)",
+    )
+    p.add_argument(
+        "--peak-tflops", type=float, default=197.0,
+        help="peak TFLOP/s (v5e bf16 MXU ~197; halve for f32)",
+    )
+    args = p.parse_args()
+
+    path = os.path.join(args.profile_dir, "cost_analysis.json")
+    with open(path) as f:
+        cost = json.load(f)
+    bytes_per_gen = cost.get("bytes accessed", 0.0)
+    flops_per_gen = cost.get("flops", 0.0)
+
+    gbps = bytes_per_gen * args.gen_per_sec / 1e9
+    tflops = flops_per_gen * args.gen_per_sec / 1e12
+    out = {
+        "bytes_per_gen": bytes_per_gen,
+        "flops_per_gen": flops_per_gen,
+        "achieved_GBps": round(gbps, 1),
+        "pct_of_hbm_peak": round(100 * gbps / args.hbm_gbps, 1),
+        "achieved_TFLOPs": round(tflops, 2),
+        "pct_of_flop_peak": round(100 * tflops / args.peak_tflops, 1),
+        "arithmetic_intensity_flops_per_byte": round(
+            flops_per_gen / bytes_per_gen, 3
+        ) if bytes_per_gen else None,
+        "bound": (
+            "memory"
+            if bytes_per_gen
+            and (gbps / args.hbm_gbps) > (tflops / args.peak_tflops)
+            else "compute"
+        ),
+    }
+    json.dump(out, sys.stdout, indent=1)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
