@@ -174,6 +174,76 @@ def test_vmap_workflow_monitor_unordered():
     assert not np.allclose(mon.fitness_history[-1][0], mon.fitness_history[-1][1])
 
 
+def test_aux_history_records_algorithm_record_step():
+    """full_pop_history routes Algorithm.record_step dicts to the monitor's
+    auxiliary history, de-interleaved by key (slot tag)."""
+    from evox_tpu.algorithms import OpenES
+
+    mon = EvalMonitor(full_fit_history=False, full_pop_history=True)
+    wf = StdWorkflow(
+        OpenES(32, jnp.zeros(DIM), learning_rate=0.1, noise_stdev=0.5),
+        Sphere(),
+        monitor=mon,
+    )
+    state = wf.init(jax.random.key(3))
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    n_steps = 3
+    for _ in range(n_steps):
+        state = step(state)
+    jax.block_until_ready(state)
+    aux = mon.aux_history
+    assert list(aux) == ["center"]  # OpenES record_step key
+    assert len(aux["center"]) == n_steps + 1
+    assert aux["center"][0].shape == (DIM,)
+    # The recorded trajectory is the evolving ES center, ending at the
+    # current state's center.
+    np.testing.assert_allclose(
+        np.asarray(aux["center"][-1]), np.asarray(state.algorithm.center)
+    )
+
+
+def test_aux_history_vmapped_unordered():
+    """Aux history under a vmapped workflow: slot + (gen, instance) tags
+    reconstruct per-key, per-generation batched entries even if delivery
+    order is adversarial."""
+    import random
+
+    from evox_tpu.algorithms import OpenES
+    from evox_tpu.workflows.eval_monitor import __monitor_history__
+
+    n_instances, n_steps = 3, 2
+    mon = EvalMonitor(
+        full_fit_history=False,
+        full_pop_history=True,
+        ordered=False,
+        num_instances=n_instances,
+    )
+    wf = StdWorkflow(
+        OpenES(32, jnp.zeros(DIM), learning_rate=0.1, noise_stdev=0.5),
+        Sphere(),
+        monitor=mon,
+    )
+    keys = jax.random.split(jax.random.key(4), n_instances)
+    states = jax.vmap(wf.init)(keys, jnp.arange(n_instances))
+    states = jax.jit(jax.vmap(wf.init_step))(states)
+    step = jax.jit(jax.vmap(wf.step))
+    for _ in range(n_steps):
+        states = step(states)
+    jax.block_until_ready(states)
+
+    rng = random.Random(1)
+    for entries in __monitor_history__[mon._id_].values():
+        rng.shuffle(entries)
+
+    aux = mon.aux_history
+    assert len(aux["center"]) == n_steps + 1
+    assert aux["center"][0].shape == (n_instances, DIM)
+    np.testing.assert_allclose(
+        np.asarray(aux["center"][-1]), np.asarray(states.algorithm.center)
+    )
+
+
 def test_unordered_monitor_rejects_reuse_across_runs():
     """An unordered monitor reused for a second run (generation tags restart)
     must fail loudly instead of silently mis-grouping (sorted-by-tag grouping
