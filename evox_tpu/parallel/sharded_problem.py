@@ -41,8 +41,12 @@ class ShardedProblem(Problem):
 
     def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
         n_shards = self.mesh.shape[self.axis_name]
-        assert pop.shape[0] % n_shards == 0, (
-            f"population size {pop.shape[0]} must divide over the "
+        # The population may be a pytree (e.g. policy-parameter dicts with a
+        # leading pop axis, as neuroevolution problems consume); the P(axis)
+        # in_spec below is a pytree prefix, sharding every leaf's axis 0.
+        pop_size = jax.tree.leaves(pop)[0].shape[0]
+        assert pop_size % n_shards == 0, (
+            f"population size {pop_size} must divide over the "
             f"{n_shards}-way '{self.axis_name}' mesh axis"
         )
         axis = self.axis_name
