@@ -143,7 +143,7 @@ def bench_pso_northstar(n_steps, profile_dir=None):
     }
 
 
-def _timed_fused(wf, n_steps: int, metric: str) -> dict:
+def _timed_fused(wf, n_steps: int, metric: str, profile_dir=None) -> dict:
     """All generations inside ONE compiled ``lax.fori_loop``
     (``StdWorkflow.run``) — zero per-generation dispatch; the TPU-side win
     the reference cannot express (it pays a compiled-graph launch per
@@ -152,6 +152,20 @@ def _timed_fused(wf, n_steps: int, metric: str) -> dict:
 
     state0 = wf.init(jax.random.key(0))
     run = jax.jit(lambda s: wf.run(s, n_steps))
+    if profile_dir:
+        os.makedirs(profile_dir, exist_ok=True)
+        compiled = run.lower(state0).compile()
+        with open(os.path.join(profile_dir, "run_hlo.txt"), "w") as f:
+            f.write(compiled.as_text())
+        try:
+            cost = compiled.cost_analysis()
+            with open(os.path.join(profile_dir, "cost_analysis.json"), "w") as f:
+                # Whole-program costs; divide by n_steps for per-generation.
+                json.dump(
+                    {"n_steps": n_steps, **dict(sorted(cost.items()))}, f, indent=1
+                )
+        except Exception as e:
+            _log(f"cost_analysis unavailable: {e!r}")
     jax.block_until_ready(run(state0))  # compile + warm-up run
     t0 = time.perf_counter()
     jax.block_until_ready(run(state0))
@@ -174,6 +188,7 @@ def bench_pso_northstar_fused(n_steps, profile_dir=None):
         n_steps,
         "PSO generations/sec/chip, fused fori_loop "
         "(pop=100000, dim=1000, Sphere)",
+        profile_dir=profile_dir,
     )
 
 
@@ -191,6 +206,7 @@ def bench_pso_small_fused(n_steps, profile_dir=None):
         StdWorkflow(PSO(1024, lb, ub), Ackley()),
         n_steps,
         "PSO generations/sec/chip, fused fori_loop (pop=1024, dim=100, Ackley)",
+        profile_dir=profile_dir,
     )
 
 
