@@ -78,8 +78,11 @@ class Algorithm(_Component):
         return self.step(state, evaluate)
 
     def record_step(self, state: State) -> dict[str, Any]:
-        del state
-        return {}
+        """Auxiliary values handed to ``Monitor.record_auxiliary`` each step.
+        Default mirrors the reference (``components.py:48-50``): the current
+        population and fitness, when the state carries them under the
+        conventional names."""
+        return {k: state[k] for k in ("pop", "fit") if k in state}
 
 
 class Problem(_Component):

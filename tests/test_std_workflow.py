@@ -203,6 +203,24 @@ def test_aux_history_records_algorithm_record_step():
     )
 
 
+def test_aux_history_default_pop_fit():
+    """The default Algorithm.record_step feeds {pop, fit} to the monitor
+    (reference components.py:48-50), enabling plot(source='pop')."""
+    mon = EvalMonitor(full_fit_history=False, full_pop_history=True)
+    wf = _make(monitor=mon)
+    state = wf.init(jax.random.key(6))
+    state = jax.jit(wf.init_step)(state)
+    state = jax.jit(wf.step)(state)
+    jax.block_until_ready(state)
+    aux = mon.aux_history
+    assert sorted(aux) == ["fit", "pop"]
+    assert aux["pop"][0].shape == (POP, DIM)
+    assert aux["fit"][0].shape == (POP,)
+    np.testing.assert_allclose(
+        np.asarray(aux["fit"][-1]), np.asarray(state.algorithm.fit)
+    )
+
+
 def test_aux_history_vmapped_unordered():
     """Aux history under a vmapped workflow: slot + (gen, instance) tags
     reconstruct per-key, per-generation batched entries even if delivery
