@@ -56,3 +56,7 @@ class ParamsAndVector:
 
     def __call__(self, vectors: jax.Array) -> Any:
         return self.batched_to_params(vectors)
+
+    # Reference name (its nn.Module ``forward``, ``parameters_and_vector.
+    # py:94-97``): the adapter plugs in as a solution_transform directly.
+    forward = batched_to_params

@@ -164,6 +164,16 @@ class EvalMonitor(Monitor):
             self._sink(fitness, HistoryType.FITNESS, state)
         return state
 
+    def record_history(self, state: State) -> State:
+        """Manually flush the latest solution/fitness to host history
+        (reference ``eval_monitor.py:243-251``; the automatic path does this
+        inside :meth:`pre_tell`)."""
+        if self.full_sol_history:
+            self._sink(state.latest_solution, HistoryType.SOLUTION, state)
+        if self.full_fit_history:
+            self._sink(state.latest_fitness, HistoryType.FITNESS, state)
+        return state
+
     def record_auxiliary(self, state: State, aux: dict[str, jax.Array]) -> State:
         if self.full_pop_history:
             if not self.aux_keys:

@@ -126,6 +126,15 @@ class StdWorkflow(Workflow):
 
     init = setup  # convenience alias
 
+    def get_submodule(self, target: str):
+        """Dotted-path component lookup (reference ``std_workflow.py:133``,
+        an ``nn.Module`` passthrough there): e.g. ``"algorithm"``,
+        ``"problem"``, ``"monitor"``."""
+        obj = self
+        for part in target.split("."):
+            obj = getattr(obj, part)
+        return obj
+
     # -- evaluation pipeline ----------------------------------------------
     def _problem_eval(self, prob_state: State, pop: Any) -> tuple[jax.Array, State]:
         return self.problem.evaluate(prob_state, pop)

@@ -43,6 +43,39 @@ REDESIGNED = {
 }
 
 
+def _ref_methods(rel: str, cls_name: str) -> list[str]:
+    tree = ast.parse((REF / rel).read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            return sorted(
+                n.name
+                for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and not n.name.startswith("_")
+            )
+    raise AssertionError(f"class {cls_name} not in reference {rel}")
+
+
+@pytest.mark.parametrize(
+    "rel,cls_path",
+    [
+        ("workflows/eval_monitor.py", "evox_tpu.workflows:EvalMonitor"),
+        ("workflows/std_workflow.py", "evox_tpu.workflows:StdWorkflow"),
+        ("problems/hpo_wrapper.py", "evox_tpu.problems.hpo_wrapper:HPOProblemWrapper"),
+        ("utils/parameters_and_vector.py", "evox_tpu.utils:ParamsAndVector"),
+    ],
+)
+def test_reference_method_surface_covered(rel, cls_path):
+    import importlib
+
+    mod_name, cls_name = cls_path.split(":")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    missing = [
+        m for m in _ref_methods(rel, cls_name) if not hasattr(cls, m)
+    ]
+    assert not missing, f"{cls_path} lacks reference methods {missing}"
+
+
 @pytest.mark.parametrize(
     "rel,mod_name",
     [
