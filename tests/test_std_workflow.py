@@ -311,6 +311,21 @@ def test_multigeneration_run():
     assert jnp.all(jnp.isfinite(out.algorithm.fit))
 
 
+def test_multigeneration_run_with_monitor():
+    """Monitor side-channel (ordered io_callback) composes with the fused
+    fori_loop driver: one history entry per generation, top-k intact."""
+    n_gens = 5
+    mon = EvalMonitor(topk=2, full_fit_history=True)
+    wf = _make(monitor=mon)
+    state = wf.init(jax.random.key(8))
+    out = jax.jit(lambda s: wf.run(s, n_gens))(state)
+    jax.block_until_ready(out)
+    assert len(mon.fitness_history) == n_gens
+    best = float(mon.get_best_fitness(out.monitor))
+    hist_min = min(float(np.min(h)) for h in mon.fitness_history)
+    assert best == pytest.approx(hist_min)
+
+
 def test_distributed_divisibility_error():
     with pytest.raises(ValueError, match="divisible"):
         StdWorkflow(PSO(POP + 1, LB, UB), Sphere(), enable_distributed=True)
