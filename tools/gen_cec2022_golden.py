@@ -1,0 +1,401 @@
+"""Generate ``tests/cec2022_golden.json`` — the CEC2022 oracle values.
+
+This is the *independent* oracle the test lane checks ``evox_tpu`` against
+(the role the vendored third-party implementation plays in the reference:
+``unit_test/problems/CEC2022_by_P_N_Suganthan.py`` backing
+``unit_test/problems/test_cec2022.py``).  It is written in pure NumPy
+float64, per-row / loop-style following the official suite's C-code
+structure — deliberately sharing no code with the vectorized jnp spec-table
+implementation in ``evox_tpu/problems/numerical/cec2022.py`` — so agreement
+between the two is evidence of fidelity, not self-consistency.
+
+Probe points per dimension: the origin, a constant 50-vector, and three
+seeded uniform draws in the [-100, 100] search box (seed below).  Running
+this script twice produces byte-identical output::
+
+    python tools/gen_cec2022_golden.py          # rewrite the golden file
+    python tools/gen_cec2022_golden.py --check  # verify the file matches
+
+Data files (shift vectors, rotation matrices, shuffle indices) are the
+official competition distribution in
+``evox_tpu/problems/numerical/cec2022_input_data/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_DATA_DIR = os.path.join(
+    _REPO, "evox_tpu", "problems", "numerical", "cec2022_input_data"
+)
+_GOLDEN_PATH = os.path.join(_REPO, "tests", "cec2022_golden.json")
+
+_SEED = 20220612  # documented: the suite's LNCS publication date
+_DIMS = (2, 10, 20)
+
+
+# ---------------------------------------------------------------------------
+# Basic functions — scalar per row, official C-code structure.
+# ---------------------------------------------------------------------------
+
+def zakharov(z):
+    s1 = sum(zi * zi for zi in z)
+    s2 = sum(0.5 * (i + 1) * zi for i, zi in enumerate(z))
+    return s1 + s2**2 + s2**4
+
+
+def rosenbrock(z):
+    total = 0.0
+    for i in range(len(z) - 1):
+        a = z[i] + 1.0
+        b = z[i + 1] + 1.0
+        total += 100.0 * (a * a - b) ** 2 + (a - 1.0) ** 2
+    return total
+
+
+def schaffer_f7(z):
+    acc = 0.0
+    for i in range(len(z) - 1):
+        s = math.hypot(z[i], z[i + 1])
+        t = math.sin(50.0 * s**0.2)
+        acc += math.sqrt(s) * (1.0 + t * t)
+    f = acc / (len(z) - 1)
+    return f * f
+
+
+def rastrigin(z):
+    return sum(zi * zi - 10.0 * math.cos(2.0 * math.pi * zi) + 10.0 for zi in z)
+
+
+def levy(z):
+    w = [1.0 + zi / 4.0 for zi in z]
+    total = math.sin(math.pi * w[0]) ** 2
+    for wi in w[:-1]:
+        total += (wi - 1.0) ** 2 * (1.0 + 10.0 * math.sin(math.pi * wi + 1.0) ** 2)
+    total += (w[-1] - 1.0) ** 2 * (1.0 + math.sin(2.0 * math.pi * w[-1]) ** 2)
+    return total
+
+
+def bent_cigar(z):
+    return z[0] * z[0] + sum(1e6 * zi * zi for zi in z[1:])
+
+
+def hgbat(z):
+    t = [zi - 1.0 for zi in z]
+    r2 = sum(ti * ti for ti in t)
+    sx = sum(t)
+    return abs(r2 * r2 - sx * sx) ** 0.5 + (0.5 * r2 + sx) / len(z) + 0.5
+
+
+def katsuura(z):
+    nx = len(z)
+    f = 1.0
+    for i, zi in enumerate(z):
+        temp = 0.0
+        for j in range(1, 33):
+            p = 2.0**j
+            temp += abs(zi * p - round(zi * p)) / p
+        f *= (1.0 + (i + 1) * temp) ** (10.0 / nx**1.2)
+    scale = 10.0 / (nx * nx)
+    return f * scale - scale
+
+
+def ackley(z):
+    nx = len(z)
+    s1 = sum(zi * zi for zi in z) / nx
+    s2 = sum(math.cos(2.0 * math.pi * zi) for zi in z) / nx
+    return (
+        math.e - 20.0 * math.exp(-0.2 * math.sqrt(s1)) - math.exp(s2) + 20.0
+    )
+
+
+def schwefel(z):
+    nx = len(z)
+    total = 0.0
+    for zi in z:
+        y = zi + 4.209687462275036e2
+        if y > 500.0:
+            total += (500.0 - math.fmod(y, 500.0)) * math.sin(
+                math.sqrt(abs(500.0 - math.fmod(y, 500.0)))
+            )
+            total -= (y - 500.0) ** 2 / (10000.0 * nx)
+        elif y < -500.0:
+            total += (math.fmod(abs(y), 500.0) - 500.0) * math.sin(
+                math.sqrt(abs(math.fmod(abs(y), 500.0) - 500.0))
+            )
+            total -= (y + 500.0) ** 2 / (10000.0 * nx)
+        else:
+            total += y * math.sin(math.sqrt(abs(y)))
+    return 4.189828872724338e2 * nx - total
+
+
+def escaffer6(z):
+    total = 0.0
+    nx = len(z)
+    for i in range(nx):
+        a, b = z[i], z[(i + 1) % nx]
+        s = a * a + b * b
+        t = math.sin(math.sqrt(s)) ** 2
+        total += 0.5 + (t - 0.5) / (1.0 + 0.001 * s) ** 2
+    return total
+
+
+def happycat(z):
+    nx = len(z)
+    t = [zi - 1.0 for zi in z]
+    r2 = sum(ti * ti for ti in t)
+    sx = sum(t)
+    return abs(r2 - nx) ** 0.25 + (0.5 * r2 + sx) / nx + 0.5
+
+
+def grie_rosen(z):
+    nx = len(z)
+    total = 0.0
+    for i in range(nx):
+        a = z[i] + 1.0
+        b = z[(i + 1) % nx] + 1.0
+        t = 100.0 * (a * a - b) ** 2 + (a - 1.0) ** 2
+        total += t * t / 4000.0 - math.cos(t) + 1.0
+    return total
+
+
+def griewank(z):
+    s = sum(zi * zi for zi in z) / 4000.0
+    p = 1.0
+    for i, zi in enumerate(z):
+        p *= math.cos(zi / math.sqrt(i + 1.0))
+    return 1.0 + s - p
+
+
+def discus(z):
+    return 1e6 * z[0] * z[0] + sum(zi * zi for zi in z[1:])
+
+
+def ellips(z):
+    nx = len(z)
+    return sum(10.0 ** (6.0 * i / (nx - 1)) * zi * zi for i, zi in enumerate(z))
+
+
+# ---------------------------------------------------------------------------
+# Suite definition (official): shift/rotate, hybrids, compositions.
+# ---------------------------------------------------------------------------
+
+def _load_m(fn, d):
+    m = np.loadtxt(os.path.join(_DATA_DIR, f"M_{fn}_D{d}.txt"), dtype=np.float64)
+    return m.reshape(-1, d)  # (d, d) or (cf_num*d, d), official row-major
+
+
+def _load_shift(fn):
+    return np.loadtxt(
+        os.path.join(_DATA_DIR, f"shift_data_{fn}.txt"), dtype=np.float64
+    )
+
+
+def _load_shuffle(fn, d):
+    ss = np.loadtxt(
+        os.path.join(_DATA_DIR, f"shuffle_data_{fn}_D{d}.txt"), dtype=np.int64
+    )
+    return ss - 1  # 0-based
+
+
+def _sr(x, shift, rate, m=None):
+    """Official ``sr_func``: shift, shrink, rotate (y = M z, z column)."""
+    z = (np.asarray(x, dtype=np.float64) - shift) * rate
+    return m @ z if m is not None else z
+
+
+_SIMPLE = {
+    1: (zakharov, 1.0, 300.0),
+    2: (rosenbrock, 2.048 / 100.0, 400.0),
+    3: (schaffer_f7, 1.0, 600.0),
+    4: (rastrigin, 5.12 / 100.0, 800.0),
+    5: (levy, 1.0, 900.0),
+}
+
+_HYBRID = {
+    6: (
+        [0.4, 0.4, 0.2],
+        [(bent_cigar, 1.0), (hgbat, 5.0 / 100.0), (rastrigin, 5.12 / 100.0)],
+        1800.0,
+    ),
+    7: (
+        [0.1, 0.2, 0.2, 0.2, 0.1, 0.2],
+        [
+            (hgbat, 5.0 / 100.0),
+            (katsuura, 5.0 / 100.0),
+            (ackley, 1.0),
+            (rastrigin, 5.12 / 100.0),
+            (schwefel, 10.0),
+            (schaffer_f7, 1.0),
+        ],
+        2000.0,
+    ),
+    8: (
+        [0.3, 0.2, 0.2, 0.1, 0.2],
+        [
+            (katsuura, 5.0 / 100.0),
+            (happycat, 5.0 / 100.0),
+            (grie_rosen, 5.0 / 100.0),
+            (schwefel, 10.0),
+            (ackley, 1.0),
+        ],
+        2200.0,
+    ),
+}
+
+_COMPOSITION = {
+    9: (
+        [10, 20, 30, 40, 50],
+        [0, 200, 300, 100, 400],
+        [
+            (rosenbrock, 2.048 / 100.0, True, 1.0),
+            (ellips, 1.0, True, 1e-6),
+            (bent_cigar, 1.0, True, 1e-26),
+            (discus, 1.0, True, 1e-6),
+            (ellips, 1.0, False, 1e-6),
+        ],
+        2300.0,
+    ),
+    10: (
+        [20, 10, 10],
+        [0, 200, 100],
+        [
+            (schwefel, 10.0, False, 1.0),
+            (rastrigin, 5.12 / 100.0, True, 1.0),
+            (hgbat, 5.0 / 100.0, True, 1.0),
+        ],
+        2400.0,
+    ),
+    11: (
+        [20, 20, 30, 30, 20],
+        [0, 200, 300, 400, 200],
+        [
+            (escaffer6, 1.0, True, 5e-4),
+            (schwefel, 10.0, True, 1.0),
+            (griewank, 6.0, True, 10.0),
+            (rosenbrock, 2.048 / 100.0, True, 1.0),
+            (rastrigin, 5.12 / 100.0, True, 10.0),
+        ],
+        2600.0,
+    ),
+    12: (
+        [10, 20, 30, 40, 50, 60],
+        [0, 300, 500, 100, 400, 200],
+        [
+            (hgbat, 5.0 / 100.0, True, 10.0),
+            (rastrigin, 5.12 / 100.0, True, 10.0),
+            (schwefel, 10.0, True, 2.5),
+            (bent_cigar, 1.0, True, 1e-26),
+            (ellips, 1.0, True, 1e-6),
+            (escaffer6, 1.0, True, 5e-4),
+        ],
+        2700.0,
+    ),
+}
+
+
+def evaluate(fn_num, d, x):
+    """Oracle value of CEC2022 F``fn_num`` at one point ``x`` (length d)."""
+    x = np.asarray(x, dtype=np.float64)
+    if fn_num in _SIMPLE:
+        f, rate, bias = _SIMPLE[fn_num]
+        m = _load_m(fn_num, d)
+        shift = np.ravel(_load_shift(fn_num))[:d]
+        return f(_sr(x, shift, rate, m)) + bias
+    if fn_num in _HYBRID:
+        fractions, parts, bias = _HYBRID[fn_num]
+        m = _load_m(fn_num, d)
+        shift = np.ravel(_load_shift(fn_num))[:d]
+        ss = _load_shuffle(fn_num, d)[:d]
+        z = _sr(x, shift, 1.0, m)[ss]
+        sizes = [math.ceil(g * d) for g in fractions]
+        sizes[-1] = d - sum(sizes[:-1])
+        total, off = bias, 0
+        for (f, rate), size in zip(parts, sizes):
+            total += f(z[off : off + size] * rate)
+            off += size
+        return total
+    sigmas, biases, parts, f_bias = _COMPOSITION[fn_num]
+    m_all = _load_m(fn_num, d)
+    shift_all = _load_shift(fn_num).reshape(10, -1)
+    vals, ws = [], []
+    exact_idx = None
+    for i, ((f, rate, rotate, scale), sigma, b) in enumerate(
+        zip(parts, sigmas, biases)
+    ):
+        shift_i = shift_all[i, :d]
+        m_i = m_all[i * d : (i + 1) * d] if rotate else None
+        vals.append(f(_sr(x, shift_i, rate, m_i)) * scale + b)
+        diff2 = float(np.sum((x - shift_i) ** 2))
+        if diff2 == 0.0 and exact_idx is None:
+            exact_idx = i
+        ws.append(
+            math.exp(-diff2 / (2.0 * d * sigma * sigma)) / math.sqrt(diff2)
+            if diff2 > 0.0
+            else 0.0
+        )
+    if exact_idx is not None:
+        # Landing exactly on a component's shift selects it outright — the
+        # finite limit of the inf/inf weight form.
+        return vals[exact_idx] + f_bias
+    w_sum = sum(ws)
+    if w_sum == 0.0:
+        w_sum = 1e-9
+    return sum(w * v for w, v in zip(ws, vals)) / w_sum + f_bias
+
+
+# ---------------------------------------------------------------------------
+# Probe points + file IO.
+# ---------------------------------------------------------------------------
+
+def probe_points(d):
+    rng = np.random.default_rng(_SEED + d)
+    rows = [np.zeros(d), np.full(d, 50.0)]
+    rows += [rng.uniform(-100.0, 100.0, size=d) for _ in range(3)]
+    return np.stack(rows)
+
+
+def build():
+    inputs = {str(d): probe_points(d).tolist() for d in _DIMS}
+    golden = {}
+    for fn_num in range(1, 13):
+        for d in _DIMS:
+            if fn_num in (6, 7, 8) and d == 2:
+                continue  # undefined in the official suite
+            pts = np.asarray(inputs[str(d)], dtype=np.float64)
+            golden[f"{fn_num}_{d}"] = [evaluate(fn_num, d, p) for p in pts]
+    return {
+        "generator": "tools/gen_cec2022_golden.py",
+        "seed": _SEED,
+        "inputs": inputs,
+        "golden": golden,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true", help="verify, don't write")
+    args = ap.parse_args()
+    data = build()
+    text = json.dumps(data, indent=1, sort_keys=True) + "\n"
+    if args.check:
+        with open(_GOLDEN_PATH) as f:
+            on_disk = f.read()
+        if on_disk != text:
+            raise SystemExit("cec2022_golden.json does NOT match the generator")
+        print("cec2022_golden.json reproduces byte-identically")
+        return
+    with open(_GOLDEN_PATH, "w") as f:
+        f.write(text)
+    print(f"wrote {_GOLDEN_PATH} ({len(data['golden'])} cases)")
+
+
+if __name__ == "__main__":
+    main()
