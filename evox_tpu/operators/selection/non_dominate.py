@@ -10,7 +10,8 @@ runs until all batch members converge), so no registration machinery exists.
 
 The O(n²m) dominance matrix is the hot spot for large populations (SURVEY
 §2.3 ⚠); ``evox_tpu.ops.dominance`` provides a Pallas blocked kernel used
-automatically above a size threshold.
+automatically above a size threshold when the ``EVOX_TPU_PALLAS`` runtime
+gate is open (see ``evox_tpu.ops.pallas_gate``).
 """
 
 from __future__ import annotations
@@ -68,17 +69,31 @@ def non_dominate_rank(f: jax.Array) -> jax.Array:
     return rank
 
 
-def _dominance_matrix(f: jax.Array) -> jax.Array:
-    """Dominance matrix via XLA's fused broadcast-compare.
+def _pallas_min_pop() -> int:
+    import os
 
-    A Pallas blocked-tile kernel exists as reference code
-    (``evox_tpu.ops.dominance``, interpret-mode tested) but is deliberately
-    NOT dispatched here: Pallas/Mosaic compilation is not supported on every
-    TPU attachment (a ``pallas_call`` over this box's remote tunnel hung the
-    single-client relay for >15 min), and the XLA path measured 38 gen/s on
-    the NSGA-II pop=10k north-star — call ``dominance_matrix`` explicitly if
-    your attachment supports Mosaic and the O(n²m) broadcast shows up in
-    profiles."""
+    return int(os.environ.get("EVOX_TPU_PALLAS_MIN_POP", "4096"))
+
+
+def _dominance_matrix(f: jax.Array) -> jax.Array:
+    """Dominance matrix: XLA's fused broadcast-compare by default; the Pallas
+    blocked-tile kernel (``evox_tpu.ops.dominance``) when the runtime gate is
+    open and the population is large enough for tiling to pay.
+
+    The gate (``evox_tpu.ops.pallas_gate``, ``EVOX_TPU_PALLAS`` env var with
+    a one-shot subprocess capability probe) exists because Pallas/Mosaic is
+    not supported on every TPU attachment — a ``pallas_call`` over this
+    box's remote tunnel hung the single-client relay for >15 min — so the
+    kernel must never dispatch unless the attachment is known-good.  Below
+    ``EVOX_TPU_PALLAS_MIN_POP`` (default 4096) the broadcast path wins on
+    fusion alone and is always used."""
+    if f.ndim == 2 and f.shape[0] >= _pallas_min_pop():
+        from ...ops.pallas_gate import pallas_enabled
+
+        if pallas_enabled():
+            from ...ops.dominance import dominance_matrix
+
+            return dominance_matrix(f)
     return dominate_relation(f, f)
 
 

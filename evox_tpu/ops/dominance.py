@@ -50,10 +50,13 @@ def dominance_matrix(
         interpret = jax.default_backend() != "tpu"
     bs = min(block_size, n)
     n_pad = -(-n // bs) * bs
-    # (m, n) layout: the population axis is the 128-lane axis.
-    xt = jnp.pad(
-        f.T.astype(jnp.float32), ((0, 0), (0, n_pad - n)), constant_values=jnp.inf
-    )
+    # (m, n) layout: the population axis is the 128-lane axis.  The input
+    # dtype is preserved for floats (downcasting would let the gated kernel
+    # rank differently from the broadcast path under x64); non-float inputs
+    # compare as f32.
+    if not jnp.issubdtype(f.dtype, jnp.floating):
+        f = f.astype(jnp.float32)
+    xt = jnp.pad(f.T, ((0, 0), (0, n_pad - n)), constant_values=jnp.inf)
     out = pl.pallas_call(
         functools.partial(_dominance_kernel, n_obj=m),
         grid=(n_pad // bs, n_pad // bs),

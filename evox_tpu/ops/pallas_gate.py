@@ -1,0 +1,211 @@
+"""Runtime gate for dispatching Pallas/Mosaic kernels.
+
+Pallas compilation is not supported on every TPU attachment: on this
+project's remote-tunnel (axon relay) attachment, a ``pallas_call`` hung the
+single-client relay for >15 minutes (observed 2026-07-29; see
+``.claude/skills/verify/SKILL.md``).  The library therefore never dispatches
+a Pallas kernel unless the gate opens:
+
+* ``EVOX_TPU_PALLAS`` unset / ``"0"`` — gate closed (default; XLA paths).
+* ``EVOX_TPU_PALLAS=probe`` — open iff a cached capability-probe verdict for
+  the CURRENT backend says Pallas works.  The probe itself is **explicit**::
+
+      python -m evox_tpu.ops.pallas_gate   # run the probe, cache verdict
+
+  It runs a tiny ``pallas_call`` in a fresh subprocess with a hard timeout
+  and caches the verdict (pass / fail / timeout, keyed by backend) at
+  :data:`PROBE_RECORD_PATH`.  The probe is NOT run lazily from inside a
+  trace: on single-client attachments the library's own process already
+  holds the device, so a lazily-spawned probe subprocess would block on it,
+  stall tracing for the full timeout, and cache a spurious "unsupported"
+  verdict.  Probe once, up front, from a process that is not holding the
+  attachment.
+* ``EVOX_TPU_PALLAS=1`` — gate open unconditionally (you know the
+  attachment supports Mosaic; no probe, no subprocess).
+* Any other value — gate CLOSED, with a warning.  Fail-closed is
+  deliberate: a typo must not dispatch a kernel that can hang a
+  single-client relay attachment.
+
+The reference's analogue is its custom-op registration path for the
+dominance kernel (``src/evox/operators/selection/non_dominate.py:29-70``),
+which torch dispatches unconditionally; the gate exists because a TPU
+attachment, unlike a local CUDA device, can *hang* rather than error on an
+unsupported kernel launch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+__all__ = ["pallas_enabled", "run_capability_probe", "PROBE_RECORD_PATH"]
+
+PROBE_RECORD_PATH = os.path.join(
+    os.path.expanduser("~"), ".evox_tpu_pallas_probe.json"
+)
+_PROBE_TIMEOUT_S = 240
+
+_cached: bool | None = None
+
+_PROBE_CODE = """
+import time
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+t0 = time.time()
+x = jnp.ones((8, 128), jnp.float32)
+out = pl.pallas_call(
+    kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)
+)(x)
+out.block_until_ready()
+assert float(out[0, 0]) == 2.0
+print(f"PALLAS_PROBE_OK backend={jax.default_backend()} "
+      f"elapsed={time.time() - t0:.1f}s", flush=True)
+"""
+
+
+def _current_backend() -> str:
+    """Identity of the attachment a verdict applies to.  Calling this from
+    ``pallas_enabled`` is safe: the gate is only consulted mid-trace, when a
+    backend is already initialized."""
+    import jax
+
+    return jax.default_backend()
+
+
+def _load_records() -> dict:
+    """The on-disk verdict store: ``{backend_name: record}`` — one slot per
+    backend, so alternating CPU/TPU runs don't clobber each other's
+    verdict."""
+    if os.path.exists(PROBE_RECORD_PATH):
+        try:
+            with open(PROBE_RECORD_PATH) as f:
+                records = json.load(f)
+            if isinstance(records, dict) and all(
+                isinstance(v, dict) for v in records.values()
+            ):
+                return records
+        except (OSError, json.JSONDecodeError):
+            pass
+    return {}
+
+
+def run_capability_probe(timeout_s: float = _PROBE_TIMEOUT_S) -> dict:
+    """Run the Pallas capability probe in a subprocess and cache the verdict
+    on disk, keyed by the current backend.  Returns the record dict
+    ``{"ok": bool, ...}``.
+
+    Run this from a process that is NOT already holding a single-client
+    attachment (fresh shell: ``python -m evox_tpu.ops.pallas_gate``) — the
+    subprocess needs to initialize the backend itself.  The parent does not
+    touch JAX until the child has exited (initializing the backend here
+    first would be the exact self-contention the gate exists to avoid): the
+    verdict's backend key is parsed from the child's output, with a parent
+    ``jax.default_backend()`` call only as the post-exit fallback.
+    """
+    t0 = time.time()
+    record: dict = {"timeout_s": timeout_s, "probed_at": int(t0)}
+    backend = None
+    out = err = ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", _PROBE_CODE],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        out, err = proc.stdout or "", proc.stderr or ""
+        if proc.returncode == 0 and "PALLAS_PROBE_OK" in out:
+            record.update(
+                ok=True,
+                detail=out.strip().splitlines()[-1],
+                elapsed_s=round(time.time() - t0, 1),
+            )
+        else:
+            record.update(
+                ok=False,
+                detail=f"rc={proc.returncode}",
+                error_tail=(err or out)[-1000:],
+            )
+    except subprocess.TimeoutExpired:
+        # NOTE: the killed child may wedge a single-client relay attachment
+        # for a while (observed on axon) — which is exactly why the probe is
+        # explicit and its verdict persisted.
+        record.update(
+            ok=False, detail=f"timeout after {timeout_s}s (Mosaic hang?)"
+        )
+    m = re.search(r"backend=(\w+)", out)
+    if m:
+        backend = m.group(1)
+    else:
+        # Child never reported a backend (failed/timed out before init
+        # completed).  The child has exited, so initializing here no longer
+        # contends with it; if the attachment itself is wedged this may
+        # still block — acceptable in the explicit CLI, never on a library
+        # code path.
+        backend = _current_backend()
+    record["backend"] = backend
+    records = _load_records()
+    records[backend] = record
+    try:
+        with open(PROBE_RECORD_PATH, "w") as f:
+            json.dump(records, f, indent=1)
+    except OSError:
+        pass
+    return record
+
+
+def pallas_enabled() -> bool:
+    """Should Pallas kernels be dispatched in this process?  See module
+    docstring for the ``EVOX_TPU_PALLAS`` contract."""
+    global _cached
+    if _cached is not None:
+        return _cached
+    flag = os.environ.get("EVOX_TPU_PALLAS", "0").strip().lower()
+    if flag in ("1", "force", "on", "true"):
+        _cached = True
+    elif flag == "probe":
+        record = _load_records().get(_current_backend())
+        if record is None:
+            import warnings
+
+            warnings.warn(
+                "EVOX_TPU_PALLAS=probe, but no capability verdict exists "
+                f"for backend {_current_backend()!r}; the gate stays CLOSED. "
+                "Run `python -m evox_tpu.ops.pallas_gate` (from a fresh "
+                "process, before your workload) to probe this attachment.",
+                stacklevel=2,
+            )
+        _cached = bool(record and record.get("ok"))
+    else:
+        # Unset, "0", and ANY unrecognized value: gate closed (fail-closed —
+        # a typo must not dispatch a kernel that can hang a single-client
+        # relay attachment).
+        if flag not in ("", "0", "false", "off"):
+            import warnings
+
+            warnings.warn(
+                f"EVOX_TPU_PALLAS={flag!r} is not recognized; the Pallas "
+                f"gate stays CLOSED (use '1', 'probe', or '0').",
+                stacklevel=2,
+            )
+        _cached = False
+    return _cached
+
+
+def _reset_for_tests() -> None:
+    global _cached
+    _cached = None
+
+
+if __name__ == "__main__":
+    verdict = run_capability_probe()
+    print(json.dumps(verdict, indent=1))
+    sys.exit(0 if verdict.get("ok") else 1)

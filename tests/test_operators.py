@@ -1,6 +1,8 @@
 """Operator unit tests vs brute-force oracles (reference:
 ``unit_test/operators/``)."""
 
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -89,6 +91,62 @@ def test_pallas_dominance_kernel(mo_fitness):
     expected = np.asarray(dominate_relation(mo_fitness, mo_fitness))
     got = np.asarray(dominance_matrix(mo_fitness, block_size=16, interpret=True))
     np.testing.assert_array_equal(got, expected)
+
+
+def test_pallas_gate_dispatch(mo_fitness, monkeypatch):
+    """EVOX_TPU_PALLAS gate: closed -> broadcast path; open (forced) ->
+    the Pallas kernel dispatches inside non_dominate_rank and agrees."""
+    from evox_tpu.ops import pallas_gate
+
+    expected = np.asarray(non_dominate_rank(mo_fitness))  # gate closed
+
+    monkeypatch.setenv("EVOX_TPU_PALLAS", "1")
+    monkeypatch.setenv("EVOX_TPU_PALLAS_MIN_POP", "1")
+    pallas_gate._reset_for_tests()
+    try:
+        got = np.asarray(non_dominate_rank(mo_fitness))
+    finally:
+        pallas_gate._reset_for_tests()
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_pallas_gate_modes(monkeypatch, tmp_path):
+    from evox_tpu.ops import pallas_gate
+
+    for val, want in [("0", False), ("", False), ("1", True), ("force", True)]:
+        monkeypatch.setenv("EVOX_TPU_PALLAS", val)
+        pallas_gate._reset_for_tests()
+        assert pallas_gate.pallas_enabled() is want, val
+    # Unrecognized values fail CLOSED (a typo must not dispatch a kernel
+    # that can hang a single-client relay attachment) and warn.
+    monkeypatch.setenv("EVOX_TPU_PALLAS", "prob")
+    pallas_gate._reset_for_tests()
+    with pytest.warns(UserWarning, match="not recognized"):
+        assert pallas_gate.pallas_enabled() is False
+    # probe mode reads the cached on-disk verdict for THIS backend; it never
+    # probes lazily (a lazily-spawned probe would contend with this process
+    # for a single-client attachment).
+    backend = jax.default_backend()
+    record = tmp_path / "probe.json"
+    record.write_text(json.dumps({backend: {"ok": True, "backend": backend}}))
+    monkeypatch.setattr(pallas_gate, "PROBE_RECORD_PATH", str(record))
+    monkeypatch.setenv("EVOX_TPU_PALLAS", "probe")
+    pallas_gate._reset_for_tests()
+    assert pallas_gate.pallas_enabled() is True
+    record.write_text(
+        json.dumps({backend: {"ok": False, "detail": "timeout", "backend": backend}})
+    )
+    pallas_gate._reset_for_tests()
+    assert pallas_gate.pallas_enabled() is False
+    # A verdict recorded on a DIFFERENT attachment proves nothing here:
+    # gate stays closed, with a pointer at the explicit probe CLI.
+    record.write_text(
+        json.dumps({"not-this-backend": {"ok": True, "backend": "not-this-backend"}})
+    )
+    pallas_gate._reset_for_tests()
+    with pytest.warns(UserWarning, match="no capability verdict"):
+        assert pallas_gate.pallas_enabled() is False
+    pallas_gate._reset_for_tests()
 
 
 def test_crowding_distance():
