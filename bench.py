@@ -147,14 +147,28 @@ def _timed_fused(wf, n_steps: int, metric: str, profile_dir=None) -> dict:
     """All generations inside ONE compiled ``lax.fori_loop``
     (``StdWorkflow.run``) — zero per-generation dispatch; the TPU-side win
     the reference cannot express (it pays a compiled-graph launch per
-    step)."""
+    step).
+
+    Measurement mirrors ``_timed_steps`` exactly: ``init_step`` runs OUTSIDE
+    the timed region and the loop input is donated (the per-step driver uses
+    ``donate_argnums=0`` too — without donation the fused program pays a
+    GB-scale entry copy of the whole state into the loop carry, which is
+    what made round 3 measure fused as spuriously slower)."""
     import jax
 
-    state0 = wf.init(jax.random.key(0))
-    run = jax.jit(lambda s: wf.run(s, n_steps))
+    run = jax.jit(
+        lambda s: wf.run(s, n_steps, init=False), donate_argnums=0
+    )
+    init_jit = jax.jit(wf.init_step)
+
+    def fresh_state():
+        state = wf.init(jax.random.key(0))
+        return jax.block_until_ready(init_jit(state))
+
+    state = fresh_state()
     if profile_dir:
         os.makedirs(profile_dir, exist_ok=True)
-        compiled = run.lower(state0).compile()
+        compiled = run.lower(state).compile()
         with open(os.path.join(profile_dir, "run_hlo.txt"), "w") as f:
             f.write(compiled.as_text())
         try:
@@ -166,9 +180,10 @@ def _timed_fused(wf, n_steps: int, metric: str, profile_dir=None) -> dict:
                 )
         except Exception as e:
             _log(f"cost_analysis unavailable: {e!r}")
-    jax.block_until_ready(run(state0))  # compile + warm-up run
+    jax.block_until_ready(run(state))  # compile + warm-up run (donates state)
+    state = fresh_state()
     t0 = time.perf_counter()
-    jax.block_until_ready(run(state0))
+    jax.block_until_ready(run(state))
     elapsed = time.perf_counter() - t0
     return {
         "metric": metric,

@@ -185,14 +185,32 @@ class StdWorkflow(Workflow):
         """Last optimization step (algorithm's ``final_step`` if overridden)."""
         return self._step(state, "final_step")
 
-    def run(self, state: State, n_steps: int, init: bool = True) -> State:
+    def run(
+        self, state: State, n_steps: int, init: bool = True, unroll: int = 1
+    ) -> State:
         """Run many generations inside one compiled program: ``init_step``
         followed by a ``lax.fori_loop`` of ``step`` — zero per-generation
         dispatch overhead (the reference pays one ``torch.compile`` dispatch
-        per generation; this is the TPU-side win flagged in SURVEY §3.1)."""
+        per generation; SURVEY §3.1).
+
+        Jit with ``donate_argnums=0`` when the input state is disposable:
+        XLA then aliases the state buffers into the loop carry instead of
+        copying them at program entry (for large populations the state is
+        GBs).  ``unroll`` is forwarded to ``lax.fori_loop``; >1 lets XLA
+        fuse across consecutive generations at the cost of code size —
+        it pays when a single generation is dispatch- or loop-overhead-
+        bound (small populations), not when it is HBM-bound.
+
+        Where the fused form wins is SMALL populations, where per-step
+        dispatch dominates the on-chip work; at HBM-bound sizes (the
+        north-star config) JAX's async dispatch already hides per-step
+        launch latency behind the milliseconds of on-chip work, so fused
+        and per-step run at the same rate.  Measured numbers for both
+        regimes: BASELINE.md / ``BENCH_ALL.json`` (``pso_small_fused``,
+        ``pso_northstar_fused``)."""
         if init:
             state = self.init_step(state)
             n_steps -= 1
         return jax.lax.fori_loop(
-            0, n_steps, lambda _, s: self.step(s), state
+            0, n_steps, lambda _, s: self.step(s), state, unroll=unroll
         )
