@@ -16,6 +16,8 @@ import numpy as np
 __all__ = [
     "plot_dec_space",
     "plot_obj_space_1d",
+    "plot_obj_space_1d_animation",
+    "plot_obj_space_1d_no_animation",
     "plot_obj_space_2d",
     "plot_obj_space_3d",
 ]
@@ -159,14 +161,52 @@ def plot_obj_space_1d(
     )
 
 
+def plot_obj_space_1d_no_animation(fitness_history: List[np.ndarray], **kwargs):
+    """Static min/mean/max fitness curves (reference ``plot.py:152-179``)."""
+    return plot_obj_space_1d(fitness_history, animation=False, **kwargs)
+
+
+def plot_obj_space_1d_animation(fitness_history: List[np.ndarray], **kwargs):
+    """Animated per-generation fitness histogram (reference
+    ``plot.py:180-310``)."""
+    return plot_obj_space_1d(fitness_history, animation=True, **kwargs)
+
+
+def _generation_colored_overlay(fitness_history, pf_trace, scatter_cls, dims):
+    """Static multi-objective figure: every generation's points in one
+    scatter, colored by generation index (sequential colorscale), the true
+    Pareto front overlaid — the no-animation view of a converging front."""
+    counts = [len(f) for f in fitness_history]
+    gen_idx = np.repeat(np.arange(len(fitness_history)), counts)
+    all_fit = np.concatenate(fitness_history, axis=0)
+    coords = {ax: all_fit[:, i] for i, ax in enumerate(dims)}
+    traces = pf_trace + [
+        scatter_cls(
+            mode="markers",
+            marker={
+                "color": gen_idx,
+                "colorscale": "Viridis",
+                "size": 2 if len(dims) == 3 else 4,
+                "colorbar": {"title": "Generation"},
+            },
+            name="population",
+            **coords,
+        )
+    ]
+    return traces
+
+
 def plot_obj_space_2d(
     fitness_history: List[np.ndarray],
     problem_pf: np.ndarray | None = None,
     sort_points: bool = False,
+    animation: bool = True,
     **kwargs,
 ):
-    """Animated 2-objective scatter with optional true Pareto front overlay
-    (reference ``plot.py:311-447``)."""
+    """2-objective scatter with optional true Pareto front overlay
+    (reference ``plot.py:311-447``): animated per-generation frames, or —
+    with ``animation=False`` — one static figure of every generation's
+    points colored by generation index."""
     go = _go()
     fitness_history = [np.asarray(f) for f in fitness_history]
     if sort_points:
@@ -183,6 +223,17 @@ def plot_obj_space_2d(
                 name="Pareto front",
             )
         ]
+    all_fit = np.concatenate(fitness_history, axis=0)
+    layout = dict(
+        xaxis={"range": _padded_range(all_fit[:, 0])},
+        yaxis={"range": _padded_range(all_fit[:, 1])},
+        **kwargs,
+    )
+    if not animation:
+        traces = _generation_colored_overlay(
+            fitness_history, pf_trace, go.Scatter, ("x", "y")
+        )
+        return go.Figure(data=traces, layout=go.Layout(**layout))
     frames = [
         pf_trace
         + [
@@ -192,25 +243,20 @@ def plot_obj_space_2d(
         ]
         for f in fitness_history
     ]
-    all_fit = np.concatenate(fitness_history, axis=0)
-    return _animated_scatter(
-        frames,
-        dict(
-            xaxis={"range": _padded_range(all_fit[:, 0])},
-            yaxis={"range": _padded_range(all_fit[:, 1])},
-            **kwargs,
-        ),
-    )
+    return _animated_scatter(frames, layout)
 
 
 def plot_obj_space_3d(
     fitness_history: List[np.ndarray],
     problem_pf: np.ndarray | None = None,
     sort_points: bool = False,
+    animation: bool = True,
     **kwargs,
 ):
-    """Animated 3-objective scatter with optional true Pareto front overlay
-    (reference ``plot.py:448-588``)."""
+    """3-objective scatter with optional true Pareto front overlay
+    (reference ``plot.py:448-588``): animated per-generation frames, or —
+    with ``animation=False`` — one static figure of every generation's
+    points colored by generation index."""
     go = _go()
     fitness_history = [np.asarray(f) for f in fitness_history]
     if sort_points:
@@ -228,6 +274,11 @@ def plot_obj_space_3d(
                 name="Pareto front",
             )
         ]
+    if not animation:
+        traces = _generation_colored_overlay(
+            fitness_history, pf_trace, go.Scatter3d, ("x", "y", "z")
+        )
+        return go.Figure(data=traces, layout=go.Layout(**kwargs))
     frames = [
         pf_trace
         + [

@@ -86,6 +86,83 @@ def test_plot_requires_plotly():
             plot.plot_obj_space_1d([np.zeros(4)])
 
 
+@pytest.fixture
+def fake_plotly(monkeypatch):
+    """A minimal plotly stand-in (the real package is optional and absent in
+    this image): graph_objects classes that just record their kwargs, enough
+    to assert the figures' structure."""
+    import sys
+
+    class _Trace(dict):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+
+    class Scatter(_Trace):
+        pass
+
+    class Scatter3d(_Trace):
+        pass
+
+    class Histogram(_Trace):
+        pass
+
+    class Frame(_Trace):
+        pass
+
+    class Layout(_Trace):
+        pass
+
+    class Figure:
+        def __init__(self, data=None, frames=None, layout=None):
+            self.data = data
+            self.frames = frames
+            self.layout = layout
+
+    go = types.ModuleType("plotly.graph_objects")
+    for cls in (Scatter, Scatter3d, Histogram, Frame, Layout, Figure):
+        setattr(go, cls.__name__, cls)
+    plotly = types.ModuleType("plotly")
+    plotly.graph_objects = go
+    monkeypatch.setitem(sys.modules, "plotly", plotly)
+    monkeypatch.setitem(sys.modules, "plotly.graph_objects", go)
+    return go
+
+
+def test_plot_static_2d_3d(fake_plotly):
+    """animation=False produces one static figure: a generation-colored
+    overlay of every generation plus the PF trace — no frames."""
+    from evox_tpu.vis_tools import plot
+
+    hist = [np.random.rand(8, 2) for _ in range(4)]
+    pf = np.random.rand(16, 2)
+    fig = plot.plot_obj_space_2d(hist, problem_pf=pf, animation=False)
+    assert fig.frames is None
+    assert len(fig.data) == 2  # PF + overlay
+    overlay = fig.data[-1]
+    assert len(overlay["x"]) == 8 * 4
+    assert list(overlay["marker"]["color"][:8]) == [0] * 8  # gen index
+
+    hist3 = [np.random.rand(8, 3) for _ in range(4)]
+    fig3 = plot.plot_obj_space_3d(hist3, animation=False)
+    assert fig3.frames is None
+    assert len(fig3.data) == 1
+    assert len(fig3.data[0]["z"]) == 8 * 4
+
+    # Animated path still emits per-generation frames.
+    fig_anim = plot.plot_obj_space_2d(hist, problem_pf=pf)
+    assert len(fig_anim.frames) == 4
+
+
+def test_plot_1d_named_variants(fake_plotly):
+    from evox_tpu.vis_tools import plot
+
+    hist = [np.random.rand(8) for _ in range(3)]
+    static = plot.plot_obj_space_1d_no_animation(hist)
+    assert static.frames is None and len(static.data) == 3  # min/mean/max
+    anim = plot.plot_obj_space_1d_animation(hist)
+    assert len(anim.frames) == 3
+
+
 def test_extension_autoload(monkeypatch):
     # Simulate an installed extension distribution providing
     # evox_tpu_ext.algorithms.myalgo with one public class.
