@@ -387,7 +387,12 @@ def bench_neuroevolution(n_steps, profile_dir=None):
     policy = MLPPolicy((4, 32, 32, 1))
     params0 = policy.init(jax.random.key(1))
     adapter = ParamsAndVector(params0)
-    problem = RolloutProblem(policy, cartpole(), max_episode_length=ep_len)
+    # maximize_reward=False + opt_direction="max": the problem emits raw
+    # returns and the workflow handles direction (the two must not BOTH
+    # negate, or the algorithm optimizes toward the worst return).
+    problem = RolloutProblem(
+        policy, cartpole(), max_episode_length=ep_len, maximize_reward=False
+    )
     wf = StdWorkflow(
         OpenES(
             pop_size=pop,

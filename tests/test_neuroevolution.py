@@ -58,6 +58,48 @@ def test_rollout_done_stops_reward(key):
     assert jnp.all(returns >= 0) and jnp.all(returns <= 100)
 
 
+def test_direction_conventions_equivalent(key):
+    """The two reward-direction conventions — problem-side negation
+    (maximize_reward=True + default "min") and workflow-side direction
+    (maximize_reward=False + opt_direction="max") — must drive the
+    algorithm identically.  Mixing them negates twice and optimizes toward
+    the WORST return (a bug this test pins down)."""
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.workflows import StdWorkflow
+
+    env = cartpole()
+    policy = MLPPolicy([env.obs_size, 4, env.action_size])
+    adapter = ParamsAndVector(policy.init(jax.random.key(0)))
+    dim = adapter.vector_size
+
+    def build(maximize_reward, opt_direction):
+        prob = RolloutProblem(
+            policy,
+            env,
+            max_episode_length=20,
+            rotate_key=False,
+            maximize_reward=maximize_reward,
+        )
+        wf = StdWorkflow(
+            PSO(8, -jnp.ones(dim), jnp.ones(dim)),
+            prob,
+            opt_direction=opt_direction,
+            solution_transform=adapter.batched_to_params,
+        )
+        s = wf.init(key)
+        s = jax.jit(wf.init_step)(s)
+        step = jax.jit(wf.step)
+        for _ in range(2):
+            s = step(s)
+        return s
+
+    s_problem_side = build(True, "min")
+    s_workflow_side = build(False, "max")
+    assert jnp.array_equal(
+        s_problem_side.algorithm.pop, s_workflow_side.algorithm.pop
+    ), "the two conventions must produce identical trajectories"
+
+
 def test_policy_search_learns_pendulum():
     env = pendulum()
     policy = MLPPolicy([env.obs_size, 16, env.action_size])
