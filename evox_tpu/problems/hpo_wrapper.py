@@ -24,6 +24,7 @@ final best) remains available as ``aggregation="final"``.
 
 from __future__ import annotations
 
+import contextvars
 from typing import Any, Callable, Literal, Mapping
 
 import jax
@@ -36,6 +37,16 @@ __all__ = ["HPOMonitor", "HPOFitnessMonitor", "HPOProblemWrapper", "HPO_REPEAT_A
 #: vmap axis name carried by the repeats axis inside
 #: :meth:`HPOProblemWrapper.evaluate`; HPO monitors reduce over it.
 HPO_REPEAT_AXIS = "hpo_repeat"
+
+#: Trace-scoped repeat wiring ``(num_repeats, fit_aggregation)`` installed by
+#: :meth:`HPOProblemWrapper.evaluate` for the duration of its trace.  A
+#: ``ContextVar`` (not attribute mutation on the shared monitor object) so
+#: that (a) concurrent traces in different threads/contexts cannot observe
+#: each other's wiring, and (b) nested wrappers (HPO-of-HPO) save/restore
+#: correctly via token reset.
+_REPEAT_WIRING: contextvars.ContextVar[tuple[int, Callable] | None] = (
+    contextvars.ContextVar("hpo_repeat_wiring", default=None)
+)
 
 
 def _reduce_axis(fn: Callable, arr: jax.Array, axis: int) -> jax.Array:
@@ -52,10 +63,15 @@ class HPOMonitor(Monitor):
     """Base monitor for HPO inner workflows: must expose the inner run's
     final score via ``tell_fitness`` (reference ``hpo_wrapper.py:41-58``).
 
-    :param num_repeats: set by :class:`HPOProblemWrapper` (per-generation
-        mode); when > 1, subclasses should aggregate fitness across the
-        ``HPO_REPEAT_AXIS`` vmap axis in ``pre_tell`` via
-        :meth:`aggregate_repeats`.
+    Subclasses aggregate each generation's fitness across repeats by
+    calling :meth:`aggregate_repeats` in ``pre_tell`` — never by reading
+    ``self.num_repeats`` directly: when the monitor runs inside an
+    :class:`HPOProblemWrapper` evaluation, the wrapper's trace-scoped
+    wiring (repeat count + reduction) takes precedence over the
+    constructor values, and only ``aggregate_repeats`` sees it.
+
+    :param num_repeats: repeat count used when the monitor runs standalone
+        (outside a wrapper's trace).
     :param fit_aggregation: reduction over the repeats axis, called as
         ``fit_aggregation(stacked, axis=0)`` (default ``jnp.mean`` — the
         reference's mean-of-repeats, ``hpo_wrapper.py:19-38``).
@@ -73,8 +89,18 @@ class HPOMonitor(Monitor):
         """Cross-repeat aggregation of this generation's fitness.  Inside the
         wrapper's repeat vmap this is a collective over the named axis: every
         lane receives the same aggregated tensor (the JAX-native equivalent
-        of the reference's vmap-registered mean custom op)."""
-        if self.num_repeats <= 1:
+        of the reference's vmap-registered mean custom op).
+
+        Repeat wiring installed by a surrounding
+        :meth:`HPOProblemWrapper.evaluate` trace (via the context-local
+        ``_REPEAT_WIRING``) takes precedence over the constructor
+        attributes, so one monitor instance can serve several wrappers."""
+        wiring = _REPEAT_WIRING.get()
+        num_repeats, fit_aggregation = (
+            wiring if wiring is not None
+            else (self.num_repeats, self.fit_aggregation)
+        )
+        if num_repeats <= 1:
             return fitness
         try:
             stacked = jax.lax.all_gather(fitness, HPO_REPEAT_AXIS, axis=0)
@@ -84,7 +110,7 @@ class HPOMonitor(Monitor):
             # standalone or under "final" aggregation traces with no such
             # axis — degrade to the raw per-lane fitness.
             return fitness
-        return _reduce_axis(self.fit_aggregation, stacked, 0)
+        return _reduce_axis(fit_aggregation, stacked, 0)
 
     def tell_fitness(self, state: State) -> jax.Array:
         raise NotImplementedError(
@@ -230,15 +256,15 @@ class HPOProblemWrapper(Problem):
             return wf.monitor.tell_fitness(wf_state.monitor)
 
         # Wire the monitor's repeat aggregation for the duration of this
-        # trace only (the reference wires it permanently at construction,
-        # ``hpo_wrapper.py:204`` — but several wrappers may share one
-        # workflow object, so config must not leak across them).
-        monitor = wf.monitor
+        # trace only, via the context-local ``_REPEAT_WIRING`` (the reference
+        # wires it permanently at construction, ``hpo_wrapper.py:204`` — but
+        # several wrappers may share one workflow object, and concurrent
+        # traces must not observe each other's config, so nothing is mutated
+        # on the shared monitor).
         per_gen = self.aggregation == "per_generation" and self.num_repeats > 1
-        saved = (monitor.num_repeats, monitor.fit_aggregation)
-        monitor.num_repeats = self.num_repeats if per_gen else 1
-        if per_gen:
-            monitor.fit_aggregation = self.fit_aggregation
+        token = _REPEAT_WIRING.set(
+            (self.num_repeats, self.fit_aggregation) if per_gen else (1, jnp.mean)
+        )
         try:
             if self.num_repeats == 1:
                 fit = jax.vmap(run_one)(state.instances, dict(hyper_parameters))
@@ -259,7 +285,7 @@ class HPOProblemWrapper(Problem):
                 )(state.instances, dict(hyper_parameters))
                 fit = _reduce_axis(self.fit_aggregation, fit, 1)
         finally:
-            monitor.num_repeats, monitor.fit_aggregation = saved
+            _REPEAT_WIRING.reset(token)
         # The inner states are consumed per evaluation (fresh instances each
         # call evaluate identical init states, matching the reference's
         # copy_init_state behavior).

@@ -51,7 +51,17 @@ __monitor_history__: dict[int, dict[int, list]] = {}
 
 class EvalMonitor(Monitor):
     """Monitor hooked around evaluation; records offspring, fitness, top-k
-    elites, and (on demand) the full history / pareto front."""
+    elites, and (on demand) the full history / pareto front.
+
+    **Single-owner contract.** One ``EvalMonitor`` instance serves ONE
+    workflow: host-side history is keyed by the monitor's object identity,
+    and ``StdWorkflow.__init__`` writes ``opt_direction`` (and
+    ``record_auxiliary`` writes ``aux_keys``) onto the instance.  Attaching
+    the same instance to a second workflow interleaves both runs' histories
+    under one key and overwrites the first workflow's config — construct a
+    fresh monitor per workflow instead.  (vmapping ONE workflow over
+    stacked instances is fine: that is what ``ordered=False`` +
+    ``num_instances`` exist for.)"""
 
     def __init__(
         self,
