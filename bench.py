@@ -346,6 +346,31 @@ def bench_nsga2_dtlz2(n_steps, profile_dir=None):
     }
 
 
+def bench_nsga2_dtlz2_pallas(n_steps, profile_dir=None):
+    """The NSGA-II config with the Pallas dominance kernel dispatched (the
+    child env sets EVOX_TPU_PALLAS=probe; see CONFIG_ENV).  Refuses to run —
+    rather than silently measuring the broadcast path under a pallas label —
+    when the gate is closed or the population is below the dispatch
+    threshold."""
+    from evox_tpu.operators.selection.non_dominate import _pallas_min_pop
+    from evox_tpu.ops.pallas_gate import pallas_enabled
+
+    if not pallas_enabled():
+        raise RuntimeError(
+            "nsga2_dtlz2_pallas: the Pallas gate is closed (no passing "
+            "capability verdict for this backend — run "
+            "`python -m evox_tpu.ops.pallas_gate` first)."
+        )
+    if _pallas_min_pop() > 10_000:
+        raise RuntimeError(
+            "nsga2_dtlz2_pallas: EVOX_TPU_PALLAS_MIN_POP exceeds the "
+            "config's pop=10000; the kernel would not dispatch."
+        )
+    result = bench_nsga2_dtlz2(n_steps, profile_dir=profile_dir)
+    result["metric"] += ", pallas dominance kernel"
+    return result
+
+
 def bench_rvea_dtlz2(n_steps, profile_dir=None):
     import jax.numpy as jnp
 
@@ -485,6 +510,17 @@ def bench_smoke(n_steps, profile_dir=None):
     return run_smoke()
 
 
+# Per-config environment overrides applied to the child process.
+# nsga2_dtlz2_pallas sets the gate to "probe": the dominance matrix runs the
+# blocked-tile kernel (``evox_tpu/ops/dominance.py``) ONLY if a cached
+# capability verdict from ``python -m evox_tpu.ops.pallas_gate`` says this
+# attachment supports Mosaic — fail-closed otherwise (a pallas_call on an
+# unsupported single-client relay can hang it), and the bench fn refuses to
+# measure rather than mislabel the broadcast path.
+CONFIG_ENV = {
+    "nsga2_dtlz2_pallas": {"EVOX_TPU_PALLAS": "probe"},
+}
+
 # name -> (fn, tpu_steps, cpu_steps)
 CONFIGS = {
     "smoke": (bench_smoke, 1, 1),
@@ -498,6 +534,7 @@ CONFIGS = {
     "de_cec": (bench_de_cec, 200, 20),
     "openes_cec": (bench_openes_cec, 300, 50),
     "nsga2_dtlz2": (bench_nsga2_dtlz2, 30, 3),
+    "nsga2_dtlz2_pallas": (bench_nsga2_dtlz2_pallas, 30, 3),
     "rvea_dtlz2": (bench_rvea_dtlz2, 30, 3),
     "neuroevolution": (bench_neuroevolution, 30, 3),
     "vmapped_instances": (bench_vmapped_instances, 200, 50),
@@ -613,6 +650,7 @@ def run_child(config: str, platform: str, profile: bool) -> dict:
     if profile:
         cmd += ["--profile"]
     env = dict(os.environ) if platform == "tpu" else _cpu_env()
+    env.update(CONFIG_ENV.get(config, {}))
     t0 = time.perf_counter()
     try:
         proc = subprocess.run(
@@ -734,8 +772,11 @@ def main() -> int:
         if platform == "tpu" and not args.no_probe and not probe_tpu():
             platform = "cpu"
 
+    # Explicit-only configs never run under --all: smoke is a diagnostic,
+    # and the pallas variant must not dispatch on an unprobed attachment.
+    explicit_only = {"smoke", "nsga2_dtlz2_pallas"}
     configs = (
-        [c for c in CONFIGS if c != "smoke"]
+        [c for c in CONFIGS if c not in explicit_only]
         if args.all
         else [args.config or HEADLINE]
     )

@@ -62,11 +62,13 @@ class JaDE(Algorithm):
                 * (self.ub - self.lb)
                 + self.lb
             )
-        half = jnp.full((self.pop_size,), 0.5, dtype=self.dtype)
+        # Distinct buffers (no aliases): duplicate buffers in one State
+        # break whole-state donation.
+        half = lambda: jnp.full((self.pop_size,), 0.5, dtype=self.dtype)
         return State(
             key=key,
-            F_u=half,
-            CR_u=half,
+            F_u=half(),
+            CR_u=half(),
             pop=pop,
             fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
         )

@@ -66,7 +66,9 @@ class CMAES(Algorithm):
         self.decomp_per_iter = max(int(1 / (c_1 + c_mu) / dim / 10), 1)
 
     def setup(self, key: jax.Array) -> State:
-        eye = jnp.eye(self.dim)
+        # Three distinct identity buffers (no aliases): duplicate buffers in
+        # one State break whole-state donation.
+        eye = lambda: jnp.eye(self.dim)
         return State(
             key=key,
             c_sigma=Parameter(self.c_sigma),
@@ -77,9 +79,9 @@ class CMAES(Algorithm):
             mean=self.mean_init,
             sigma=jnp.asarray(self.sigma_init),
             iteration=jnp.asarray(0),
-            C=eye,
-            A=eye,  # sampling transform B diag(sqrt(D))
-            C_invsqrt=eye,
+            C=eye(),
+            A=eye(),  # sampling transform B diag(sqrt(D))
+            C_invsqrt=eye(),
             p_sigma=jnp.zeros((self.dim,)),
             p_c=jnp.zeros((self.dim,)),
             fit=jnp.full((self.pop_size,), jnp.inf),

@@ -103,7 +103,9 @@ class DMSPSOEL(Algorithm):
             pop=pop,
             velocity=velocity,
             fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
-            personal_best_location=pop,
+            # A copy, not an alias: duplicate buffers in one State break
+            # whole-state donation ("donate the same buffer twice").
+            personal_best_location=jnp.copy(pop),
             personal_best_fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
             local_best_location=pop[:dyn].reshape(
                 self.swarms_num, self.swarm_size, self.dim

@@ -85,7 +85,9 @@ class FSPSO(Algorithm):
             pop=pop,
             fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
             velocity=velocity,
-            local_best_location=pop,
+            # A copy, not an alias: duplicate buffers in one State break
+            # whole-state donation ("donate the same buffer twice").
+            local_best_location=jnp.copy(pop),
             local_best_fit=jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype),
             global_best_location=pop[0],
             global_best_fit=jnp.asarray(jnp.inf, dtype=self.dtype),

@@ -62,7 +62,9 @@ class PSO(Algorithm):
             jax.random.uniform(v_key, (self.pop_size, self.dim), dtype=self.dtype) * 2.0
             - 1.0
         ) * length
-        inf = jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype)
+        # Distinct buffers per leaf (no aliases): duplicate buffers in one
+        # State break whole-state donation ("donate the same buffer twice").
+        inf = lambda: jnp.full((self.pop_size,), jnp.inf, dtype=self.dtype)
         return State(
             key=key,
             w=Parameter(self.w, dtype=self.dtype),
@@ -70,9 +72,9 @@ class PSO(Algorithm):
             phi_g=Parameter(self.phi_g, dtype=self.dtype),
             pop=pop,
             velocity=velocity,
-            fit=inf,
-            local_best_location=pop,
-            local_best_fit=inf,
+            fit=inf(),
+            local_best_location=jnp.copy(pop),
+            local_best_fit=inf(),
             global_best_location=pop[0],
             global_best_fit=jnp.asarray(jnp.inf, dtype=self.dtype),
         )

@@ -18,11 +18,31 @@ def run_algorithm(algo: Algorithm, steps: int = 5, seed: int = 0) -> State:
     """Eager execution (jax's eager still traces ops, but no jit cache)."""
     wf = StdWorkflow(algo, Sphere())
     state = wf.init(jax.random.key(seed))
+    _assert_no_aliased_leaves(state)
     state = wf.init_step(state)
     for _ in range(steps - 1):
         state = wf.step(state)
     _assert_finite_fit(state)
     return state
+
+
+def _assert_no_aliased_leaves(state: State) -> None:
+    """No two leaves of a freshly-set-up state may share a device buffer:
+    whole-state donation (``jit(wf.run, donate_argnums=0)``) fails with
+    "donate the same buffer twice" on aliased pytrees.  Guards the
+    ``jnp.copy`` discipline in every algorithm's ``setup``."""
+    seen: dict[int, str] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        try:
+            ptr = leaf.unsafe_buffer_pointer()
+        except Exception:  # non-array leaf or backend without pointers
+            continue
+        name = jax.tree_util.keystr(path)
+        assert ptr not in seen, (
+            f"setup() aliases {seen[ptr]} and {name} to one buffer; "
+            f"use jnp.copy — aliased states cannot be donated"
+        )
+        seen[ptr] = name
 
 
 def run_jit_algorithm(algo: Algorithm, steps: int = 5, seed: int = 0) -> State:

@@ -311,6 +311,19 @@ def test_multigeneration_run():
     assert jnp.all(jnp.isfinite(out.algorithm.fit))
 
 
+def test_multigeneration_run_unroll_and_donation():
+    """`run` with unroll>1 and a donated carry computes the same trajectory
+    as the plain form (unroll is a pipelining knob, not a semantic one)."""
+    wf = _make()
+    state_a = wf.init(jax.random.key(3))
+    state_b = wf.init(jax.random.key(3))
+    out_a = jax.jit(lambda s: wf.run(s, 6))(state_a)
+    out_b = jax.jit(lambda s: wf.run(s, 6, unroll=3), donate_argnums=0)(state_b)
+    np.testing.assert_array_equal(
+        np.asarray(out_a.algorithm.pop), np.asarray(out_b.algorithm.pop)
+    )
+
+
 def test_multigeneration_run_with_monitor():
     """Monitor side-channel (ordered io_callback) composes with the fused
     fori_loop driver: one history entry per generation, top-k intact."""
