@@ -17,7 +17,11 @@ pytestmark = pytest.mark.slow
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 DOC_FILES = sorted(
-    [REPO / "README.md", *(REPO / "docs" / "guide").glob("*.md")]
+    [
+        REPO / "README.md",
+        *(REPO / "docs" / "guide").glob("*.md"),
+        *(REPO / "docs" / "tutorial").glob("*.md"),
+    ]
 )
 
 _FENCE = re.compile(r"```python\n(.*?)```", re.DOTALL)

@@ -37,8 +37,12 @@ def main() -> int:
     path = os.path.join(args.profile_dir, "cost_analysis.json")
     with open(path) as f:
         cost = json.load(f)
-    bytes_per_gen = cost.get("bytes accessed", 0.0)
-    flops_per_gen = cost.get("flops", 0.0)
+    # Fused-driver profiles carry whole-program costs plus the generation
+    # count ("n_steps", written by bench._timed_fused) — normalize to
+    # per-generation so the roofline math matches per-step profiles.
+    n_steps = cost.get("n_steps") or 1
+    bytes_per_gen = cost.get("bytes accessed", 0.0) / n_steps
+    flops_per_gen = cost.get("flops", 0.0) / n_steps
 
     gbps = bytes_per_gen * args.gen_per_sec / 1e9
     tflops = flops_per_gen * args.gen_per_sec / 1e12
