@@ -749,6 +749,18 @@ def main() -> int:
     if args.child:
         import jax
 
+        # Persistent compilation cache (same store the test lane uses):
+        # with --runs N each run is a fresh child, so without the cache
+        # every repeat pays the full XLA compile again.
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(_REPO_ROOT, ".jax_cache"),
+            )
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception as e:
+            _log(f"compilation cache unavailable: {e!r}")
+
         _log(f"child: {args.child} backend={jax.default_backend()} "
              f"steps={args.steps}")
         fn = CONFIGS[args.child][0]

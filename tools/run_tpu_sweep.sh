@@ -69,4 +69,7 @@ for cfg in ["nsga2_dtlz2", "rvea_dtlz2", "pso_northstar_fused", "pso_northstar"]
         with open(os.path.join(prof, "roofline.json"), "w") as f:
             f.write(out.stdout)
 EOF
+echo "=== regenerate BASELINE.md table $(date -u +%H:%M:%S) ==="
+python tools/update_baseline.py || echo "UPDATE_BASELINE FAILED rc=$?"
+
 echo "=== sweep done $(date -u +%H:%M:%S) ==="
