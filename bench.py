@@ -521,6 +521,11 @@ CONFIG_ENV = {
     "nsga2_dtlz2_pallas": {"EVOX_TPU_PALLAS": "probe"},
 }
 
+# Configs that never run under --all: smoke is a diagnostic, and the pallas
+# variant must not dispatch on an unprobed attachment.  (Also consumed by
+# tools/update_baseline.py for its artifact-fallback rule.)
+EXPLICIT_ONLY = {"smoke", "nsga2_dtlz2_pallas"}
+
 # name -> (fn, tpu_steps, cpu_steps)
 CONFIGS = {
     "smoke": (bench_smoke, 1, 1),
@@ -784,11 +789,8 @@ def main() -> int:
         if platform == "tpu" and not args.no_probe and not probe_tpu():
             platform = "cpu"
 
-    # Explicit-only configs never run under --all: smoke is a diagnostic,
-    # and the pallas variant must not dispatch on an unprobed attachment.
-    explicit_only = {"smoke", "nsga2_dtlz2_pallas"}
     configs = (
-        [c for c in CONFIGS if c not in explicit_only]
+        [c for c in CONFIGS if c not in EXPLICIT_ONLY]
         if args.all
         else [args.config or HEADLINE]
     )
@@ -809,6 +811,18 @@ def main() -> int:
                 "max": ok[-1]["value"] if ok else 0.0,
             }
         results[name] = _apply_baseline(result, platform)
+        # Persist the AGGREGATED result (median + runs spread + vs_baseline)
+        # as the per-config artifact: each child wrote only its own raw run
+        # there, so without this the artifact of record would be whichever
+        # run finished last.
+        if results[name].get("value"):
+            try:
+                with open(
+                    os.path.join(_ARTIFACT_DIR, f"{name}.{platform}.json"), "w"
+                ) as f:
+                    json.dump(results[name], f, indent=1)
+            except OSError as e:
+                _log(f"artifact write failed for {name}: {e!r}")
         _log(json.dumps(results[name]))
 
     if args.all:

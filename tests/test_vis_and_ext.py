@@ -163,6 +163,31 @@ def test_plot_1d_named_variants(fake_plotly):
     assert len(anim.frames) == 3
 
 
+def test_monitor_plot_dispatch(fake_plotly):
+    """EvalMonitor.plot routes by objective count through vis_tools.plot
+    (reference ``eval_monitor.py:338-378``) — here with a 3-objective MO
+    history through the full workflow."""
+    import jax
+    import jax.numpy as jnp
+
+    from evox_tpu.algorithms import NSGA2
+    from evox_tpu.problems.numerical import DTLZ2
+    from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+    mon = EvalMonitor(multi_obj=True, full_fit_history=True)
+    wf = StdWorkflow(
+        NSGA2(16, 3, jnp.zeros(6), jnp.ones(6)), DTLZ2(d=6, m=3), monitor=mon
+    )
+    s = wf.init(jax.random.key(0))
+    s = jax.jit(wf.init_step)(s)
+    s = jax.jit(wf.step)(s)
+    jax.block_until_ready(s)
+    fig = mon.plot(animation=False)
+    assert fig is not None and fig.frames is None  # static 3d overlay
+    fig_anim = mon.plot()
+    assert len(fig_anim.frames) == len(mon.fitness_history)
+
+
 def test_extension_autoload(monkeypatch):
     # Simulate an installed extension distribution providing
     # evox_tpu_ext.algorithms.myalgo with one public class.

@@ -16,10 +16,12 @@ cd "$(dirname "$0")/.."
 PROFILE_CFGS="nsga2_dtlz2 rvea_dtlz2 pso_northstar_fused pso_northstar"
 
 # Stale-data guard: a roofline must never pair this sweep's gen/s with a
-# previous round's cost profile.
+# previous round's cost profile, and a previous round's pallas artifact
+# must not survive into this round's table if today's probe fails.
 for cfg in $PROFILE_CFGS; do
   rm -rf "bench_artifacts/profile_${cfg}"
 done
+rm -f bench_artifacts/nsga2_dtlz2_pallas.tpu.json
 
 echo "=== sweep start $(date -u +%H:%M:%S) ==="
 python bench.py --all --runs 3 --platform tpu --no-probe \
@@ -71,5 +73,22 @@ for cfg in ["nsga2_dtlz2", "rvea_dtlz2", "pso_northstar_fused", "pso_northstar"]
 EOF
 echo "=== regenerate BASELINE.md table $(date -u +%H:%M:%S) ==="
 python tools/update_baseline.py || echo "UPDATE_BASELINE FAILED rc=$?"
+
+# LAST, after every number is banked: the Pallas capability probe.  On an
+# attachment where Mosaic hangs, the killed probe child can wedge the relay
+# for a long while — running it last means only this step is lost.  The
+# verdict (pass or the failure record) is copied into bench_artifacts/ as
+# committed evidence; on pass, the gated NSGA-II pallas config is measured.
+echo "=== pallas capability probe $(date -u +%H:%M:%S) ==="
+if python -m evox_tpu.ops.pallas_gate; then
+  cp ~/.evox_tpu_pallas_probe.json bench_artifacts/pallas_probe_verdict.json
+  echo "=== pallas OK -> measuring nsga2_dtlz2_pallas $(date -u +%H:%M:%S) ==="
+  python bench.py --config nsga2_dtlz2_pallas --runs 3 --platform tpu --no-probe \
+    || echo "PALLAS BENCH FAILED rc=$?"
+  python tools/update_baseline.py || true
+else
+  cp ~/.evox_tpu_pallas_probe.json bench_artifacts/pallas_probe_verdict.json 2>/dev/null
+  echo "pallas probe FAILED on this attachment (verdict recorded)"
+fi
 
 echo "=== sweep done $(date -u +%H:%M:%S) ==="
