@@ -267,6 +267,18 @@ def bench_pso_northstar_rbg(n_steps, profile_dir=None):
     return result
 
 
+def bench_pso_northstar_bf16_rbg(n_steps, profile_dir=None):
+    """Both levers at once: bf16 state (half the HBM bytes) + hardware rbg
+    PRNG (no Threefry ALU chain).  If each helps independently, this is the
+    fastest the north-star config goes without changing the algorithm."""
+    import jax
+
+    jax.config.update("jax_default_prng_impl", "rbg")
+    result = bench_pso_northstar_bf16(n_steps, profile_dir=profile_dir)
+    result["metric"] = result["metric"].replace("bf16", "bf16 + rbg PRNG")
+    return result
+
+
 def bench_cmaes_cec(n_steps, profile_dir=None):
     import jax.numpy as jnp
 
@@ -535,6 +547,7 @@ CONFIGS = {
     "pso_northstar_fused": (bench_pso_northstar_fused, 100, 3),
     "pso_northstar_rbg": (bench_pso_northstar_rbg, 100, 3),
     "pso_northstar_bf16": (bench_pso_northstar_bf16, 100, 3),
+    "pso_northstar_bf16_rbg": (bench_pso_northstar_bf16_rbg, 100, 3),
     "cmaes_cec": (bench_cmaes_cec, 200, 50),
     "de_cec": (bench_de_cec, 200, 20),
     "openes_cec": (bench_openes_cec, 300, 50),
