@@ -183,6 +183,9 @@ def test_sharded_fused_run_with_monitor(key):
     step = jax.jit(wf_b.step)
     for _ in range(n_gens - 1):
         t = step(t)
+    # Dispatch is async: the host side channel only flushes once the
+    # computation is complete — block before reading history.
+    jax.block_until_ready(t)
     assert len(mon_b.fitness_history) == n_gens
     # The host side channel itself must carry identical per-generation
     # payloads in both drivers (not just identical in-graph top-k).
