@@ -14,10 +14,12 @@ __all__ = [
     "RolloutProblem",
     "SupervisedLearningProblem",
     "cartpole",
+    "minibrax",
     "pendulum",
     "stack_model_params",
 ]
 
+from . import minibrax
 from .brax import BraxProblem
 from .envs import Env, cartpole, pendulum
 from .mujoco_playground import MujocoProblem

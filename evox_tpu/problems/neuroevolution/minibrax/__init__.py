@@ -1,0 +1,47 @@
+"""minibrax: a vendored, minimal, brax-API-compatible physics engine.
+
+The reference validates its Brax adapter against the live engine
+(``/root/reference/unit_test/problems/test_brax.py:49-140``); the real
+``brax`` package is not installable in this image, so this sub-package
+provides a *real* (small, planar, pure-JAX) physics engine honouring the
+exact API slice :class:`~evox_tpu.problems.neuroevolution.BraxProblem`
+consumes:
+
+* ``envs.get_environment(env_name=...)`` registry → ``Env`` objects with
+  pure ``reset``/``step``, ``observation_size``/``action_size``, ``sys``;
+* ``envs.State`` carrying ``pipeline_state``/``obs``/``reward``/``done``;
+* ``io.html.render(sys, trajectory)`` / ``io.image.render_array(...)``.
+
+:func:`activate` aliases this package as ``brax`` in ``sys.modules`` —
+only when the real brax is absent — so the adapter (and the integration
+test lane) executes unmodified.  With real brax installed, ``activate()``
+is a no-op returning the genuine package.
+"""
+
+from __future__ import annotations
+
+from . import envs, io  # noqa: F401  (adapter reaches these via attribute access)
+from .physics import PipelineState, System, pipeline_init, pipeline_step  # noqa: F401
+
+__all__ = ["envs", "io", "activate", "System", "PipelineState", "pipeline_init", "pipeline_step"]
+
+
+def activate():
+    """Install minibrax as ``brax`` in ``sys.modules`` if brax is absent.
+
+    Returns whichever module will answer ``import brax`` afterwards."""
+    import sys as _sys
+
+    try:
+        import brax  # noqa: F401
+
+        return _sys.modules["brax"]
+    except ImportError:
+        pass
+    this = _sys.modules[__name__]
+    _sys.modules["brax"] = this
+    _sys.modules["brax.envs"] = envs
+    _sys.modules["brax.io"] = io
+    _sys.modules["brax.io.html"] = io.html
+    _sys.modules["brax.io.image"] = io.image
+    return this

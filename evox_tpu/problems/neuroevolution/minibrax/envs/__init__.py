@@ -1,0 +1,182 @@
+"""minibrax environments: the ``brax.envs`` API surface on the planar
+pipeline (``State`` with pipeline_state/obs/reward/done, ``Env`` base with
+``reset``/``step``/``observation_size``/``action_size``/``sys``, and a
+``get_environment`` registry — cf. brax's ``envs/__init__.py`` surface the
+adapter consumes via ``/root/repo/evox_tpu/problems/neuroevolution/brax.py``)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..physics import PipelineState, System, pipeline_init, pipeline_step
+
+__all__ = ["State", "Env", "Hopper", "PointMass", "get_environment", "register_environment"]
+
+
+class State(NamedTuple):
+    """Environment state, structurally identical to ``brax.envs.base.State``:
+    the fields the rollout adapter and the renderer consume (a NamedTuple
+    pytree with a brax-style ``replace``)."""
+
+    pipeline_state: PipelineState
+    obs: jax.Array
+    reward: jax.Array
+    done: jax.Array  # float32, like brax; consumers cast to bool
+    metrics: dict = {}
+    info: dict = {}
+
+    def replace(self, **updates) -> "State":
+        return self._replace(**updates)
+
+
+class Env:
+    """Base class: subclasses set ``sys`` and implement pure ``reset``/``step``."""
+
+    sys: System
+
+    def reset(self, key: jax.Array) -> State:
+        raise NotImplementedError
+
+    def step(self, state: State, action: jax.Array) -> State:
+        raise NotImplementedError
+
+    @property
+    def observation_size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def action_size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def dt(self) -> float:
+        return self.sys.dt
+
+
+class Hopper(Env):
+    """One-legged vertical hopper: a torso and a foot coupled by an actuated
+    leg spring, hopping on penalty ground contact.  The single action
+    modulates the leg's rest length (thrust).  Reward = alive bonus +
+    torso height + upward-velocity shaping − control cost; the episode
+    ends when the torso collapses below 0.35 m."""
+
+    def __init__(self):
+        self.sys = System(
+            dt=0.02,
+            n_substeps=4,
+            gravity=9.8,
+            mass=jnp.array([1.0, 0.2]),
+            radius=jnp.array([0.15, 0.08]),
+            link_idx=jnp.array([[0, 1]]),
+            link_length=jnp.array([0.6]),
+            link_stiffness=jnp.array([400.0]),
+            link_damping=jnp.array([8.0]),
+            actuator_gain=jnp.array([0.5]),
+        )
+
+    def _obs(self, ps: PipelineState) -> jax.Array:
+        leg = ps.q[0] - ps.q[1]
+        return jnp.concatenate(
+            [ps.q[:, 1], ps.qd[:, 1], jnp.linalg.norm(leg, keepdims=True)]
+        )
+
+    def reset(self, key: jax.Array) -> State:
+        jitter = 0.05 * jax.random.uniform(key, (2,), minval=-1.0, maxval=1.0)
+        q = jnp.array([[0.0, 0.75], [0.0, 0.1]]).at[:, 1].add(jitter)
+        ps = pipeline_init(self.sys, q, jnp.zeros((2, 2)))
+        return State(
+            pipeline_state=ps,
+            obs=self._obs(ps),
+            reward=jnp.asarray(0.0),
+            done=jnp.asarray(0.0),
+        )
+
+    def step(self, state: State, action: jax.Array) -> State:
+        u = jnp.clip(action.reshape(()), -1.0, 1.0)
+        ps = pipeline_step(self.sys, state.pipeline_state, u)
+        torso_z, torso_zd = ps.q[0, 1], ps.qd[0, 1]
+        reward = 1.0 + torso_z + 0.1 * jnp.maximum(torso_zd, 0.0) - 0.01 * u**2
+        done = (torso_z < 0.35).astype(jnp.float32)
+        return state.replace(pipeline_state=ps, obs=self._obs(ps), reward=reward, done=done)
+
+    @property
+    def observation_size(self) -> int:
+        return 5
+
+    @property
+    def action_size(self) -> int:
+        return 1
+
+
+class PointMass(Env):
+    """Force-controlled point mass homing to the origin in the x-z plane
+    (no gravity); reward = −distance, done when it escapes the 4 m box."""
+
+    def __init__(self):
+        self.sys = System(
+            dt=0.05,
+            n_substeps=1,
+            gravity=0.0,
+            mass=jnp.array([1.0]),
+            radius=jnp.array([0.1]),
+            link_idx=jnp.zeros((0, 2), jnp.int32),
+            link_length=jnp.zeros((0,)),
+            link_stiffness=jnp.zeros((0,)),
+            link_damping=jnp.zeros((0,)),
+            actuator_gain=jnp.zeros((0,)),
+            contact_stiffness=0.0,
+            contact_damping=0.0,
+            friction=0.0,
+        )
+
+    def reset(self, key: jax.Array) -> State:
+        q = jax.random.uniform(key, (1, 2), minval=-1.0, maxval=1.0)
+        ps = pipeline_init(self.sys, q, jnp.zeros((1, 2)))
+        return State(
+            pipeline_state=ps,
+            obs=jnp.concatenate([ps.q[0], ps.qd[0]]),
+            reward=jnp.asarray(0.0),
+            done=jnp.asarray(0.0),
+        )
+
+    def step(self, state: State, action: jax.Array) -> State:
+        ps = state.pipeline_state
+        f = jnp.clip(action.reshape(2), -1.0, 1.0)
+        qd = 0.95 * ps.qd + self.sys.dt * f[None, :]
+        q = ps.q + self.sys.dt * qd
+        ps = PipelineState(q=q, qd=qd)
+        dist = jnp.linalg.norm(q[0])
+        return state.replace(
+            pipeline_state=ps,
+            obs=jnp.concatenate([q[0], qd[0]]),
+            reward=-dist,
+            done=(dist > 4.0).astype(jnp.float32),
+        )
+
+    @property
+    def observation_size(self) -> int:
+        return 4
+
+    @property
+    def action_size(self) -> int:
+        return 2
+
+
+_registry = {"hopper": Hopper, "pointmass": PointMass}
+
+
+def register_environment(name: str, cls) -> None:
+    _registry[name] = cls
+
+
+def get_environment(env_name: str, backend: str | None = None, **kwargs) -> Env:
+    """Instantiate a registered environment (brax signature; the planar
+    pipeline has a single backend, so ``backend`` is accepted and ignored)."""
+    if env_name not in _registry:
+        raise ValueError(
+            f"unknown minibrax env {env_name!r}; available: {sorted(_registry)}"
+        )
+    return _registry[env_name](**kwargs)

@@ -1,0 +1,3 @@
+from . import html, image
+
+__all__ = ["html", "image"]

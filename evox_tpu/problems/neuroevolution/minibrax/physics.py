@@ -1,0 +1,105 @@
+"""minibrax physics: a tiny planar rigid-body pipeline in pure JAX.
+
+This is a real (if small) physics engine, not a mock: bodies are point
+masses in the x-z plane integrated by semi-implicit Euler under gravity,
+coupled by actuated spring-damper joints, with penalty-based ground
+contact (normal spring-damper when a body's collision sphere penetrates
+the z=0 plane).  It exists so the :class:`~evox_tpu.problems.
+neuroevolution.BraxProblem` adapter — whose upstream engine
+(``google/brax``) is not installable in this image — can be executed
+end-to-end against an engine honouring the same API (cf. the reference's
+live-engine lane, ``/root/reference/unit_test/problems/test_brax.py:49-140``).
+
+Everything is pure jnp on static shapes, so rollouts run inside
+``lax.scan`` / ``vmap`` / ``jit`` exactly like brax's MJX pipelines do.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class System(NamedTuple):
+    """Static description of a minibrax scene.
+
+    ``link_idx`` is an (n_links, 2) int array of body-index pairs coupled
+    by actuated spring-damper joints; per-link arrays give rest length,
+    stiffness, damping and actuator gain (an action scales a link's rest
+    length, modelling a linear actuator in series with the spring).
+    """
+
+    dt: float
+    n_substeps: int
+    gravity: float
+    mass: jax.Array  # (n_bodies,)
+    radius: jax.Array  # (n_bodies,) collision-sphere radii
+    link_idx: jax.Array  # (n_links, 2) int
+    link_length: jax.Array  # (n_links,)
+    link_stiffness: jax.Array  # (n_links,)
+    link_damping: jax.Array  # (n_links,)
+    actuator_gain: jax.Array  # (n_links,) rest-length modulation per unit action
+    contact_stiffness: float = 4000.0
+    contact_damping: float = 40.0
+    friction: float = 1.0
+
+
+class PipelineState(NamedTuple):
+    """Dynamic state: positions ``q`` and velocities ``qd``, (n_bodies, 2)
+    arrays over the (x, z) plane — the role brax's ``pipeline_state`` plays
+    for its generalized/spring pipelines.  A NamedTuple, so it is a pytree
+    with no dependencies beyond jax itself."""
+
+    q: jax.Array
+    qd: jax.Array
+
+
+def pipeline_init(sys: System, q: jax.Array, qd: jax.Array) -> PipelineState:
+    return PipelineState(q=jnp.asarray(q, jnp.float32), qd=jnp.asarray(qd, jnp.float32))
+
+
+def _forces(sys: System, q: jax.Array, qd: jax.Array, act: jax.Array) -> jax.Array:
+    """Net force on every body: gravity + joints + ground contact."""
+    f = jnp.zeros_like(q).at[:, 1].add(-sys.gravity * sys.mass)
+
+    # Actuated spring-damper links.  An action u modulates the rest length:
+    # rest = length * (1 + gain * u), clipped to stay positive.
+    a, b = sys.link_idx[:, 0], sys.link_idx[:, 1]
+    delta = q[b] - q[a]  # (n_links, 2)
+    dist = jnp.linalg.norm(delta, axis=-1)
+    direction = delta / jnp.maximum(dist, 1e-6)[:, None]
+    rest = sys.link_length * jnp.clip(1.0 + sys.actuator_gain * act, 0.2, 1.8)
+    rel_vel = jnp.sum((qd[b] - qd[a]) * direction, axis=-1)
+    mag = sys.link_stiffness * (dist - rest) + sys.link_damping * rel_vel
+    link_f = mag[:, None] * direction  # pulls a toward b when stretched
+    f = f.at[a].add(link_f).at[b].add(-link_f)
+
+    # Ground contact: penalty normal force + simple viscous friction while
+    # a body's sphere penetrates the z=0 plane.
+    penetration = jnp.maximum(sys.radius - q[:, 1], 0.0)
+    in_contact = penetration > 0.0
+    normal = sys.contact_stiffness * penetration - sys.contact_damping * jnp.minimum(
+        qd[:, 1], 0.0
+    ) * (penetration > 0.0)
+    f = f.at[:, 1].add(jnp.where(in_contact, jnp.maximum(normal, 0.0), 0.0))
+    f = f.at[:, 0].add(jnp.where(in_contact, -sys.friction * qd[:, 0] * sys.mass, 0.0))
+    return f
+
+
+def pipeline_step(sys: System, state: PipelineState, act: jax.Array) -> PipelineState:
+    """Advance one control step (``n_substeps`` semi-implicit Euler steps)."""
+    h = sys.dt / sys.n_substeps
+
+    def substep(carry, _):
+        q, qd = carry
+        f = _forces(sys, q, qd, act)
+        qd = qd + h * f / sys.mass[:, None]
+        q = q + h * qd
+        return (q, qd), None
+
+    (q, qd), _ = jax.lax.scan(
+        substep, (state.q, state.qd), None, length=sys.n_substeps
+    )
+    return PipelineState(q=q, qd=qd)

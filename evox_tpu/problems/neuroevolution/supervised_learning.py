@@ -1,22 +1,36 @@
 """Supervised-learning fitness: loss of a population of model weights.
 
 TPU-native counterpart of the reference SupervisedLearningProblem
-(``src/evox/problems/neuroevolution/supervised_learning.py:15-165``).  The
-reference streams batches from a torch ``DataLoader`` through a host-side
-iterator (an un-jittable side effect it must hide behind custom ops); here
-the dataset lives on device as arrays and the batch cursor is part of the
-problem *state*, so evaluation — vmapped model forward over the stacked
-population included — is one pure jitted function, HPO-vmappable for free
-(the reference explicitly cannot support that; its warning at
-``supervised_learning.py:38-40``).
+(``src/evox/problems/neuroevolution/supervised_learning.py:15-165``).  Two
+data paths:
+
+* **Device-resident** (``inputs=``/``labels=``): the dataset lives on
+  device as arrays and the batch cursor is part of the problem *state*, so
+  evaluation — vmapped model forward over the stacked population included —
+  is one pure jitted function, HPO-vmappable for free (the reference
+  explicitly cannot support that; its warning at
+  ``supervised_learning.py:38-40``).
+* **Host-streaming** (``data_source=``): any iterable of ``(inputs,
+  labels)`` host batches (a torch ``DataLoader`` works as-is — the
+  reference's only mode), drained through an ordered ``io_callback`` with a
+  background prefetch thread, so datasets larger than device memory
+  stream in batch-by-batch.  Each evaluation fetches its batches *once*
+  and shares them across the whole population (fitness stays comparable).
+  Like the reference's loader path, this mode is not HPO-vmappable, and
+  the loader position lives on the host (not in the checkpointable state).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import queue
+import threading
+import weakref
+from typing import Any, Callable, Iterable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
 
 from ...core import Problem, State
 
@@ -30,34 +44,67 @@ class SupervisedLearningProblem(Problem):
     def __init__(
         self,
         apply_fn: Callable[[Any, jax.Array], jax.Array],
-        inputs: jax.Array,
-        labels: jax.Array,
-        criterion: Callable[[jax.Array, jax.Array], jax.Array],
+        inputs: jax.Array | None = None,
+        labels: jax.Array | None = None,
+        criterion: Callable[[jax.Array, jax.Array], jax.Array] | None = None,
         batch_size: int | None = None,
         n_batch_per_eval: int = 1,
         reduction: str = "mean",
+        data_source: Iterable | None = None,
+        prefetch: int = 2,
     ):
         """
         :param apply_fn: pure model forward ``(params, batched_inputs) ->
             predictions`` (e.g. ``flax_module.apply`` or a pytree-MLP fn).
-        :param inputs: full input array, leading axis = examples.
+        :param inputs: full input array, leading axis = examples
+            (device-resident path; mutually exclusive with ``data_source``).
         :param labels: full label array, aligned with ``inputs``.
         :param criterion: per-example loss ``(pred, label) -> (batch,)`` or a
             scalar-reducing loss; non-scalar outputs are reduced here per
             ``reduction``.
-        :param batch_size: minibatch size; ``None`` uses the whole dataset.
+        :param batch_size: minibatch size; ``None`` uses the whole dataset
+            (device-resident path only — streaming batches arrive pre-sized).
         :param n_batch_per_eval: batches consumed per evaluation; ``-1``
-            sweeps the full dataset every evaluation.
+            sweeps the full dataset every evaluation (device-resident only).
         :param reduction: ``"mean"`` or ``"sum"`` over examples.
+        :param data_source: host-streaming path — any iterable yielding
+            ``(inputs, labels)`` batches (numpy / torch CPU tensors / lists);
+            re-iterated from the start when exhausted (epochs).  All batches
+            must share the first batch's shape (ragged final batches are
+            skipped).
+        :param prefetch: streaming path: batches buffered ahead by the
+            producer thread.
         """
         assert reduction in ("mean", "sum")
+        assert criterion is not None, "criterion is required"
+        self.apply_fn = apply_fn
+        self.reduction = reduction
+        self.criterion = criterion
+
+        if data_source is not None:
+            assert inputs is None and labels is None, (
+                "pass either device-resident inputs/labels or a streaming "
+                "data_source, not both"
+            )
+            assert n_batch_per_eval >= 1, (
+                "n_batch_per_eval=-1 (full sweep) is undefined for a "
+                "streaming data_source"
+            )
+            self.n_batch_per_eval = n_batch_per_eval
+            self._init_streaming(data_source, prefetch)
+            return
+
+        self.streaming = False
+        assert inputs is not None and labels is not None, (
+            "provide either device-resident inputs/labels or a streaming "
+            "data_source"
+        )
         n = inputs.shape[0]
         if batch_size is None:
             batch_size = n
         assert batch_size <= n, (
             f"batch_size ({batch_size}) exceeds the dataset size ({n})"
         )
-        self.apply_fn = apply_fn
         self.inputs = jnp.asarray(inputs)
         self.labels = jnp.asarray(labels)
         self.batch_size = batch_size
@@ -65,8 +112,98 @@ class SupervisedLearningProblem(Problem):
         if n_batch_per_eval == -1:
             n_batch_per_eval = self.num_batches
         self.n_batch_per_eval = n_batch_per_eval
-        self.reduction = reduction
-        self.criterion = criterion
+
+    # ---- host-streaming machinery -------------------------------------
+
+    def _init_streaming(self, data_source: Iterable, prefetch: int) -> None:
+        self.streaming = True
+        self._source = data_source
+        self._queue: queue.Queue = queue.Queue(maxsize=max(prefetch, 1))
+        self._producer_started = False
+        # Peek one batch synchronously to learn the fixed batch spec; the
+        # producer keeps consuming this same iterator so the peeked batch
+        # is delivered exactly once and in order.
+        self._first_iter = iter(data_source)
+        first = self._first_batch = self._to_numpy(next(self._first_iter))
+        self._batch_spec = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in first
+        )
+        self.batch_size = first[0].shape[0]
+
+    @staticmethod
+    def _to_numpy(batch) -> tuple[np.ndarray, np.ndarray]:
+        x, y = batch
+        return np.asarray(x), np.asarray(y)
+
+    # The producer runs in a daemon thread that holds only a *weak*
+    # reference to the problem: when the problem is garbage-collected the
+    # thread notices (at its next 1 s put-timeout) and exits, so streaming
+    # instances don't pin themselves/their loaders in memory for process
+    # lifetime.  Module-level function so no bound-method strong ref leaks in.
+    @staticmethod
+    def _producer(prob_ref, q, source, first_iter, first_batch):
+        shapes = (first_batch[0].shape, first_batch[1].shape)
+
+        def put(item) -> bool:
+            while prob_ref() is not None:
+                try:
+                    q.put(item, timeout=1.0)
+                    return True
+                except queue.Full:
+                    pass
+            return False  # problem collected: stop producing
+
+        if not put(first_batch):
+            return
+        it = first_iter  # continue past the peeked batch, then re-epoch
+        while True:
+            delivered = False
+            for batch in it:
+                x = np.asarray(batch[0])
+                y = np.asarray(batch[1])
+                if (x.shape, y.shape) != shapes:  # ragged final batch: skip
+                    continue
+                if not put((x, y)):
+                    return
+                delivered = True
+            new_it = iter(source)
+            if new_it is it or not delivered:
+                # One-shot iterator (iter() returned the exhausted iterator
+                # itself, e.g. a plain generator) or an epoch with zero
+                # usable batches: surface a clear error instead of
+                # busy-spinning while evaluate() blocks forever.
+                put((
+                    "__stream_error__",
+                    "data_source exhausted and not re-iterable (pass a "
+                    "re-iterable like a list, Dataset or DataLoader, not a "
+                    "one-shot generator), or it yielded no batch matching "
+                    f"the first batch's shapes {shapes}",
+                ))
+                return
+            it = new_it
+
+    def _host_next(self) -> tuple[np.ndarray, np.ndarray]:
+        if not self._producer_started:
+            self._producer_started = True
+            threading.Thread(
+                target=self._producer,
+                args=(
+                    weakref.ref(self),
+                    self._queue,
+                    self._source,
+                    self._first_iter,
+                    self._first_batch,
+                ),
+                daemon=True,
+            ).start()
+        item = self._queue.get()
+        if isinstance(item[0], str):  # ("__stream_error__", message)
+            raise RuntimeError(item[1])
+        x, y = item
+        spec = self._batch_spec
+        return x.astype(spec[0].dtype, copy=False), y.astype(spec[1].dtype, copy=False)
+
+    # -------------------------------------------------------------------
 
     def setup(self, key: jax.Array) -> State:
         del key
@@ -79,6 +216,9 @@ class SupervisedLearningProblem(Problem):
         return x, y
 
     def evaluate(self, state: State, pop_params: Any) -> tuple[jax.Array, State]:
+        if self.streaming:
+            return self._evaluate_streaming(state, pop_params)
+
         def one_model_loss(params):
             def batch_loss(i):
                 x, y = self._batch(state.batch_cursor + i)
@@ -94,6 +234,25 @@ class SupervisedLearningProblem(Problem):
             % self.num_batches
         )
         return fitness, new_state
+
+    def _evaluate_streaming(self, state: State, pop_params: Any) -> tuple[jax.Array, State]:
+        # Fetch this evaluation's batches ONCE (ordered host callbacks keep
+        # source order under jit), then share them across the population.
+        batches = [
+            io_callback(self._host_next, self._batch_spec, ordered=True)
+            for _ in range(self.n_batch_per_eval)
+        ]
+        xs = jnp.stack([b[0] for b in batches])
+        ys = jnp.stack([b[1] for b in batches])
+
+        def one_model_loss(params):
+            losses = jax.vmap(
+                lambda x, y: self.criterion_value(self.apply_fn(params, x), y)
+            )(xs, ys)
+            return jnp.mean(losses) if self.reduction == "mean" else jnp.sum(losses)
+
+        fitness = jax.vmap(one_model_loss)(pop_params)
+        return fitness, state.replace(batch_cursor=state.batch_cursor + 1)
 
     def criterion_value(self, pred: jax.Array, label: jax.Array) -> jax.Array:
         out = self.criterion(pred, label)
