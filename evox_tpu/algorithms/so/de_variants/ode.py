@@ -21,6 +21,10 @@ __all__ = ["ODE"]
 class ODE(DE):
     """Opposition-based DE (Rahnamayan et al., 2008)."""
 
+    # Two top-level evaluations per generation (DE offspring + opposition
+    # mirror); declares the count for the workflow's evaluation-count guard.
+    max_evaluations_per_step = 2
+
     def step(self, state: State, evaluate: EvalFn) -> State:
         state = super().step(state, evaluate)
 

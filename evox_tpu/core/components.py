@@ -13,9 +13,14 @@ Contract differences from the reference, by design:
 * ``Algorithm.step(state, evaluate) -> state`` receives the evaluation
   callback explicitly instead of a workflow-injected ``self.evaluate`` proxy
   (reference ``components.py:35-46`` + dynamic subclassing in
-  ``std_workflow.py:116-125``).  The callback must be called **exactly once
-  per step, at the top trace level** (not under ``lax.cond``/``scan``) — the
-  same implicit contract the reference's compiled path has.
+  ``std_workflow.py:116-125``).  The callback must be called **at the top
+  trace level** (never under ``lax.cond``/``scan``, which trace it per
+  branch/iteration), **once per step** by default — algorithms that
+  genuinely evaluate several populations per step (e.g. ODE's opposition
+  phase) declare the count via a ``max_evaluations_per_step`` class
+  attribute.  ``StdWorkflow`` enforces this at trace time (zero calls or
+  calls beyond the declared limit raise a descriptive error) — the same
+  contract the reference's compiled path leaves implicit.
 * Problems and monitors thread their own sub-states explicitly; there is no
   module-global side channel.  Host-side history uses ``io_callback``
   (see ``workflows/eval_monitor.py``).

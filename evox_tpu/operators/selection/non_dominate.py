@@ -86,8 +86,16 @@ def _dominance_matrix(f: jax.Array) -> jax.Array:
     box's remote tunnel hung the single-client relay for >15 min — so the
     kernel must never dispatch unless the attachment is known-good.  Below
     ``EVOX_TPU_PALLAS_MIN_POP`` (default 4096) the broadcast path wins on
-    fusion alone and is always used."""
+    fusion alone and is always used.
+
+    float64 objectives (``jax_enable_x64``) on a real TPU stay on the
+    broadcast path even when the gate is open: Mosaic has no f64 tile
+    compare, so dispatching the kernel would fail at compile time rather
+    than fall back (and downcasting inside the kernel could rank
+    differently from the XLA path)."""
     if f.ndim == 2 and f.shape[0] >= _pallas_min_pop():
+        if f.dtype == jnp.float64 and jax.default_backend() == "tpu":
+            return dominate_relation(f, f)
         from ...ops.pallas_gate import pallas_enabled
 
         if pallas_enabled():

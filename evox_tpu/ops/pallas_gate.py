@@ -8,12 +8,15 @@ a Pallas kernel unless the gate opens:
 
 * ``EVOX_TPU_PALLAS`` unset / ``"0"`` — gate closed (default; XLA paths).
 * ``EVOX_TPU_PALLAS=probe`` — open iff a cached capability-probe verdict for
-  the CURRENT backend says Pallas works.  The probe itself is **explicit**::
+  the CURRENT attachment identity (backend + device kind + optional
+  ``EVOX_TPU_ATTACHMENT_ID``) says Pallas works.  The probe itself is
+  **explicit**::
 
       python -m evox_tpu.ops.pallas_gate   # run the probe, cache verdict
 
   It runs a tiny ``pallas_call`` in a fresh subprocess with a hard timeout
-  and caches the verdict (pass / fail / timeout, keyed by backend) at
+  and caches the verdict (pass / fail / timeout, keyed by attachment
+  identity: backend + device kind + optional ``EVOX_TPU_ATTACHMENT_ID``) at
   :data:`PROBE_RECORD_PATH`.  The probe is NOT run lazily from inside a
   trace: on single-client attachments the library's own process already
   holds the device, so a lazily-spawned probe subprocess would block on it,
@@ -66,24 +69,44 @@ out = pl.pallas_call(
 )(x)
 out.block_until_ready()
 assert float(out[0, 0]) == 2.0
-print(f"PALLAS_PROBE_OK backend={jax.default_backend()} "
-      f"elapsed={time.time() - t0:.1f}s", flush=True)
+print(f"PALLAS_PROBE_OK elapsed={time.time() - t0:.1f}s "
+      f"backend={jax.default_backend()} "
+      f"kind={jax.devices()[0].device_kind}", flush=True)
 """
 
 
-def _current_backend() -> str:
-    """Identity of the attachment a verdict applies to.  Calling this from
+def _attachment_key(backend: str, device_kind: str | None) -> str:
+    """Identity a verdict applies to: backend name + device kind (+ an
+    optional operator-set ``EVOX_TPU_ATTACHMENT_ID``).  A bare backend name
+    ("tpu") is too coarse — a verdict recorded on one Mosaic-capable
+    attachment must not open the gate on a different attachment of the same
+    backend type sharing this home directory (e.g. the relay type the gate
+    exists to protect)."""
+    parts = [backend]
+    if device_kind:
+        parts.append(device_kind)
+    attachment_id = os.environ.get("EVOX_TPU_ATTACHMENT_ID")
+    if attachment_id:
+        parts.append(attachment_id)
+    return "|".join(parts)
+
+
+def _current_attachment_key() -> str:
+    """Identity of the current process's attachment.  Calling this from
     ``pallas_enabled`` is safe: the gate is only consulted mid-trace, when a
     backend is already initialized."""
     import jax
 
-    return jax.default_backend()
+    devices = jax.devices()
+    kind = devices[0].device_kind if devices else None
+    return _attachment_key(jax.default_backend(), kind)
 
 
 def _load_records() -> dict:
-    """The on-disk verdict store: ``{backend_name: record}`` — one slot per
-    backend, so alternating CPU/TPU runs don't clobber each other's
-    verdict."""
+    """The on-disk verdict store: ``{attachment_key: record}`` — one slot
+    per attachment identity, so alternating CPU/TPU runs (or different TPU
+    attachments sharing this home directory) don't clobber or inherit each
+    other's verdict."""
     if os.path.exists(PROBE_RECORD_PATH):
         try:
             with open(PROBE_RECORD_PATH) as f:
@@ -99,8 +122,8 @@ def _load_records() -> dict:
 
 def run_capability_probe(timeout_s: float = _PROBE_TIMEOUT_S) -> dict:
     """Run the Pallas capability probe in a subprocess and cache the verdict
-    on disk, keyed by the current backend.  Returns the record dict
-    ``{"ok": bool, ...}``.
+    on disk, keyed by the current attachment identity.  Returns the record
+    dict ``{"ok": bool, ...}``.
 
     Run this from a process that is NOT already holding a single-client
     attachment (fresh shell: ``python -m evox_tpu.ops.pallas_gate``) — the
@@ -112,7 +135,6 @@ def run_capability_probe(timeout_s: float = _PROBE_TIMEOUT_S) -> dict:
     """
     t0 = time.time()
     record: dict = {"timeout_s": timeout_s, "probed_at": int(t0)}
-    backend = None
     out = err = ""
     try:
         proc = subprocess.run(
@@ -141,19 +163,22 @@ def run_capability_probe(timeout_s: float = _PROBE_TIMEOUT_S) -> dict:
         record.update(
             ok=False, detail=f"timeout after {timeout_s}s (Mosaic hang?)"
         )
-    m = re.search(r"backend=(\w+)", out)
+    m = re.search(r"backend=(\w+) kind=(.+)$", out.strip(), re.MULTILINE)
     if m:
-        backend = m.group(1)
+        key = _attachment_key(m.group(1), m.group(2).strip())
+        record["backend"] = m.group(1)
+        record["device_kind"] = m.group(2).strip()
     else:
-        # Child never reported a backend (failed/timed out before init
+        # Child never reported its identity (failed/timed out before init
         # completed).  The child has exited, so initializing here no longer
         # contends with it; if the attachment itself is wedged this may
         # still block — acceptable in the explicit CLI, never on a library
         # code path.
-        backend = _current_backend()
-    record["backend"] = backend
+        key = _current_attachment_key()
+        record["backend"] = key.split("|")[0]
+    record["attachment"] = key
     records = _load_records()
-    records[backend] = record
+    records[key] = record
     try:
         with open(PROBE_RECORD_PATH, "w") as f:
             json.dump(records, f, indent=1)
@@ -172,13 +197,13 @@ def pallas_enabled() -> bool:
     if flag in ("1", "force", "on", "true"):
         _cached = True
     elif flag == "probe":
-        record = _load_records().get(_current_backend())
+        record = _load_records().get(_current_attachment_key())
         if record is None:
             import warnings
 
             warnings.warn(
                 "EVOX_TPU_PALLAS=probe, but no capability verdict exists "
-                f"for backend {_current_backend()!r}; the gate stays CLOSED. "
+                f"for attachment {_current_attachment_key()!r}; the gate stays CLOSED. "
                 "Run `python -m evox_tpu.ops.pallas_gate` (from a fresh "
                 "process, before your workload) to probe this attachment.",
                 stacklevel=2,

@@ -274,11 +274,21 @@ def plot_obj_space_3d(
                 name="Pareto front",
             )
         ]
+    # Fixed scene ranges from the full history (like the 2D paths): frames
+    # of an animation must not rescale, and the static figure should frame
+    # identically to its animated counterpart.
+    all_fit = np.concatenate(fitness_history, axis=0)
+    scene = {
+        axis: {"range": _padded_range(all_fit[:, i])}
+        for i, axis in enumerate(("xaxis", "yaxis", "zaxis"))
+    }
+    scene.update(kwargs.pop("scene", {}))  # caller's scene opts (camera, ...) win
+    layout = dict(scene=scene, **kwargs)
     if not animation:
         traces = _generation_colored_overlay(
             fitness_history, pf_trace, go.Scatter3d, ("x", "y", "z")
         )
-        return go.Figure(data=traces, layout=go.Layout(**kwargs))
+        return go.Figure(data=traces, layout=go.Layout(**layout))
     frames = [
         pf_trace
         + [
@@ -292,4 +302,4 @@ def plot_obj_space_3d(
         ]
         for f in fitness_history
     ]
-    return _animated_scatter(frames, dict(**kwargs))
+    return _animated_scatter(frames, layout)

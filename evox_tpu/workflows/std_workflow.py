@@ -141,6 +141,31 @@ class StdWorkflow(Workflow):
 
     def _make_evaluate(self, carrier: dict) -> Callable:
         def evaluate(pop):
+            # Trace-time enforcement of the evaluation-count contract
+            # (``core/components.py`` module docstring): an unexpected extra
+            # call — the signature of evaluate under ``lax.cond``/``scan``,
+            # which traces the closure per branch/iteration — would silently
+            # corrupt the monitor/problem sub-state threading through the
+            # carrier, so fail loudly instead.  Algorithms that genuinely
+            # evaluate k>1 populations per step at the top trace level
+            # (e.g. ODE: parents + opposition mirror) declare it via a
+            # ``max_evaluations_per_step`` class attribute.
+            carrier["n_evaluate_calls"] += 1
+            limit = getattr(self.algorithm, "max_evaluations_per_step", 1)
+            if carrier["n_evaluate_calls"] > limit:
+                raise RuntimeError(
+                    f"{type(self.algorithm).__name__} called the workflow's "
+                    f"`evaluate` closure more than its declared limit of "
+                    f"{limit} call(s) per step. Calls must happen at the top "
+                    "trace level: calling evaluate inside `lax.cond`/"
+                    "`lax.scan`/`lax.while_loop` traces it per branch/"
+                    "iteration and corrupts the monitor/problem state "
+                    "threading — evaluate first, then select from the "
+                    "*fitness* with `jnp.where`/`lax.cond`. If the "
+                    "algorithm legitimately evaluates several populations "
+                    "per step, declare `max_evaluations_per_step` on the "
+                    "algorithm class."
+                )
             mon = self.monitor.post_ask(carrier["monitor"], pop)
             if self.solution_transform is not None:
                 pop = self.solution_transform(pop)
@@ -158,10 +183,22 @@ class StdWorkflow(Workflow):
 
     # -- stepping ----------------------------------------------------------
     def _step(self, state: State, which: str) -> State:
-        carrier = {"problem": state.problem, "monitor": state.monitor}
+        carrier = {
+            "problem": state.problem,
+            "monitor": state.monitor,
+            "n_evaluate_calls": 0,
+        }
         evaluate = self._make_evaluate(carrier)
         algo_step = getattr(self.algorithm, which)
         algo_state = algo_step(state.algorithm, evaluate)
+        if carrier["n_evaluate_calls"] == 0:
+            raise RuntimeError(
+                f"{type(self.algorithm).__name__}.{which} never called the "
+                "workflow's `evaluate` closure: every step must evaluate the "
+                "population exactly once (the fitness drives the monitor and "
+                "problem state threading). If the algorithm hides the call "
+                "under `lax.cond`, hoist it to the top trace level."
+            )
         mon_state = carrier["monitor"]
         # Feed auxiliary algorithm records to the monitor only when the
         # monitor actually overrides the hook (reference ``:178-180``).

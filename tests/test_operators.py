@@ -123,26 +123,42 @@ def test_pallas_gate_modes(monkeypatch, tmp_path):
     pallas_gate._reset_for_tests()
     with pytest.warns(UserWarning, match="not recognized"):
         assert pallas_gate.pallas_enabled() is False
-    # probe mode reads the cached on-disk verdict for THIS backend; it never
+    # probe mode reads the cached on-disk verdict for THIS attachment
+    # (backend + device kind + optional EVOX_TPU_ATTACHMENT_ID); it never
     # probes lazily (a lazily-spawned probe would contend with this process
     # for a single-client attachment).
-    backend = jax.default_backend()
+    monkeypatch.delenv("EVOX_TPU_ATTACHMENT_ID", raising=False)
+    attachment = pallas_gate._current_attachment_key()
     record = tmp_path / "probe.json"
-    record.write_text(json.dumps({backend: {"ok": True, "backend": backend}}))
+    record.write_text(json.dumps({attachment: {"ok": True, "attachment": attachment}}))
     monkeypatch.setattr(pallas_gate, "PROBE_RECORD_PATH", str(record))
     monkeypatch.setenv("EVOX_TPU_PALLAS", "probe")
     pallas_gate._reset_for_tests()
     assert pallas_gate.pallas_enabled() is True
     record.write_text(
-        json.dumps({backend: {"ok": False, "detail": "timeout", "backend": backend}})
+        json.dumps(
+            {attachment: {"ok": False, "detail": "timeout", "attachment": attachment}}
+        )
     )
     pallas_gate._reset_for_tests()
     assert pallas_gate.pallas_enabled() is False
-    # A verdict recorded on a DIFFERENT attachment proves nothing here:
-    # gate stays closed, with a pointer at the explicit probe CLI.
-    record.write_text(
-        json.dumps({"not-this-backend": {"ok": True, "backend": "not-this-backend"}})
-    )
+    # A verdict recorded on a DIFFERENT attachment proves nothing here —
+    # including a pre-r5 record keyed by the bare backend name: a pass on
+    # one TPU attachment must not open the gate on another TPU attachment
+    # sharing this home directory.  Gate stays closed, pointing at the
+    # explicit probe CLI.
+    backend_only = jax.default_backend()
+    for foreign_key in ("not-this-backend", backend_only):
+        record.write_text(
+            json.dumps({foreign_key: {"ok": True, "attachment": foreign_key}})
+        )
+        pallas_gate._reset_for_tests()
+        with pytest.warns(UserWarning, match="no capability verdict"):
+            assert pallas_gate.pallas_enabled() is False, foreign_key
+    # The explicit attachment-id env var refines the key further: a verdict
+    # recorded without it no longer matches once it is set.
+    record.write_text(json.dumps({attachment: {"ok": True}}))
+    monkeypatch.setenv("EVOX_TPU_ATTACHMENT_ID", "relay-b")
     pallas_gate._reset_for_tests()
     with pytest.warns(UserWarning, match="no capability verdict"):
         assert pallas_gate.pallas_enabled() is False

@@ -342,3 +342,55 @@ def test_multigeneration_run_with_monitor():
 def test_distributed_divisibility_error():
     with pytest.raises(ValueError, match="divisible"):
         StdWorkflow(PSO(POP + 1, LB, UB), Sphere(), enable_distributed=True)
+
+
+class _DoubleEvalAlgo:
+    """Misbehaving algorithm: calls evaluate twice per step."""
+
+    def setup(self, key):
+        from evox_tpu.core import State
+
+        return State(pop=jnp.zeros((4, DIM)))
+
+    def step(self, state, evaluate):
+        evaluate(state.pop)
+        evaluate(state.pop)
+        return state
+
+    init_step = step
+    final_step = step
+
+    def record_step(self, state):
+        return {}
+
+
+class _NoEvalAlgo:
+    """Misbehaving algorithm: never calls evaluate."""
+
+    def setup(self, key):
+        from evox_tpu.core import State
+
+        return State(pop=jnp.zeros((4, DIM)))
+
+    def step(self, state, evaluate):
+        return state
+
+    init_step = step
+    final_step = step
+
+    def record_step(self, state):
+        return {}
+
+
+def test_evaluate_exactly_once_enforced():
+    """The evaluate-exactly-once contract is a trace-time diagnostic, not a
+    silent corruption (``core/components.py`` contract)."""
+    wf = StdWorkflow(_DoubleEvalAlgo(), Sphere())
+    state = wf.init(jax.random.key(0))
+    with pytest.raises(RuntimeError, match="more than its declared limit"):
+        jax.jit(wf.step)(state)
+
+    wf = StdWorkflow(_NoEvalAlgo(), Sphere())
+    state = wf.init(jax.random.key(0))
+    with pytest.raises(RuntimeError, match="never called"):
+        jax.jit(wf.step)(state)
