@@ -74,12 +74,18 @@ class Algorithm(_Component):
     """
 
     def step(self, state: State, evaluate: EvalFn) -> State:
+        """One ask-eval-tell generation: propose a population, call
+        ``evaluate`` on it (once, at the top trace level), and fold the
+        fitness back into the returned state."""
         raise NotImplementedError
 
     def init_step(self, state: State, evaluate: EvalFn) -> State:
+        """First-generation variant (e.g. evaluate-only); defaults to
+        ``step``."""
         return self.step(state, evaluate)
 
     def final_step(self, state: State, evaluate: EvalFn) -> State:
+        """Last-generation variant; defaults to ``step``."""
         return self.step(state, evaluate)
 
     def record_step(self, state: State) -> dict[str, Any]:
@@ -99,6 +105,8 @@ class Problem(_Component):
     """
 
     def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
+        """Fitness of every candidate in ``pop`` plus the updated problem
+        state (stateless problems return ``state`` unchanged)."""
         raise NotImplementedError
 
 
@@ -106,12 +114,15 @@ class Workflow(_Component):
     """A steppable composition of components (reference ``components.py:72-85``)."""
 
     def init_step(self, state: State) -> State:
+        """First optimization step; defaults to ``step``."""
         return self.step(state)
 
     def step(self, state: State) -> State:
+        """Advance the whole composition by one generation."""
         raise NotImplementedError
 
     def final_step(self, state: State) -> State:
+        """Last optimization step; defaults to ``step``."""
         return self.step(state)
 
 
@@ -123,24 +134,32 @@ class Monitor(_Component):
     """
 
     def set_config(self, **config: Any) -> "Monitor":
+        """Out-of-band configuration from the workflow (e.g. the
+        optimization direction); returns self."""
         return self
 
     def post_ask(self, state: State, population: jax.Array) -> State:
+        """Hook: after the algorithm proposes a population."""
         del population
         return state
 
     def pre_eval(self, state: State, population: jax.Array) -> State:
+        """Hook: after the solution transform, before evaluation."""
         del population
         return state
 
     def post_eval(self, state: State, fitness: jax.Array) -> State:
+        """Hook: on the raw fitness, before direction/fitness transforms."""
         del fitness
         return state
 
     def pre_tell(self, state: State, fitness: jax.Array) -> State:
+        """Hook: on the transformed fitness the algorithm will be told."""
         del fitness
         return state
 
     def record_auxiliary(self, state: State, aux: dict[str, Any]) -> State:
+        """Hook: per-step auxiliary values from ``Algorithm.record_step``
+        (only called when a subclass overrides this method)."""
         del aux
         return state

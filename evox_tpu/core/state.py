@@ -144,10 +144,13 @@ class State(Mapping):
 
     @property
     def param_keys(self) -> frozenset[str]:
+        """Names of the fields labeled as HPO-tunable ``Parameter``s."""
         return self._param_keys
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten_with_keys(self):
+        """Pytree protocol: children keyed by field name; param labels ride
+        in the static aux data."""
         keys = tuple(self._data.keys())
         children = tuple(
             (jax.tree_util.DictKey(k), self._data[k]) for k in keys
@@ -156,6 +159,7 @@ class State(Mapping):
 
     @classmethod
     def tree_unflatten(cls, aux, children):
+        """Pytree protocol: rebuild from ``tree_flatten_with_keys`` output."""
         keys, param_keys = aux
         new = object.__new__(cls)
         object.__setattr__(new, "_data", dict(zip(keys, children)))

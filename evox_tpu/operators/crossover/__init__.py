@@ -1,3 +1,8 @@
+"""Crossover operators (reference ``src/evox/operators/crossover/``):
+SBX full/half and the DE recombination family - pure tensor->tensor
+functions over whole populations.
+"""
+
 __all__ = [
     "DE_differential_sum",
     "DE_exponential_crossover",

@@ -1,3 +1,7 @@
+"""Mutation operators (reference ``src/evox/operators/mutation/``):
+PlatEMO-style polynomial mutation over whole populations.
+"""
+
 __all__ = ["polynomial_mutation"]
 
 from .pm_mutation import polynomial_mutation

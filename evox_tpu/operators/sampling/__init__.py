@@ -1,3 +1,7 @@
+"""Sampling operators (reference ``src/evox/operators/sampling/``):
+Das-Dennis simplex lattices, Latin hypercube, and grid sampling.
+"""
+
 __all__ = [
     "grid_sampling",
     "latin_hypercube_sampling",

@@ -1,3 +1,8 @@
+"""Selection operators (reference ``src/evox/operators/selection/``):
+non-dominated sorting, crowding distance, RVEA reference-vector
+selection, tournaments, and p-best picks.
+"""
+
 __all__ = [
     "crowding_distance",
     "nd_environmental_selection",

@@ -113,6 +113,9 @@ class HPOMonitor(Monitor):
         return _reduce_axis(fit_aggregation, stacked, 0)
 
     def tell_fitness(self, state: State) -> jax.Array:
+        """The scalar (or per-objective) fitness this inner run reports to
+        the outer algorithm.  Abstract: subclasses define what "fitness of
+        a run" means (e.g. best-so-far)."""
         raise NotImplementedError(
             "`tell_fitness` function is not implemented. It must be overwritten."
         )
@@ -154,6 +157,8 @@ class HPOFitnessMonitor(HPOMonitor):
         )
 
     def tell_fitness(self, state: State) -> jax.Array:
+        """Best fitness seen over the inner run (the wrapped workflow's
+        objective value for these hyper-parameters)."""
         return state.best_fitness
 
 
@@ -239,6 +244,7 @@ class HPOProblemWrapper(Problem):
         return params
 
     def get_params_keys(self, state: State) -> list[str]:
+        """Dotted paths of every tunable (``Parameter``-labeled) leaf."""
         return list(self.get_init_params(state).keys())
 
     def evaluate(

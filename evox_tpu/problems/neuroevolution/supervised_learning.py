@@ -255,6 +255,8 @@ class SupervisedLearningProblem(Problem):
         return fitness, state.replace(batch_cursor=state.batch_cursor + 1)
 
     def criterion_value(self, pred: jax.Array, label: jax.Array) -> jax.Array:
+        """Apply ``criterion`` and reduce non-scalar outputs per
+        ``reduction``."""
         out = self.criterion(pred, label)
         if out.ndim > 0:
             out = jnp.mean(out) if self.reduction == "mean" else jnp.sum(out)

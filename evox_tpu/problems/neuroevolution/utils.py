@@ -37,6 +37,7 @@ class MLPPolicy:
         self.dtype = dtype
 
     def init(self, key: jax.Array) -> dict:
+        """Random layer weights/biases as a params dict pytree."""
         params = {}
         for i, (fan_in, fan_out) in enumerate(
             zip(self.layer_sizes[:-1], self.layer_sizes[1:])
@@ -50,6 +51,7 @@ class MLPPolicy:
         return params
 
     def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        """Forward pass: ``x`` through the MLP under ``params``."""
         n_layers = len(self.layer_sizes) - 1
         h = x.astype(self.dtype)
         for i in range(n_layers):

@@ -1,3 +1,8 @@
+"""Numerical benchmark problems (reference ``src/evox/problems/numerical/``):
+classic functions with optional shift/affine transforms, the official
+CEC2022 suite, and DTLZ1-7 with analytic Pareto fronts.
+"""
+
 __all__ = [
     "CEC2022",
     "DTLZ",

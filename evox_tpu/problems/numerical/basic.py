@@ -37,6 +37,7 @@ __all__ = [
 
 
 def ackley_func(a: float, b: float, c: float, x: jax.Array) -> jax.Array:
+    """Ackley function value per row of ``x``."""
     d = x.shape[1]
     return (
         -a * jnp.exp(-b * jnp.sqrt(jnp.sum(x**2, axis=1) / d))
@@ -47,6 +48,7 @@ def ackley_func(a: float, b: float, c: float, x: jax.Array) -> jax.Array:
 
 
 def griewank_func(x: jax.Array) -> jax.Array:
+    """Griewank function value per row of ``x``."""
     d = x.shape[1]
     i = jnp.arange(1, d + 1, dtype=x.dtype)
     return (
@@ -57,17 +59,20 @@ def griewank_func(x: jax.Array) -> jax.Array:
 
 
 def rastrigin_func(x: jax.Array) -> jax.Array:
+    """Rastrigin function value per row of ``x``."""
     d = x.shape[1]
     return 10.0 * d + jnp.sum(x**2 - 10.0 * jnp.cos(2.0 * jnp.pi * x), axis=1)
 
 
 def rosenbrock_func(x: jax.Array) -> jax.Array:
+    """Rosenbrock function value per row of ``x``."""
     return jnp.sum(
         100.0 * (x[:, 1:] - x[:, :-1] ** 2) ** 2 + (x[:, :-1] - 1.0) ** 2, axis=1
     )
 
 
 def schwefel_func(x: jax.Array) -> jax.Array:
+    """Schwefel function value per row of ``x``."""
     d = x.shape[1]
     return 418.9828872724338 * d - jnp.sum(
         x * jnp.sin(jnp.sqrt(jnp.abs(x))), axis=1
@@ -75,10 +80,12 @@ def schwefel_func(x: jax.Array) -> jax.Array:
 
 
 def sphere_func(x: jax.Array) -> jax.Array:
+    """Sphere (sum of squares) value per row of ``x``."""
     return jnp.sum(x**2, axis=1)
 
 
 def ellipsoid_func(x: jax.Array) -> jax.Array:
+    """Ellipsoid function value per row of ``x``."""
     d = x.shape[1]
     i = jnp.arange(1, d + 1, dtype=x.dtype)
     return jnp.sum(i * x**2, axis=1)

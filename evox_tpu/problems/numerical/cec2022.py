@@ -291,10 +291,12 @@ class CEC2022(Problem):
 
     @property
     def lb(self) -> jax.Array:
+        """Decision-space lower bound (CEC2022 domain is [-100, 100]^d)."""
         return jnp.full((self.nx,), -100.0, dtype=self.dtype)
 
     @property
     def ub(self) -> jax.Array:
+        """Decision-space upper bound (CEC2022 domain is [-100, 100]^d)."""
         return jnp.full((self.nx,), 100.0, dtype=self.dtype)
 
     # -- transforms ---------------------------------------------------------

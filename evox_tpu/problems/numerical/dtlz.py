@@ -87,6 +87,8 @@ class DTLZ(Problem):
 
     @property
     def sample(self) -> jax.Array:
+        """Das-Dennis reference directions used to build the analytic
+        Pareto front (lazily enumerated on host)."""
         # Lazy: the host-side Das-Dennis enumeration only runs if pf() is
         # actually requested (and not at all for subclasses that override
         # _make_sample with a different lattice).
@@ -99,10 +101,12 @@ class DTLZ(Problem):
 
     @property
     def lb(self) -> jax.Array:
+        """Decision-space lower bound (zeros; DTLZ domain is [0, 1]^d)."""
         return jnp.zeros((self.d,), dtype=self.dtype)
 
     @property
     def ub(self) -> jax.Array:
+        """Decision-space upper bound (ones; DTLZ domain is [0, 1]^d)."""
         return jnp.ones((self.d,), dtype=self.dtype)
 
     def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
@@ -112,6 +116,7 @@ class DTLZ(Problem):
         raise NotImplementedError
 
     def pf(self) -> jax.Array:
+        """Analytic Pareto-front sample (reference ``dtlz.py`` ``pf``)."""
         return self.sample / 2
 
 

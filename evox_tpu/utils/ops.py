@@ -48,6 +48,9 @@ def switch(label: jax.Array, values: Sequence[jax.Array]) -> jax.Array:
 
 
 def clamp(a: jax.Array, lo: jax.Array, hi: jax.Array) -> jax.Array:
+    """Clamp ``a`` into ``[lo, hi]`` elementwise (= ``jnp.clip``; the
+    reference's ReLU re-implementation exists only for Inductor fusion).
+    ``clamp_int``/``clamp_float``/``clip`` are dtype-named aliases."""
     return jnp.clip(a, lo, hi)
 
 
@@ -57,10 +60,14 @@ clip = clamp
 
 
 def maximum(a, b):
+    """Elementwise maximum (= ``jnp.maximum``); ``maximum_float``/
+    ``maximum_int`` are dtype-named aliases kept for reference parity."""
     return jnp.maximum(a, b)
 
 
 def minimum(a, b):
+    """Elementwise minimum (= ``jnp.minimum``); ``minimum_float``/
+    ``minimum_int`` are dtype-named aliases kept for reference parity."""
     return jnp.minimum(a, b)
 
 
@@ -75,10 +82,12 @@ def lexsort(keys: Sequence[jax.Array] | jax.Array, dim: int = -1) -> jax.Array:
 
 
 def nanmin(a: jax.Array, axis=None, keepdims=False):
+    """NaN-ignoring min (= ``jnp.nanmin``), reference-parity wrapper."""
     return jnp.nanmin(a, axis=axis, keepdims=keepdims)
 
 
 def nanmax(a: jax.Array, axis=None, keepdims=False):
+    """NaN-ignoring max (= ``jnp.nanmax``), reference-parity wrapper."""
     return jnp.nanmax(a, axis=axis, keepdims=keepdims)
 
 

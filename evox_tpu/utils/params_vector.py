@@ -39,19 +39,26 @@ class ParamsAndVector:
 
     @property
     def vector_size(self) -> int:
+        """Length of the flat vector (total parameter count)."""
         return self._size
 
     def to_vector(self, params: Any) -> jax.Array:
+        """Flatten one parameter pytree to a flat vector."""
         flat, _ = ravel_pytree(params)
         return flat
 
     def to_params(self, vector: jax.Array) -> Any:
+        """Rebuild the parameter pytree from one flat vector."""
         return self._unravel(vector)
 
     def batched_to_vector(self, batched_params: Any) -> jax.Array:
+        """Flatten a population of parameter pytrees (leading pop axis) to
+        a (pop, vector_size) matrix."""
         return jax.vmap(self.to_vector)(batched_params)
 
     def batched_to_params(self, vectors: jax.Array) -> Any:
+        """Rebuild a population of parameter pytrees from (pop, vector_size)
+        rows - the workflow ``solution_transform`` direction."""
         return jax.vmap(self._unravel)(vectors)
 
     def __call__(self, vectors: jax.Array) -> Any:

@@ -105,9 +105,12 @@ class EvoXVisionAdapter:
         self.header_written = False
 
     def set_metadata(self, metadata: dict) -> None:
+        """Set the JSON header (schema) to be written by
+        :meth:`write_header`."""
         self.metadata = metadata
 
     def write_header(self) -> None:
+        """Write magic + length-prefixed JSON schema (must precede data)."""
         assert self.metadata is not None, "Metadata must be set before writing the header."
         blob = json.dumps(self.metadata).encode("utf-8")
         self.writer.write(_MAGIC)
@@ -122,10 +125,12 @@ class EvoXVisionAdapter:
         self.writer.writelines(fields)
 
     def flush(self) -> None:
+        """Flush buffered chunks to the underlying stream."""
         if self.writer:
             self.writer.flush()
 
     def close(self) -> None:
+        """Close the underlying stream."""
         if self.writer:
             self.writer.close()
 

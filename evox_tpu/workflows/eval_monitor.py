@@ -237,18 +237,24 @@ class EvalMonitor(Monitor):
 
     @property
     def fitness_history(self) -> list:
+        """Per-generation fitness arrays from the host-side history
+        (``fit_history`` is the reference-parity alias)."""
         return self._grouped(__monitor_history__[self._id_][HistoryType.FITNESS])
 
     fit_history = fitness_history
 
     @property
     def solution_history(self) -> list:
+        """Per-generation solution arrays from the host-side history
+        (requires ``full_sol_history``; ``sol_history`` is the alias)."""
         return self._grouped(__monitor_history__[self._id_][HistoryType.SOLUTION])
 
     sol_history = solution_history
 
     @property
     def auxiliary_history(self) -> dict[str, list]:
+        """Per-key lists of per-generation auxiliary records (from
+        ``Algorithm.record_step``); ``aux_history`` is the alias."""
         raw = __monitor_history__[self._id_][HistoryType.AUXILIARY]
         if not self.aux_keys:
             return {}
@@ -262,6 +268,8 @@ class EvalMonitor(Monitor):
     aux_history = auxiliary_history
 
     def clear_history(self) -> None:
+        """Drop this monitor's host-side history (state-side top-k and
+        latest-generation buffers are untouched)."""
         __monitor_history__[self._id_] = {t: [] for t in HistoryType}
 
     # -- result accessors ----------------------------------------------------
@@ -270,20 +278,27 @@ class EvalMonitor(Monitor):
         return self.opt_direction * state.latest_fitness
 
     def get_latest_solution(self, state: State) -> jax.Array:
+        """Population of the latest generation (pre-transform solutions)."""
         return state.latest_solution
 
     def get_topk_fitness(self, state: State) -> jax.Array:
+        """Best ``topk`` fitness values so far (original sign restored)."""
         return self.opt_direction * state.topk_fitness
 
     def get_topk_solutions(self, state: State) -> jax.Array:
+        """Solutions achieving the best ``topk`` fitness values so far
+        (single-objective only)."""
         self._assert_single("get_topk_solutions")
         return state.topk_solutions
 
     def get_best_solution(self, state: State) -> jax.Array:
+        """The single best solution so far (single-objective only)."""
         self._assert_single("get_best_solution")
         return state.topk_solutions[0]
 
     def get_best_fitness(self, state: State) -> jax.Array:
+        """The single best fitness so far (single-objective only; original
+        sign restored)."""
         self._assert_single("get_best_fitness")
         return self.opt_direction * state.topk_fitness[0]
 
@@ -344,13 +359,17 @@ class EvalMonitor(Monitor):
         return all_sol[rank == 0], all_fit[rank == 0] * self.opt_direction
 
     def get_pf_solutions(self, deduplicate: bool = True) -> jax.Array:
+        """Solutions of :meth:`get_pf` (requires both full histories)."""
         sol, _ = self.get_pf(deduplicate)
         return sol
 
     def get_fitness_history(self) -> list:
+        """``fitness_history`` with the original optimization sign
+        restored (the reference-API accessor form)."""
         return [self.opt_direction * jnp.asarray(f) for f in self.fitness_history]
 
     def get_solution_history(self) -> list:
+        """``solution_history`` as jax arrays (reference-API accessor)."""
         return [jnp.asarray(s) for s in self.solution_history]
 
     # -- plotting -------------------------------------------------------------

@@ -107,3 +107,30 @@ def test_reference_exports_covered(rel, mod_name):
         f"{mod_name} lacks reference exports {missing} "
         f"(reference: src/evox/{rel}/__init__.py)"
     )
+
+
+def test_api_reference_in_sync(tmp_path):
+    """docs/api/ is generated; regenerating must reproduce it exactly, so
+    the committed reference can never drift from the code's real surface.
+    Lives in the fast lane on purpose - a drifted signature must fail the
+    default `./run_tests.sh` run, not just the slow docs lane."""
+    import pathlib
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(repo / "tools"))
+    try:
+        import gen_api_docs
+    finally:
+        sys.path.pop(0)
+
+    fresh = gen_api_docs.generate(str(tmp_path))
+    committed_dir = repo / "docs" / "api"
+    committed = {p.name: p.read_text() for p in committed_dir.glob("*.md")}
+    assert set(fresh) == set(committed), (
+        "docs/api page set drifted - rerun tools/gen_api_docs.py"
+    )
+    for name, content in fresh.items():
+        assert committed[name] == content, (
+            f"docs/api/{name} is stale - rerun tools/gen_api_docs.py"
+        )

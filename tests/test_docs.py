@@ -42,3 +42,4 @@ def test_doc_snippets_execute(path, tmp_path, monkeypatch):
             exec(compile(src, f"{path.name}[block {i}]", "exec"), ns)
         except Exception as e:  # pragma: no cover - diagnostic
             pytest.fail(f"{path.name} block {i} failed: {e!r}\n---\n{src}")
+
