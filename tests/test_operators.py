@@ -345,3 +345,59 @@ def test_ref_vec_guided_matches_dense_oracle(seed):
     ex, ef = _ref_vec_guided_dense(x, f, v, theta)
     np.testing.assert_allclose(np.asarray(gx), np.asarray(ex), rtol=1e-5, equal_nan=True)
     np.testing.assert_allclose(np.asarray(gf), np.asarray(ef), rtol=1e-5, equal_nan=True)
+
+
+def test_packed_rank_matches_bruteforce():
+    """The bit-packed peeling path (dispatched above
+    EVOX_TPU_PACKED_RANK_MIN_POP) ranks identically to brute force on
+    awkward sizes (non-multiples of 32) and with duplicate rows."""
+    from evox_tpu.operators.selection.non_dominate import (
+        _non_dominate_rank_packed,
+    )
+
+    rng = np.random.default_rng(7)
+    for n, m in [(17, 2), (65, 3), (100, 4)]:
+        f = rng.standard_normal((n, m)).astype(np.float32)
+        f[1] = f[0]  # duplicates must tie, not dominate
+        got = np.asarray(_non_dominate_rank_packed(jnp.asarray(f)))
+        np.testing.assert_array_equal(got, brute_force_rank(f), err_msg=f"{n}x{m}")
+
+
+def test_packed_rank_jit_vmap(mo_fitness):
+    from evox_tpu.operators.selection.non_dominate import (
+        _non_dominate_rank_packed,
+    )
+
+    expected = np.asarray(non_dominate_rank(mo_fitness))
+    got = np.asarray(jax.jit(_non_dominate_rank_packed)(mo_fitness))
+    np.testing.assert_array_equal(got, expected)
+    batched = jnp.stack([mo_fitness, mo_fitness[::-1]])
+    vr = np.asarray(jax.jit(jax.vmap(_non_dominate_rank_packed))(batched))
+    np.testing.assert_array_equal(vr[0], expected)
+    np.testing.assert_array_equal(vr[1], expected[::-1])
+
+
+def test_packed_rank_threshold_dispatch(mo_fitness, monkeypatch):
+    """non_dominate_rank actually routes through the packed path above the
+    threshold (not merely produces equal ranks), and ranks identically."""
+    from evox_tpu.operators.selection import non_dominate
+
+    expected = np.asarray(non_dominate_rank(mo_fitness))  # dense (n=40)
+    calls = []
+    real = non_dominate._non_dominate_rank_packed
+    monkeypatch.setattr(
+        non_dominate,
+        "_non_dominate_rank_packed",
+        lambda f: (calls.append(f.shape), real(f))[1],
+    )
+    monkeypatch.setenv("EVOX_TPU_PACKED_RANK_MIN_POP", "1")
+    got = np.asarray(non_dominate_rank(mo_fitness))
+    np.testing.assert_array_equal(got, expected)
+    assert calls == [mo_fitness.shape], "packed path was not dispatched"
+    # Below the threshold the dense path must be taken.
+    calls.clear()
+    monkeypatch.setenv("EVOX_TPU_PACKED_RANK_MIN_POP", "999999")
+    np.testing.assert_array_equal(
+        np.asarray(non_dominate_rank(mo_fitness)), expected
+    )
+    assert calls == []
