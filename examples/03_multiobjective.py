@@ -1,0 +1,40 @@
+"""Multi-objective optimization: NSGA-II on DTLZ2, IGD tracking, Pareto
+front retrieval.
+
+Run with:
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python examples/03_multiobjective.py
+"""
+
+import jax
+import jax.numpy as jnp
+
+from evox_tpu.algorithms import NSGA2
+from evox_tpu.metrics import igd
+from evox_tpu.problems.numerical import DTLZ2
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+D, M, POP = 12, 3, 128
+
+problem = DTLZ2(d=D, m=M)
+monitor = EvalMonitor(multi_obj=True, full_fit_history=True)
+workflow = StdWorkflow(
+    NSGA2(pop_size=POP, n_objs=M, lb=jnp.zeros(D), ub=jnp.ones(D)),
+    problem,
+    monitor=monitor,
+)
+
+state = workflow.init(jax.random.key(0))
+state = jax.jit(workflow.init_step)(state)
+step = jax.jit(workflow.step)
+true_pf = problem.pf()
+for gen in range(30):
+    state = step(state)
+    if (gen + 1) % 10 == 0:
+        fit = monitor.get_latest_fitness(state.monitor)
+        print(f"gen {gen + 1:3d}  IGD = {float(igd(fit, true_pf)):.4f}")
+
+# Pooled approximate Pareto front over the whole run's history.
+pf_fitness = monitor.get_pf_fitness()
+print("pooled front size:", pf_fitness.shape[0])
+print("pooled front IGD :", float(igd(pf_fitness, true_pf)))

@@ -1,0 +1,51 @@
+"""Hyper-parameter optimization: an outer algorithm tunes an inner
+workflow's ``Parameter``-labeled hyperparameters.
+
+``HPOProblemWrapper`` stacks ``num_instances`` copies of the inner
+workflow's state and vmaps the whole inner run, so every outer candidate
+evaluates in parallel on device (see docs/guide/hpo.md).
+
+Run with:
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python examples/05_hpo.py
+"""
+
+import jax
+import jax.numpy as jnp
+
+from evox_tpu.algorithms import PSO
+from evox_tpu.problems.hpo_wrapper import HPOFitnessMonitor, HPOProblemWrapper
+from evox_tpu.problems.numerical import Sphere
+from evox_tpu.workflows import StdWorkflow
+
+DIM, INNER_POP, NUM_INSTANCES, INNER_ITERS = 8, 32, 16, 20
+
+# Inner workflow: PSO on Sphere.  PSO's w / phi_p / phi_g are Parameters,
+# so the wrapper exposes them as the outer search space.
+inner = StdWorkflow(
+    PSO(INNER_POP, -10.0 * jnp.ones(DIM), 10.0 * jnp.ones(DIM)),
+    Sphere(),
+    monitor=HPOFitnessMonitor(),
+)
+hpo = HPOProblemWrapper(
+    iterations=INNER_ITERS, num_instances=NUM_INSTANCES, workflow=inner
+)
+state = hpo.setup(jax.random.key(0))
+params = hpo.get_init_params(state)
+print("tunable hyper-parameters:", hpo.get_params_keys(state))
+
+# Outer candidates: random samples around the defaults.
+key = jax.random.key(1)
+candidates = {
+    k: jnp.clip(
+        v * jax.random.uniform(jax.random.fold_in(key, i), (NUM_INSTANCES,),
+                               minval=0.25, maxval=1.75),
+        0.0,
+        2.0,
+    )
+    for i, (k, v) in enumerate(params.items())
+}
+fitness, _ = jax.jit(hpo.evaluate)(state, candidates)
+best = int(jnp.argmin(fitness))
+print("per-candidate inner best fitness:", fitness)
+print("winner:", {k: float(v[best]) for k, v in candidates.items()})

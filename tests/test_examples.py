@@ -1,0 +1,26 @@
+"""Execute every example script end-to-end (the same keep-docs-honest
+discipline ``test_docs.py`` applies to fenced snippets — the reference's
+analogue is its notebook CI).  Examples print progress and assert their
+own invariants (e.g. 06's sharded == local check)."""
+
+import pathlib
+import runpy
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+EXAMPLES = sorted(
+    (pathlib.Path(__file__).resolve().parent.parent / "examples").glob("*.py")
+)
+
+
+@pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.name)
+def test_example_runs(script, capsys, monkeypatch):
+    # Examples write throwaway artifacts (e.g. /tmp/hopper.html) and read
+    # no argv; isolate module globals per run.
+    monkeypatch.setattr(sys, "argv", [str(script)])
+    runpy.run_path(str(script), run_name="__main__")
+    out = capsys.readouterr().out
+    assert out.strip(), f"{script.name} printed nothing"
