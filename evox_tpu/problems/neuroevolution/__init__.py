@@ -15,11 +15,12 @@ __all__ = [
     "SupervisedLearningProblem",
     "cartpole",
     "minibrax",
+    "miniplayground",
     "pendulum",
     "stack_model_params",
 ]
 
-from . import minibrax
+from . import minibrax, miniplayground
 from .brax import BraxProblem
 from .envs import Env, cartpole, pendulum
 from .mujoco_playground import MujocoProblem

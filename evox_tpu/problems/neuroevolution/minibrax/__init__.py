@@ -32,16 +32,10 @@ def activate():
     Returns whichever module will answer ``import brax`` afterwards."""
     import sys as _sys
 
-    try:
-        import brax  # noqa: F401
+    from ..utils import alias_vendored
 
-        return _sys.modules["brax"]
-    except ImportError:
-        pass
-    this = _sys.modules[__name__]
-    _sys.modules["brax"] = this
-    _sys.modules["brax.envs"] = envs
-    _sys.modules["brax.io"] = io
-    _sys.modules["brax.io.html"] = io.html
-    _sys.modules["brax.io.image"] = io.image
-    return this
+    return alias_vendored(
+        "brax",
+        _sys.modules[__name__],
+        {"envs": envs, "io": io, "io.html": io.html, "io.image": io.image},
+    )

@@ -1,0 +1,109 @@
+"""miniplayground: a vendored, minimal, mujoco_playground-API-compatible
+environment suite over the :mod:`..minibrax` physics engine.
+
+The reference validates its MJX adapter against the live
+``mujoco_playground`` package; that package is not installable in this
+image, so this sub-package exposes the exact API slice
+:class:`~evox_tpu.problems.neuroevolution.MujocoProblem` consumes —
+``registry.load(name)`` → env with pure ``reset``/``step`` (dict
+observations ``{"state": ...}``, float ``done``, a per-frame ``data``
+field), ``observation_size`` (dict form), ``action_size``, ``dt``, and
+``render(trajectory, ...)`` returning RGB frames — backed by the real
+(small, planar, pure-JAX) minibrax dynamics rather than a mock.
+
+:func:`activate` aliases this package as ``mujoco_playground`` in
+``sys.modules`` when the real package is absent, so the adapter (and its
+integration lane) executes unmodified.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import minibrax
+from ..minibrax.envs import State as _BraxState
+
+__all__ = ["State", "MiniPlaygroundEnv", "registry", "activate"]
+
+
+class State(NamedTuple):
+    """Playground-style env state: ``data`` is the physics state collected
+    per frame for rendering; ``obs`` is a dict pytree."""
+
+    data: minibrax.PipelineState
+    obs: dict
+    reward: jax.Array
+    done: jax.Array  # float32, like MJX; consumers cast to bool
+
+
+class MiniPlaygroundEnv:
+    """Wraps a minibrax env behind the mujoco_playground env surface."""
+
+    def __init__(self, backend_env):
+        self._env = backend_env
+
+    @property
+    def dt(self) -> float:
+        return self._env.dt
+
+    @property
+    def action_size(self) -> int:
+        return self._env.action_size
+
+    @property
+    def observation_size(self) -> dict:
+        # Playground reports dict observation sizes for dict observations;
+        # the adapter must pick out the "state" entry.
+        return {"state": self._env.observation_size, "privileged": 3}
+
+    def _obs(self, s: _BraxState) -> dict:
+        # A dict observation pytree: "state" is what policies consume;
+        # "privileged" exists so adapters provably handle extra entries.
+        return {
+            "state": s.obs,
+            "privileged": jnp.concatenate(
+                [s.reward[None], s.done[None], jnp.zeros(1)]
+            ),
+        }
+
+    def reset(self, key: jax.Array) -> State:
+        s = self._env.reset(key)
+        return State(data=s.pipeline_state, obs=self._obs(s), reward=s.reward, done=s.done)
+
+    def step(self, state: State, action: jax.Array) -> State:
+        inner = _BraxState(
+            pipeline_state=state.data,
+            obs=jnp.zeros(()),  # unused by minibrax env steps
+            reward=state.reward,
+            done=state.done,
+        )
+        s = self._env.step(inner, action)
+        return State(data=s.pipeline_state, obs=self._obs(s), reward=s.reward, done=s.done)
+
+    def render(self, trajectory, height: int = 240, width: int = 320, camera=None, **kw):
+        """RGB frames (list of (H, W, 3) uint8 arrays) for a list of
+        per-step ``data`` values."""
+        del camera, kw
+        frames = minibrax.io.image.render_array(
+            self._env.sys, trajectory, height=height, width=width
+        )
+        return list(frames)
+
+
+from . import registry  # noqa: E402  (imports MiniPlaygroundEnv)
+
+
+def activate():
+    """Install miniplayground as ``mujoco_playground`` if it is absent.
+
+    Returns whichever module will answer ``import mujoco_playground``."""
+    import sys as _sys
+
+    from ..utils import alias_vendored
+
+    return alias_vendored(
+        "mujoco_playground", _sys.modules[__name__], {"registry": registry}
+    )

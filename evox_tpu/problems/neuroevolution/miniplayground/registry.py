@@ -1,0 +1,23 @@
+"""The ``mujoco_playground.registry`` surface: ``load(name)`` plus the
+environment name listing (``ALL_ENVS``)."""
+
+from __future__ import annotations
+
+from ..minibrax import envs as _menvs
+
+ALL_ENVS = ("Hopper", "PointMass")
+
+_NAME_MAP = {"Hopper": "hopper", "PointMass": "pointmass"}
+
+
+def load(env_name: str, config=None, config_overrides=None):
+    """Instantiate a registered environment (playground signature; the
+    planar backend takes no config)."""
+    del config, config_overrides
+    from . import MiniPlaygroundEnv
+
+    if env_name not in _NAME_MAP:
+        raise ValueError(
+            f"unknown miniplayground env {env_name!r}; available: {ALL_ENVS}"
+        )
+    return MiniPlaygroundEnv(_menvs.get_environment(env_name=_NAME_MAP[env_name]))

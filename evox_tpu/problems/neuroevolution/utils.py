@@ -18,7 +18,7 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 
-__all__ = ["MLPPolicy", "stack_model_params"]
+__all__ = ["MLPPolicy", "alias_vendored", "stack_model_params"]
 
 
 class MLPPolicy:
@@ -73,3 +73,26 @@ def stack_model_params(
     leading ``pop_size`` axis (the JAX analogue of the reference's
     ``torch.func.stack_module_state``)."""
     return jax.vmap(init_fn)(jax.random.split(key, pop_size))
+
+
+def alias_vendored(real_name: str, module, submodules: dict | None = None):
+    """Install a vendored stand-in package as ``real_name`` in
+    ``sys.modules`` — only when the real package is absent.
+
+    Shared by ``minibrax.activate()`` / ``miniplayground.activate()`` so
+    the alias-if-absent semantics (and any future hardening of them) live
+    in exactly one place.  Returns whichever module will answer
+    ``import <real_name>`` afterwards.
+    """
+    import importlib
+    import sys
+
+    try:
+        importlib.import_module(real_name)
+        return sys.modules[real_name]
+    except ImportError:
+        pass
+    sys.modules[real_name] = module
+    for suffix, sub in (submodules or {}).items():
+        sys.modules[f"{real_name}.{suffix}"] = sub
+    return module

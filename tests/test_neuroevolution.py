@@ -323,6 +323,10 @@ def test_optional_deps_raise_cleanly():
     elif importlib.util.find_spec("brax") is None:
         with pytest.raises(ImportError):
             BraxProblem(lambda p, o: o, "ant", 10)
-    if importlib.util.find_spec("mujoco_playground") is None:
+    pg_mod = sys.modules.get("mujoco_playground")
+    if pg_mod is not None and "miniplayground" in pg_mod.__name__:
+        prob = MujocoProblem(lambda p, o: o, "Hopper", 10)
+        assert prob.env.obs_size > 0
+    elif importlib.util.find_spec("mujoco_playground") is None:
         with pytest.raises(ImportError):
             MujocoProblem(lambda p, o: o, "CartpoleBalance", 10)
