@@ -338,24 +338,32 @@ def bench_openes_cec(n_steps, profile_dir=None):
     }
 
 
-def bench_nsga2_dtlz2(n_steps, profile_dir=None):
+def bench_nsga2_dtlz2(n_steps, profile_dir=None, pop=10_000):
     import jax.numpy as jnp
 
     from evox_tpu.algorithms import NSGA2
     from evox_tpu.problems.numerical import DTLZ2
     from evox_tpu.workflows import StdWorkflow
 
-    d, m, pop = 12, 3, 10_000
+    d, m = 12, 3
     wf = StdWorkflow(
         NSGA2(pop, m, jnp.zeros(d), jnp.ones(d)),
         DTLZ2(d=d, m=m),
     )
     gps, _ = _timed_steps(wf, n_steps, profile_dir=profile_dir)
     return {
-        "metric": "NSGA-II generations/sec/chip (pop=10000, DTLZ2 m=3)",
+        "metric": f"NSGA-II generations/sec/chip (pop={pop}, DTLZ2 m=3)",
         "value": round(gps, 3),
         "unit": "generations/sec",
     }
+
+
+def bench_nsga2_dtlz2_50k(n_steps, profile_dir=None):
+    """NSGA-II at pop=50k: a scale the dense bool dominance matrix cannot
+    reach on one chip (the merged 2N=100k bool matrix alone is 10 GB; the
+    round-5 bit-packed rank keeps it at 1.25 GB) — only possible through
+    the packed peeling path."""
+    return bench_nsga2_dtlz2(n_steps, profile_dir=profile_dir, pop=50_000)
 
 
 def bench_nsga2_dtlz2_pallas(n_steps, profile_dir=None):
@@ -557,6 +565,7 @@ CONFIGS = {
     "de_cec": (bench_de_cec, 200, 20),
     "openes_cec": (bench_openes_cec, 300, 50),
     "nsga2_dtlz2": (bench_nsga2_dtlz2, 30, 3),
+    "nsga2_dtlz2_50k": (bench_nsga2_dtlz2_50k, 10, 2),
     "nsga2_dtlz2_pallas": (bench_nsga2_dtlz2_pallas, 30, 3),
     "rvea_dtlz2": (bench_rvea_dtlz2, 30, 3),
     "neuroevolution": (bench_neuroevolution, 30, 3),
@@ -702,6 +711,24 @@ def run_child(config: str, platform: str, profile: bool) -> dict:
     return result
 
 
+def make_history_record(result: dict, platform: str) -> dict:
+    """The BENCH_HISTORY.json entry shape for a measurement — single
+    constructor shared by the first-run recording below and
+    ``tools/update_baseline.py --rebaseline`` so the two paths cannot
+    diverge field-by-field."""
+    runs = result.get("runs", {})
+    record = {
+        "baseline": result["value"],
+        "platform": platform,
+        "device_kind": result.get("device_kind"),
+        "n_steps": result.get("n_steps"),
+        "n_runs": runs.get("n_ok", 1),
+    }
+    if runs:
+        record["spread"] = [runs["min"], runs["max"]]
+    return record
+
+
 def _apply_baseline(result: dict, platform: str) -> dict:
     """vs_baseline = value / stored first-TPU-run value (1.0 when this run
     creates the entry; CPU-fallback runs never update the store)."""
@@ -718,17 +745,7 @@ def _apply_baseline(result: dict, platform: str) -> dict:
         if entry is None:
             # Record measurement conditions with the baseline so future
             # vs_baseline deltas can be judged against run-to-run noise.
-            history[metric] = {
-                "baseline": result["value"],
-                "platform": platform,
-                "n_steps": result.get("n_steps"),
-                "n_runs": result.get("runs", {}).get("n_ok", 1),
-                **(
-                    {"spread": [result["runs"]["min"], result["runs"]["max"]]}
-                    if "runs" in result
-                    else {}
-                ),
-            }
+            history[metric] = make_history_record(result, platform)
             with open(_HISTORY_PATH, "w") as f:
                 json.dump(history, f, indent=1, sort_keys=True)
             result["vs_baseline"] = 1.0
@@ -792,6 +809,11 @@ def main() -> int:
             if args.profile else None
         )
         result = fn(args.steps, profile_dir=profile_dir)
+        # Chip identity: a bare platform name ("tpu") is too coarse for the
+        # regression baseline if the attachment ever changes generation.
+        devices = jax.devices()
+        if devices:
+            result["device_kind"] = devices[0].device_kind
         with open(args.json_out, "w") as f:
             json.dump(result, f)
         _log(f"child: {args.child} -> {result['value']} {result['unit']}")

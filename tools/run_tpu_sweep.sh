@@ -72,7 +72,10 @@ for cfg in ["nsga2_dtlz2", "rvea_dtlz2", "pso_northstar_fused", "pso_northstar"]
             f.write(out.stdout)
 EOF
 echo "=== regenerate BASELINE.md table $(date -u +%H:%M:%S) ==="
-python tools/update_baseline.py || echo "UPDATE_BASELINE FAILED rc=$?"
+# --rebaseline re-anchors BENCH_HISTORY.json to this sweep's multi-run
+# medians (old single-run values kept as previous_baseline) so future
+# drift detection compares against statistics, not round-3 one-offs.
+python tools/update_baseline.py --rebaseline || echo "UPDATE_BASELINE FAILED rc=$?"
 
 # LAST, after every number is banked: the Pallas capability probe.  On an
 # attachment where Mosaic hangs, the killed probe child can wedge the relay
