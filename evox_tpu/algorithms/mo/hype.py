@@ -134,7 +134,8 @@ class HypE(Algorithm):
         merge_pop = jnp.concatenate([state.pop, offspring], axis=0)
         merge_fit = jnp.concatenate([state.fit, off_fit], axis=0)
 
-        rank = non_dominate_rank(merge_fit)
+        # Selection only consumes ranks up to the boundary front.
+        rank = non_dominate_rank(merge_fit, until_count=self.pop_size)
         order = jnp.argsort(rank)
         worst_rank = rank[order[self.pop_size - 1]]
         mask = rank <= worst_rank

@@ -140,7 +140,10 @@ class NSGA3(Algorithm):
         merge_pop = merge_pop[shuffle]
         merge_fit = merge_fit[shuffle]
 
-        rank = non_dominate_rank(merge_fit)
+        # Ranks are only consumed up to the boundary front; stop peeling
+        # once pop_size+1 rows are ranked (whole fronts always complete,
+        # and deeper rows' sentinel rank n sorts after every real rank).
+        rank = non_dominate_rank(merge_fit, until_count=self.pop_size + 1)
         # Rank of the (pop_size+1)-th best individual: fronts strictly below
         # it fit entirely; the front equal to it is niched (``nsga3.py:151``).
         worst_rank = jnp.sort(rank)[self.pop_size]

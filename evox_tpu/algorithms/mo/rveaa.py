@@ -171,8 +171,9 @@ class RVEAa(Algorithm):
         # — NaN fitness rows rank as dominated by nothing and peel last, so
         # mask them out of the rank computation explicitly.
         nan_row = jnp.isnan(merge_fit).any(axis=1)
+        # Only the first front is consumed: stop peeling after it.
         rank = non_dominate_rank(
-            jnp.where(nan_row[:, None], jnp.inf, merge_fit)
+            jnp.where(nan_row[:, None], jnp.inf, merge_fit), until_count=1
         )
         front = (rank == 0) & ~nan_row
         merge_fit = jnp.where(front[:, None], merge_fit, jnp.nan)

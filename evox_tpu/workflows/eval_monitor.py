@@ -329,7 +329,8 @@ class EvalMonitor(Monitor):
         )
         if deduplicate:
             all_fit = jnp.unique(all_fit, axis=0)
-        rank = non_dominate_rank(all_fit)
+        # Only the first front is consumed: stop peeling after it.
+        rank = non_dominate_rank(all_fit, until_count=1)
         return all_fit[rank == 0] * self.opt_direction
 
     def get_pf(self, deduplicate: bool = True) -> tuple[jax.Array, jax.Array]:
@@ -355,7 +356,7 @@ class EvalMonitor(Monitor):
             _, idx = np.unique(np.asarray(all_sol), axis=0, return_index=True)
             idx = jnp.sort(jnp.asarray(idx))
             all_sol, all_fit = all_sol[idx], all_fit[idx]
-        rank = non_dominate_rank(all_fit)
+        rank = non_dominate_rank(all_fit, until_count=1)
         return all_sol[rank == 0], all_fit[rank == 0] * self.opt_direction
 
     def get_pf_solutions(self, deduplicate: bool = True) -> jax.Array:

@@ -388,7 +388,7 @@ def test_packed_rank_threshold_dispatch(mo_fitness, monkeypatch):
     monkeypatch.setattr(
         non_dominate,
         "_non_dominate_rank_packed",
-        lambda f: (calls.append(f.shape), real(f))[1],
+        lambda f, until_count=None: (calls.append(f.shape), real(f, until_count))[1],
     )
     monkeypatch.setenv("EVOX_TPU_PACKED_RANK_MIN_POP", "1")
     got = np.asarray(non_dominate_rank(mo_fitness))
@@ -401,3 +401,49 @@ def test_packed_rank_threshold_dispatch(mo_fitness, monkeypatch):
         np.asarray(non_dominate_rank(mo_fitness)), expected
     )
     assert calls == []
+
+
+def test_rank_until_count_early_stop():
+    """until_count peels whole fronts until the threshold is crossed:
+    ranked rows are exact, deeper rows carry the sentinel rank n."""
+    from evox_tpu.operators.selection.non_dominate import (
+        _non_dominate_rank_packed,
+    )
+
+    rng = np.random.default_rng(3)
+    f = rng.standard_normal((60, 3)).astype(np.float32)
+    full = brute_force_rank(f)
+    for k in (1, 10, 30, 60, 1000):
+        for fn in (
+            lambda a: non_dominate_rank(a, until_count=k),
+            lambda a: _non_dominate_rank_packed(a, until_count=k),
+        ):
+            got = np.asarray(fn(jnp.asarray(f)))
+            # The boundary front: smallest rank r with |{rank <= r}| >= k.
+            counts = np.cumsum(np.bincount(full))
+            boundary = int(np.searchsorted(counts, min(k, len(f))))
+            ranked = full <= boundary
+            np.testing.assert_array_equal(got[ranked], full[ranked])
+            assert np.all(got[~ranked] == len(f))
+            assert np.sum(ranked) >= min(k, len(f))
+
+
+def test_environmental_selection_early_stop_matches_full_rank(mo_fitness):
+    """nd_environmental_selection (which ranks with until_count=topk) must
+    select exactly what a full ranking selects."""
+    from evox_tpu.operators.selection.non_dominate import (
+        crowding_distance as cd_fn,
+    )
+    from evox_tpu.utils import lexsort
+
+    topk = 10
+    x = jnp.tile(jnp.arange(40, dtype=jnp.float32)[:, None], (1, 2))
+    sx, sf, srank, scd = nd_environmental_selection(x, mo_fitness, topk)
+
+    full_rank = jnp.asarray(brute_force_rank(np.asarray(mo_fitness)))
+    worst = -jax.lax.top_k(-full_rank, topk)[0][-1]
+    cd = cd_fn(mo_fitness, full_rank == worst)
+    order = lexsort([-cd, full_rank])[:topk]
+    np.testing.assert_array_equal(np.asarray(sx), np.asarray(x[order]))
+    np.testing.assert_array_equal(np.asarray(sf), np.asarray(mo_fitness[order]))
+    np.testing.assert_array_equal(np.asarray(srank), np.asarray(full_rank[order]))
