@@ -22,6 +22,8 @@ Usage::
 
     python bench.py                 # headline: PSO pop=100k dim=1000 Sphere
     python bench.py --all           # all BASELINE.md configs -> BENCH_ALL.json
+                                    # (non-TPU sweeps -> BENCH_ALL.<platform>.json;
+                                    # only TPU sweeps touch the sweep of record)
     python bench.py --smoke         # tiny jitted TPU smoke lane (3 workflows)
     python bench.py --config NAME   # one config by name
     python bench.py --platform cpu  # force the CPU fallback path
@@ -866,7 +868,11 @@ def main() -> int:
         _log(json.dumps(results[name]))
 
     if args.all:
-        with open(os.path.join(_REPO_ROOT, "BENCH_ALL.json"), "w") as f:
+        # BENCH_ALL.json is the TPU sweep of record (BASELINE.md's table and
+        # --rebaseline read it); a CPU fallback/rehearsal sweep must not
+        # clobber it, so non-TPU sweeps write a platform-suffixed file.
+        name = "BENCH_ALL.json" if platform == "tpu" else f"BENCH_ALL.{platform}.json"
+        with open(os.path.join(_REPO_ROOT, name), "w") as f:
             json.dump(results, f, indent=1)
 
     headline = results.get(HEADLINE) or next(iter(results.values()))
