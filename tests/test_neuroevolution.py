@@ -330,3 +330,31 @@ def test_optional_deps_raise_cleanly():
     elif importlib.util.find_spec("mujoco_playground") is None:
         with pytest.raises(ImportError):
             MujocoProblem(lambda p, o: o, "CartpoleBalance", 10)
+
+
+def test_alias_vendored_prefers_real_package():
+    """alias_vendored must return the real package untouched when it is
+    importable, and only alias the stand-in when it is absent."""
+    import sys
+
+    from evox_tpu.problems.neuroevolution import minibrax
+    from evox_tpu.problems.neuroevolution.utils import alias_vendored
+
+    # An importable real package always wins.
+    import json as real_json
+
+    assert alias_vendored("json", minibrax) is real_json
+
+    # An absent package gets the stand-in, submodules included.
+    name = "definitely_not_installed_pkg_xyz"
+    try:
+        got = alias_vendored(name, minibrax, {"envs": minibrax.envs})
+        assert got is minibrax
+        assert sys.modules[name] is minibrax
+        assert sys.modules[f"{name}.envs"] is minibrax.envs
+        import importlib
+
+        assert importlib.import_module(name) is minibrax
+    finally:
+        sys.modules.pop(name, None)
+        sys.modules.pop(f"{name}.envs", None)
