@@ -64,6 +64,21 @@ def _log(msg: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _dump_compiled(compiled, profile_dir: str) -> None:
+    """The "torch._dynamo.explain" role: dump the optimized HLO, plus XLA's
+    own cost model (flops / bytes accessed) for roofline math.  Shared by
+    every profiled config so the dump contents cannot drift per config."""
+    os.makedirs(profile_dir, exist_ok=True)
+    with open(os.path.join(profile_dir, "step_hlo.txt"), "w") as f:
+        f.write(compiled.as_text())
+    try:
+        cost = compiled.cost_analysis()
+        with open(os.path.join(profile_dir, "cost_analysis.json"), "w") as f:
+            json.dump({k: v for k, v in sorted(cost.items())}, f, indent=1)
+    except Exception as e:  # cost model coverage varies by backend
+        _log(f"cost_analysis unavailable: {e!r}")
+
+
 def _timed_steps(wf, n_steps: int, warmup: int = 2, profile_dir: str | None = None):
     """Reference harness shape (`benchmarks/test_base.py:18-58`): jitted
     init_step + step, warm-up, then N steps wall-clocked behind
@@ -79,18 +94,7 @@ def _timed_steps(wf, n_steps: int, warmup: int = 2, profile_dir: str | None = No
     jax.block_until_ready(state)
 
     if profile_dir:
-        os.makedirs(profile_dir, exist_ok=True)
-        # The "torch._dynamo.explain" role: dump the optimized HLO, plus
-        # XLA's own cost model (flops / bytes accessed) for roofline math.
-        compiled = step.lower(state).compile()
-        with open(os.path.join(profile_dir, "step_hlo.txt"), "w") as f:
-            f.write(compiled.as_text())
-        try:
-            cost = compiled.cost_analysis()
-            with open(os.path.join(profile_dir, "cost_analysis.json"), "w") as f:
-                json.dump({k: v for k, v in sorted(cost.items())}, f, indent=1)
-        except Exception as e:  # cost model coverage varies by backend
-            _log(f"cost_analysis unavailable: {e!r}")
+        _dump_compiled(step.lower(state).compile(), profile_dir)
         ctx = jax.profiler.trace(profile_dir)
     else:
         ctx = None
@@ -360,6 +364,60 @@ def bench_nsga2_dtlz2(n_steps, profile_dir=None, pop=10_000):
     }
 
 
+def bench_rank_20k(n_steps, profile_dir=None):
+    """Operator-level microbench: the bit-packed ``non_dominate_rank`` on a
+    merged-population-shaped input (2N=20000 rows, m=3, evolved-like front
+    structure) — the exact hot call inside NSGA-II's survivor selection.
+    Reports ranks-of-the-matrix per second (1 unit = one full ranking)."""
+    import jax
+    import jax.numpy as jnp
+
+    from evox_tpu.operators.selection import non_dominate_rank
+    from evox_tpu.operators.selection.non_dominate import (
+        _packed_rank_min_pop,
+        _pallas_kernel_eligible,
+    )
+
+    n, m = 20_000, 3
+    key = jax.random.key(0)
+    f = jax.random.normal(key, (n, m)) + jnp.linspace(0.0, 3.0, n)[:, None]
+    # Refuse to measure a different path under the "packed" label (the
+    # same discipline as bench_nsga2_dtlz2_pallas): the dispatcher must
+    # actually route to the packed loop for this input.
+    if _packed_rank_min_pop() > n:
+        raise RuntimeError(
+            f"rank_20k: EVOX_TPU_PACKED_RANK_MIN_POP exceeds n={n}; the "
+            "dense path would be measured under the packed label."
+        )
+    if _pallas_kernel_eligible(f):
+        raise RuntimeError(
+            "rank_20k: the Pallas gate is open for this input, so the "
+            "kernel path (not the packed loop) would be measured; unset "
+            "EVOX_TPU_PALLAS for this config."
+        )
+    ranked = jax.jit(non_dominate_rank)
+    ranked(f).block_until_ready()  # compile
+    if profile_dir:
+        _dump_compiled(ranked.lower(f).compile(), profile_dir)
+    ctx = jax.profiler.trace(profile_dir) if profile_dir else None
+    try:
+        if ctx is not None:
+            ctx.__enter__()
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            out = ranked(f)
+        out.block_until_ready()
+        elapsed = time.perf_counter() - t0
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    return {
+        "metric": "non_dominate_rank rankings/sec (n=20000, m=3, packed)",
+        "value": round(n_steps / elapsed, 3),
+        "unit": "rankings/sec",
+    }
+
+
 def bench_nsga2_dtlz2_50k(n_steps, profile_dir=None):
     """NSGA-II at pop=50k: a scale the dense bool dominance matrix cannot
     reach on one chip (the merged 2N=100k bool matrix alone is 10 GB; the
@@ -567,6 +625,7 @@ CONFIGS = {
     "de_cec": (bench_de_cec, 200, 20),
     "openes_cec": (bench_openes_cec, 300, 50),
     "nsga2_dtlz2": (bench_nsga2_dtlz2, 30, 3),
+    "rank_20k": (bench_rank_20k, 30, 3),
     "nsga2_dtlz2_50k": (bench_nsga2_dtlz2_50k, 10, 2),
     "nsga2_dtlz2_pallas": (bench_nsga2_dtlz2_pallas, 30, 3),
     "rvea_dtlz2": (bench_rvea_dtlz2, 30, 3),
