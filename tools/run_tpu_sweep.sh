@@ -13,7 +13,7 @@
 set -u
 cd "$(dirname "$0")/.."
 
-PROFILE_CFGS="nsga2_dtlz2 rvea_dtlz2 pso_northstar_fused pso_northstar"
+PROFILE_CFGS="nsga2_dtlz2 rank_20k rvea_dtlz2 pso_northstar_fused pso_northstar"
 
 # Stale-data guard: a roofline must never pair this sweep's gen/s with a
 # previous round's cost profile, and a previous round's pallas artifact
@@ -50,7 +50,7 @@ bench_all = {}
 if os.path.exists("BENCH_ALL.json"):
     bench_all = json.load(open("BENCH_ALL.json"))
 
-for cfg in ["nsga2_dtlz2", "rvea_dtlz2", "pso_northstar_fused", "pso_northstar"]:
+for cfg in ["nsga2_dtlz2", "rank_20k", "rvea_dtlz2", "pso_northstar_fused", "pso_northstar"]:
     entry = bench_all.get(cfg) or {}
     gps = entry.get("value", 0.0)
     prof = f"bench_artifacts/profile_{cfg}"
