@@ -285,6 +285,52 @@ def bench_pso_northstar_bf16_rbg(n_steps, profile_dir=None):
     return result
 
 
+def bench_pso_northstar_pallas(n_steps, profile_dir=None):
+    """North-star config in bf16 with the Pallas-fused move kernel
+    (``PallasPSO``): personal-best fold + in-kernel hardware PRNG +
+    velocity/position update + clamps in ONE HBM pass — the hand-fused
+    answer to the two-mega-fusions-plus-unfused-rng structure the XLA
+    bf16+rbg path lowers to (see BASELINE.md roofline notes).  Refuses to
+    run with the gate closed rather than silently measuring the XLA
+    fallback under a pallas label."""
+    import jax.numpy as jnp
+
+    from evox_tpu.algorithms import PallasPSO
+    from evox_tpu.ops.pallas_gate import pallas_enabled
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    if not pallas_enabled():
+        raise RuntimeError(
+            "pso_northstar_pallas: the Pallas gate is closed (no passing "
+            "capability verdict for this backend — run "
+            "`python -m evox_tpu.ops.pallas_gate` first)."
+        )
+    from evox_tpu.ops.pso_step import supports_shape
+
+    if not supports_shape(100_000, 1000, 2):
+        raise RuntimeError(
+            "pso_northstar_pallas: no Mosaic-legal block for the config "
+            "shape — PallasPSO would silently fall back to the XLA path "
+            "and the measurement would be mislabeled."
+        )
+    lb, ub = _box(1000)
+    wf = StdWorkflow(
+        PallasPSO(100_000, lb.astype(jnp.bfloat16), ub.astype(jnp.bfloat16),
+                  dtype=jnp.bfloat16),
+        Sphere(),
+    )
+    gps, _ = _timed_steps(wf, n_steps, profile_dir=profile_dir)
+    return {
+        "metric": (
+            "PSO generations/sec/chip, bf16 + Pallas fused move "
+            "(pop=100000, dim=1000, Sphere)"
+        ),
+        "value": round(gps, 3),
+        "unit": "generations/sec",
+    }
+
+
 def bench_cmaes_cec(n_steps, profile_dir=None):
     import jax.numpy as jnp
 
@@ -604,12 +650,13 @@ def bench_smoke(n_steps, profile_dir=None):
 # measure rather than mislabel the broadcast path.
 CONFIG_ENV = {
     "nsga2_dtlz2_pallas": {"EVOX_TPU_PALLAS": "probe"},
+    "pso_northstar_pallas": {"EVOX_TPU_PALLAS": "probe"},
 }
 
 # Configs that never run under --all: smoke is a diagnostic, and the pallas
 # variant must not dispatch on an unprobed attachment.  (Also consumed by
 # tools/update_baseline.py for its artifact-fallback rule.)
-EXPLICIT_ONLY = {"smoke", "nsga2_dtlz2_pallas"}
+EXPLICIT_ONLY = {"smoke", "nsga2_dtlz2_pallas", "pso_northstar_pallas"}
 
 # name -> (fn, tpu_steps, cpu_steps)
 CONFIGS = {
@@ -621,6 +668,7 @@ CONFIGS = {
     "pso_northstar_rbg": (bench_pso_northstar_rbg, 100, 3),
     "pso_northstar_bf16": (bench_pso_northstar_bf16, 100, 3),
     "pso_northstar_bf16_rbg": (bench_pso_northstar_bf16_rbg, 100, 3),
+    "pso_northstar_pallas": (bench_pso_northstar_pallas, 100, 3),
     "cmaes_cec": (bench_cmaes_cec, 200, 50),
     "de_cec": (bench_de_cec, 200, 20),
     "openes_cec": (bench_openes_cec, 300, 50),

@@ -7,7 +7,7 @@ __all__ = [
     "CMAES", "OpenES", "XNES", "SeparableNES", "SNES", "DES", "ARS",
     "ASEBO", "GuidedES", "PersistentES", "NoiseReuseES", "ESMC",
     # PSO
-    "PSO", "CLPSO", "CSO", "DMSPSOEL", "FSPSO", "SLPSOGS", "SLPSOUS",
+    "PSO", "PallasPSO", "CLPSO", "CSO", "DMSPSOEL", "FSPSO", "SLPSOGS", "SLPSOUS",
     # MO
     "NSGA2", "NSGA3", "RVEA", "RVEAa", "MOEAD", "HypE",
 ]
@@ -28,4 +28,4 @@ from .so.es_variants import (
     SNES,
     XNES,
 )
-from .so.pso_variants import CLPSO, CSO, DMSPSOEL, FSPSO, PSO, SLPSOGS, SLPSOUS
+from .so.pso_variants import CLPSO, CSO, DMSPSOEL, FSPSO, PSO, PallasPSO, SLPSOGS, SLPSOUS

@@ -1,8 +1,18 @@
-__all__ = ["CLPSO", "CSO", "DMSPSOEL", "FSPSO", "PSO", "SLPSOGS", "SLPSOUS"]
+__all__ = [
+    "CLPSO",
+    "CSO",
+    "DMSPSOEL",
+    "FSPSO",
+    "PSO",
+    "PallasPSO",
+    "SLPSOGS",
+    "SLPSOUS",
+]
 
 from .clpso import CLPSO
 from .cso import CSO
 from .dms_pso_el import DMSPSOEL
 from .fs_pso import FSPSO
+from .pallas_pso import PallasPSO
 from .pso import PSO
 from .sl_pso import SLPSOGS, SLPSOUS

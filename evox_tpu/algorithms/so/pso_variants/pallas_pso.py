@@ -1,0 +1,79 @@
+"""PSO with a Pallas-fused move step.
+
+Drop-in PSO variant (same constructor, same ``State`` layout as
+:class:`~evox_tpu.algorithms.so.pso_variants.pso.PSO`, itself the
+counterpart of the reference ``src/evox/algorithms/so/pso_variants/
+pso.py:9-116``) whose per-generation move runs as ONE Pallas kernel:
+personal-best fold, in-kernel hardware PRNG draws, velocity/position
+update and clamps in a single HBM pass (:mod:`evox_tpu.ops.pso_step`).
+
+Dispatch is gated by :func:`evox_tpu.ops.pallas_gate.pallas_enabled` —
+off-gate (the default, and always on non-TPU backends) this class *is*
+the XLA-path PSO, so it is safe to construct anywhere.  The kernel's
+random stream is the TPU core PRNG, decorrelated per step by folding the
+algorithm key into the seed; it is reproducible per key but not
+bit-identical to the Threefry draws of the XLA path (the same trade
+JAX's ``rbg`` PRNG makes; BASELINE.md measures both).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core import EvalFn, State
+from ...so.pso_variants.pso import PSO
+from ...so.pso_variants.utils import min_by
+
+__all__ = ["PallasPSO"]
+
+
+class PallasPSO(PSO):
+    """Inertia/cognitive/social PSO with a single-pass fused move kernel."""
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        from ....ops.pallas_gate import pallas_enabled
+        from ....ops.pso_step import fused_pso_move, supports_shape
+
+        if not pallas_enabled() or not supports_shape(
+            self.pop_size, self.dim, jnp.dtype(self.dtype).itemsize
+        ):
+            return super().step(state, evaluate)
+
+        # Global-best fold outside the kernel: it reads only the (N,)
+        # fitness and one row of pop — negligible traffic, and it keeps
+        # the kernel free of cross-block reductions.
+        global_best_location, global_best_fit = min_by(
+            [state.global_best_location[None, :], state.pop],
+            [state.global_best_fit[None], state.fit],
+        )
+        key, seed_key = jax.random.split(state.key)
+        seed = jax.random.randint(
+            seed_key, (1,), minval=0, maxval=jnp.iinfo(jnp.int32).max,
+            dtype=jnp.int32,
+        )
+        pop, velocity, local_best_location, local_best_fit = fused_pso_move(
+            state.pop,
+            state.velocity,
+            state.local_best_location,
+            state.fit,
+            state.local_best_fit,
+            global_best_location,
+            self.lb,
+            self.ub,
+            state.w,
+            state.phi_p,
+            state.phi_g,
+            seed,
+        )
+        fit = evaluate(pop)
+        return state.replace(
+            key=key,
+            pop=pop,
+            velocity=velocity,
+            fit=fit,
+            local_best_location=local_best_location,
+            local_best_fit=local_best_fit,
+            global_best_location=global_best_location,
+            global_best_fit=global_best_fit,
+        )

@@ -1,0 +1,270 @@
+"""Fused PSO move kernel (Pallas).
+
+The measured bf16+rbg north-star PSO step lowers to two XLA mega-fusions
+plus two standalone ``rng-bit-generator`` ops that XLA never fuses
+(profile: ``bench_artifacts/profile_pso_northstar_bf16_rbg``) — ~2.2 GB of
+HBM traffic per generation.  This kernel performs the whole PSO *move* in
+ONE pass over the population: personal-best fold, in-kernel hardware PRNG
+draws (the two (N, D) random tensors are never materialized in HBM),
+velocity/position update and bound clamps.  Per generation it reads
+pop/velocity/local-best once and writes their updates once — ~1.2 GB at
+the north-star config in bf16, vs ~2.2 GB for the XLA path.
+
+Behavioral parity: the update equations are the reference PSO's
+(``src/evox/algorithms/so/pso_variants/pso.py:89-106``).  In ``rand="hw"``
+mode the draws come from the TPU core PRNG (Mosaic) rather than the
+key-derived Threefry stream — reproducible for a given seed on the same
+topology, but not bit-identical to the XLA path (the same trade JAX's
+``rbg`` PRNG makes).  ``rand="input"`` takes caller-supplied draws, which
+is what the CPU/interpret-mode tests use to check exact parity against a
+pure-jnp mirror of the kernel (the TPU PRNG primitives have no CPU
+lowering).
+
+Dispatch is gated like every Pallas kernel in this library
+(:mod:`evox_tpu.ops.pallas_gate`): algorithms fall back to the XLA path
+unless the attachment has a passing capability verdict.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_pso_move"]
+
+
+def _uniform_bits(shape, dtype):
+    """Uniform [0, 1) of ``dtype`` from the in-kernel hardware PRNG."""
+    # prng_random_bits returns SIGNED int32; bitcast to uint32 first so the
+    # shift is logical — an arithmetic shift would keep the sign bit and
+    # yield draws in [-0.5, 0.5).
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    # Use exactly as many high bits as the target mantissa holds, so every
+    # k/2^m value is representable and the [0, 1) upper bound is strict —
+    # converting a finer f32 draw down would round the top ulp up to 1.0.
+    m = 7 if dtype == jnp.bfloat16 else 24
+    u = (bits >> (32 - m)).astype(jnp.float32) * (2.0**-m)
+    return u.astype(dtype)
+
+
+def _pso_move_kernel(
+    seed_ref,
+    scal_ref,
+    pop_ref,
+    vel_ref,
+    lbl_ref,
+    fit_ref,
+    lbf_ref,
+    gbl_ref,
+    lb_ref,
+    ub_ref,
+    *rest,
+    rand: str,
+):
+    if rand == "input":
+        rp_ref, rg_ref, pop_out, vel_out, lbl_out, lbf_out = rest
+    else:
+        pop_out, vel_out, lbl_out, lbf_out = rest
+        # Distinct stream per grid block; seed once per block invocation.
+        pltpu.prng_seed(seed_ref[0], pl.program_id(0), pl.program_id(1))
+
+    pop = pop_ref[...]
+    dtype = pop.dtype
+    w = scal_ref[0].astype(dtype)
+    phi_p = scal_ref[1].astype(dtype)
+    phi_g = scal_ref[2].astype(dtype)
+
+    # Personal-best fold (the (N, D) half of it lives here so the
+    # local-best array is read and written exactly once per generation).
+    fit = fit_ref[...]
+    lbf = lbf_ref[...]
+    # Compare in f32: Mosaic on v5e rejects bf16 vector compares
+    # ("Target does not support this comparison"), and the column is
+    # only (bn, 1) so the upcast is free.
+    improved = fit.astype(jnp.float32) < lbf.astype(jnp.float32)  # (bn, 1)
+    lbl = jnp.where(improved, pop, lbl_ref[...])
+    lbf_out[...] = jnp.where(improved, fit, lbf)
+    lbl_out[...] = lbl
+
+    if rand == "input":
+        rp = rp_ref[...]
+        rg = rg_ref[...]
+    else:
+        rp = _uniform_bits(pop.shape, dtype)
+        rg = _uniform_bits(pop.shape, dtype)
+
+    vel = (
+        w * vel_ref[...]
+        + phi_p * rp * (lbl - pop)
+        + phi_g * rg * (gbl_ref[...] - pop)
+    )
+    lb = lb_ref[...]
+    ub = ub_ref[...]
+    pop_out[...] = jnp.clip(pop + vel, lb, ub)
+    vel_out[...] = jnp.clip(vel, lb, ub)
+
+
+def _pick_col_block(d: int) -> int:
+    """Lane-axis tile width.  A lane-UNALIGNED full-width block (e.g. the
+    north-star's d=1000) sent Mosaic's remote compile into the >25-minute
+    range on v5e, while 128-aligned blocks compile in seconds — so tile the
+    feature axis with an aligned width and let Pallas mask the edge tile.
+    Full width only when it is already aligned (or smaller than one lane
+    tile, where "equal to the array dim" is the legal escape hatch)."""
+    if d <= 128 or (d % 128 == 0 and d <= 512):
+        return d
+    return min(512, 128 * (d // 128))
+
+
+def _pick_block(n: int, d: int, itemsize: int) -> int | None:
+    """Largest divisor of ``n`` that keeps ~10 live (bn, bd) blocks inside a
+    conservative VMEM budget.  A divisor (not padding) because padding the
+    (N, D) operands would cost an extra full read+write of the state —
+    exactly the traffic the kernel exists to avoid.  Mosaic requires the
+    block's sublane dim to be a multiple of 8 (or the whole array), so a
+    candidate must satisfy that too; returns ``None`` when no such block
+    exists (caller falls back to the XLA path)."""
+    bd = _pick_col_block(d)
+    budget_rows = max(8, (12 * 1024 * 1024) // (10 * bd * itemsize))
+    limit = min(n, 512, budget_rows)
+    bn = None
+    for cand in range(8, limit + 1, 8):
+        if n % cand == 0:
+            bn = cand
+    if bn is None and n <= limit:
+        bn = n  # whole-array block is exempt from the multiple-of-8 rule
+    return bn
+
+
+def supports_shape(n: int, d: int, itemsize: int) -> bool:
+    """Static dispatch check: True iff a Mosaic-legal block exists for an
+    (n, d) population of the given element size."""
+    return _pick_block(n, d, itemsize) is not None
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rand", "block_rows", "interpret")
+)
+def fused_pso_move(
+    pop: jax.Array,
+    velocity: jax.Array,
+    local_best_location: jax.Array,
+    fit: jax.Array,
+    local_best_fit: jax.Array,
+    global_best_location: jax.Array,
+    lb: jax.Array,
+    ub: jax.Array,
+    w: jax.Array,
+    phi_p: jax.Array,
+    phi_g: jax.Array,
+    seed: jax.Array,
+    rand_draws: tuple[jax.Array, jax.Array] | None = None,
+    rand: str = "hw",
+    block_rows: int | None = None,
+    interpret: bool | None = None,
+):
+    """One fused PSO move: personal-best fold + random draws + velocity /
+    position update + bound clamps, single HBM pass.
+
+    :param pop: (N, D) positions.  ``velocity`` / ``local_best_location``
+        same shape and dtype.
+    :param fit: (N,) fitness of ``pop``; ``local_best_fit`` same shape.
+    :param global_best_location: (D,) — fold the global best *before*
+        calling (it reads only the (N,) fitness plus one row of ``pop``).
+    :param w, phi_p, phi_g: scalar hyperparameters (traced values fine).
+    :param seed: (1,) int32 PRNG seed for ``rand="hw"``; a per-step value
+        derived from the algorithm key keeps steps decorrelated.
+    :param rand_draws: ``rand="input"`` only — (rp, rg) uniforms of
+        ``pop``'s shape, used instead of the in-kernel PRNG.
+    :returns: ``(pop', velocity', local_best_location', local_best_fit')``.
+    """
+    n, d = pop.shape
+    dtype = pop.dtype
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if rand not in ("hw", "input"):
+        raise ValueError(f"rand must be 'hw' or 'input', got {rand!r}")
+    if rand == "input" and rand_draws is None:
+        raise ValueError("rand='input' requires rand_draws=(rp, rg)")
+
+    bn = block_rows or _pick_block(n, d, dtype.itemsize)
+    if bn is None:
+        raise ValueError(
+            f"fused_pso_move: no Mosaic-legal block for pop shape ({n}, {d}) "
+            f"— pop_size needs a divisor that is a multiple of 8 within the "
+            f"VMEM budget (check supports_shape() before dispatching)."
+        )
+    if n % bn:
+        raise ValueError(
+            f"fused_pso_move: block_rows={bn} does not divide pop_size={n}; "
+            f"the tail rows would be left unwritten."
+        )
+    bd = _pick_col_block(d)
+    # 2-D grid: rows x lane-tiles.  The per-row fold quantities ((bn, 1)
+    # blocks) are re-read and re-written per lane tile — idempotent and a
+    # rounding error next to the (bn, bd) traffic.
+    grid = (n // bn, -(-d // bd))
+
+    scal = jnp.stack(
+        [
+            jnp.asarray(w, jnp.float32),
+            jnp.asarray(phi_p, jnp.float32),
+            jnp.asarray(phi_g, jnp.float32),
+        ]
+    )
+    fit2 = fit.astype(dtype).reshape(n, 1)
+    lbf2 = local_best_fit.astype(dtype).reshape(n, 1)
+    gbl2 = global_best_location.astype(dtype).reshape(1, d)
+    lb2 = jnp.broadcast_to(lb.astype(dtype), (d,)).reshape(1, d)
+    ub2 = jnp.broadcast_to(ub.astype(dtype), (d,)).reshape(1, d)
+
+    nd_spec = pl.BlockSpec((bn, bd), lambda i, j: (i, j))
+    n1_spec = pl.BlockSpec((bn, 1), lambda i, j: (i, 0))
+    row_spec = pl.BlockSpec((1, bd), lambda i, j: (0, j))
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # seed
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # scalars
+        nd_spec,  # pop
+        nd_spec,  # velocity
+        nd_spec,  # local_best_location
+        n1_spec,  # fit
+        n1_spec,  # local_best_fit
+        row_spec,  # global_best_location
+        row_spec,  # lb
+        row_spec,  # ub
+    ]
+    operands = [
+        jnp.asarray(seed, jnp.int32).reshape(1),
+        scal,
+        pop,
+        velocity,
+        local_best_location,
+        fit2,
+        lbf2,
+        gbl2,
+        lb2,
+        ub2,
+    ]
+    if rand == "input":
+        rp, rg = rand_draws
+        in_specs += [nd_spec, nd_spec]
+        operands += [rp.astype(dtype), rg.astype(dtype)]
+
+    new_pop, new_vel, new_lbl, new_lbf = pl.pallas_call(
+        functools.partial(_pso_move_kernel, rand=rand),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[nd_spec, nd_spec, nd_spec, n1_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, d), dtype),
+            jax.ShapeDtypeStruct((n, d), dtype),
+            jax.ShapeDtypeStruct((n, d), dtype),
+            jax.ShapeDtypeStruct((n, 1), dtype),
+        ],
+        interpret=interpret,
+    )(*operands)
+    return new_pop, new_vel, new_lbl, new_lbf.reshape(n)

@@ -1,0 +1,162 @@
+"""Tests for the fused Pallas PSO move kernel (``evox_tpu/ops/pso_step.py``)
+and its algorithm wrapper ``PallasPSO``.
+
+The TPU PRNG primitives have no CPU lowering, so the kernel runs here in
+interpret mode with ``rand="input"`` (caller-supplied draws) and is checked
+for exact parity against a pure-jnp mirror of the same math.  The hardware
+PRNG path (``rand="hw"``) is exercised on real TPU by the
+``pso_northstar_pallas`` bench config.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.ops.pso_step import _pick_block, fused_pso_move
+
+
+def _jnp_mirror(pop, vel, lbl, fit, lbf, gbl, lb, ub, w, phi_p, phi_g, rp, rg):
+    """The kernel's math, op for op, in plain jnp (same dtype, same order)."""
+    dtype = pop.dtype
+    w = jnp.asarray(w, jnp.float32).astype(dtype)
+    phi_p = jnp.asarray(phi_p, jnp.float32).astype(dtype)
+    phi_g = jnp.asarray(phi_g, jnp.float32).astype(dtype)
+    fit = fit.astype(dtype)[:, None]
+    lbf = lbf.astype(dtype)[:, None]
+    improved = fit < lbf
+    new_lbl = jnp.where(improved, pop, lbl)
+    new_lbf = jnp.where(improved, fit, lbf)
+    rp = rp.astype(dtype)
+    rg = rg.astype(dtype)
+    new_vel = (
+        w * vel
+        + phi_p * rp * (new_lbl - pop)
+        + phi_g * rg * (gbl.astype(dtype)[None, :] - pop)
+    )
+    lb = lb.astype(dtype)[None, :]
+    ub = ub.astype(dtype)[None, :]
+    new_pop = jnp.clip(pop + new_vel, lb, ub)
+    new_vel = jnp.clip(new_vel, lb, ub)
+    return new_pop, new_vel, new_lbl, new_lbf[:, 0]
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("n,d", [(100, 37), (64, 128), (30, 5), (64, 300)])
+def test_fused_move_matches_jnp_mirror(dtype, n, d):
+    ks = jax.random.split(jax.random.key(0), 8)
+    pop = jax.random.uniform(ks[0], (n, d), dtype=jnp.float32).astype(dtype)
+    vel = (jax.random.uniform(ks[1], (n, d)) - 0.5).astype(dtype)
+    lbl = jax.random.uniform(ks[2], (n, d)).astype(dtype)
+    fit = jax.random.uniform(ks[3], (n,)).astype(dtype)
+    lbf = jax.random.uniform(ks[4], (n,)).astype(dtype)
+    gbl = jax.random.uniform(ks[5], (d,)).astype(dtype)
+    rp = jax.random.uniform(ks[6], (n, d)).astype(dtype)
+    rg = jax.random.uniform(ks[7], (n, d)).astype(dtype)
+    lb = jnp.full((d,), -2.0, dtype)
+    ub = jnp.full((d,), 2.0, dtype)
+    w, phi_p, phi_g = 0.6, 2.5, 0.8
+
+    got = fused_pso_move(
+        pop, vel, lbl, fit, lbf, gbl, lb, ub, w, phi_p, phi_g,
+        seed=jnp.zeros((1,), jnp.int32), rand_draws=(rp, rg), rand="input",
+        interpret=True,
+    )
+    want = _jnp_mirror(
+        pop, vel, lbl, fit, lbf, gbl, lb, ub, w, phi_p, phi_g, rp, rg
+    )
+    # FMA/fusion ordering differs between the pallas interpreter and the
+    # plain-jnp mirror — allow a few ULPs of the working dtype.
+    tol = 1e-5 if dtype == jnp.float32 else 2e-2
+    for g, w_ in zip(got, want):
+        assert g.dtype == w_.dtype
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(w_, np.float32),
+            rtol=tol, atol=tol,
+        )
+
+
+def test_pick_block_divides_and_bounds():
+    for n in (100_000, 1024, 100, 7, 1):
+        bn = _pick_block(n, 1000, 2)
+        assert n % bn == 0 and 1 <= bn <= 512
+        # Mosaic sublane rule: multiple of 8, or the whole array.
+        assert bn % 8 == 0 or bn == n
+    # f32 at D=1000 must pick a smaller block than bf16's budget.
+    assert _pick_block(100_000, 1000, 4) <= _pick_block(100_000, 1000, 2)
+    # A large odd population has no legal block -> None (XLA fallback).
+    from evox_tpu.ops.pso_step import supports_shape
+
+    assert _pick_block(99_999, 1000, 2) is None
+    assert not supports_shape(99_999, 1000, 2)
+    assert supports_shape(100_000, 1000, 2)
+
+
+def test_pick_col_block_lane_rules():
+    from evox_tpu.ops.pso_step import _pick_col_block
+
+    assert _pick_col_block(37) == 37  # sub-lane-tile: full width is legal
+    assert _pick_col_block(256) == 256  # aligned and small: one tile
+    assert _pick_col_block(1000) == 512  # unaligned: aligned tile + edge
+    # Wide aligned dims must still be capped, or ~10 live blocks overflow
+    # VMEM while supports_shape() claims the shape is fine.
+    assert _pick_col_block(1024) == 512
+    assert _pick_col_block(65536) == 512
+    bn = _pick_block(8, 65536, 4)
+    assert bn == 8  # wide-dim shape stays dispatchable within budget
+
+
+def test_fused_move_rejects_non_divisor_block_rows():
+    x = jnp.zeros((100, 8))
+    f = jnp.zeros((100,))
+    b = jnp.zeros((8,))
+    with pytest.raises(ValueError, match="does not divide"):
+        fused_pso_move(
+            x, x, x, f, f, b, b, b, 0.6, 2.5, 0.8,
+            seed=jnp.zeros((1,), jnp.int32),
+            rand_draws=(x, x), rand="input", block_rows=64, interpret=True,
+        )
+
+
+def test_fused_move_rejects_bad_rand_mode():
+    x = jnp.zeros((4, 8))
+    f = jnp.zeros((4,))
+    b = jnp.zeros((8,))
+    with pytest.raises(ValueError, match="rand"):
+        fused_pso_move(
+            x, x, x, f, f, b, b, b, 0.6, 2.5, 0.8,
+            seed=jnp.zeros((1,), jnp.int32), rand="nope", interpret=True,
+        )
+    with pytest.raises(ValueError, match="rand_draws"):
+        fused_pso_move(
+            x, x, x, f, f, b, b, b, 0.6, 2.5, 0.8,
+            seed=jnp.zeros((1,), jnp.int32), rand="input", interpret=True,
+        )
+
+
+def test_pallas_pso_falls_back_off_gate():
+    """Off-gate (default on CPU) PallasPSO must behave exactly like PSO —
+    bit-identical states after identical steps."""
+    from evox_tpu.algorithms import PSO, PallasPSO
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    lb = -5.0 * jnp.ones(8)
+    ub = 5.0 * jnp.ones(8)
+    outs = []
+    for cls in (PSO, PallasPSO):
+        wf = StdWorkflow(cls(32, lb, ub), Sphere())
+        s = wf.init(jax.random.key(3))
+        s = jax.jit(wf.init_step)(s)
+        step = jax.jit(wf.step)
+        for _ in range(5):
+            s = step(s)
+        outs.append(s)
+    a, b = outs
+    np.testing.assert_array_equal(
+        np.asarray(a.algorithm.pop), np.asarray(b.algorithm.pop)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.algorithm.global_best_fit),
+        np.asarray(b.algorithm.global_best_fit),
+    )
