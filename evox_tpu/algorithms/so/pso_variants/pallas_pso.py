@@ -1,19 +1,43 @@
 """PSO with a Pallas-fused move step.
 
-Drop-in PSO variant (same constructor, same ``State`` layout as
+Drop-in PSO variant (same constructor and update math as
 :class:`~evox_tpu.algorithms.so.pso_variants.pso.PSO`, itself the
 counterpart of the reference ``src/evox/algorithms/so/pso_variants/
 pso.py:9-116``) whose per-generation move runs as ONE Pallas kernel:
 personal-best fold, in-kernel hardware PRNG draws, velocity/position
 update and clamps in a single HBM pass (:mod:`evox_tpu.ops.pso_step`).
 
-Dispatch is gated by :func:`evox_tpu.ops.pallas_gate.pallas_enabled` —
-off-gate (the default, and always on non-TPU backends) this class *is*
-the XLA-path PSO, so it is safe to construct anywhere.  The kernel's
-random stream is the TPU core PRNG, decorrelated per step by folding the
-algorithm key into the seed; it is reproducible per key but not
-bit-identical to the Threefry draws of the XLA path (the same trade
-JAX's ``rbg`` PRNG makes; BASELINE.md measures both).
+Dispatch is decided ONCE at construction: the kernel path engages only
+when the capability gate is open (:func:`evox_tpu.ops.pallas_gate.
+pallas_enabled`) AND the population shape has a Mosaic-legal block
+(:func:`evox_tpu.ops.pso_step.supports_shape`).  Off-gate (the default,
+and always on non-TPU backends) this class *is* the XLA-path PSO —
+bit-identical states — so it is safe to construct anywhere.
+
+**Lane padding.**  The kernel only dispatches 128-aligned lane tiles
+(a masked edge tile hung the remote Mosaic compile and took the tunnel
+relay down with it — see ``ops/pso_step.py``).  When the kernel path is
+selected and ``dim`` is not a multiple of 128, the evolving state is
+held *persistently padded* to :func:`~evox_tpu.ops.pso_step.pad_dim`
+width: pad columns carry ``lb = ub = 0``, so they are initialized to 0
+and every clamp returns them to 0 — no real coordinate changes, and no
+per-generation pad/slice copies (padding in :func:`fused_pso_move`
+itself would re-read and re-write every operand, exactly the traffic
+the kernel exists to avoid).  Problems and monitors only ever see the
+``[:, :dim]`` view, which XLA fuses into the consumer.  Because the
+layout is decided per process, a checkpoint from a gate-open run must
+be loaded with the gate open (and vice versa) — a mismatch raises a
+descriptive error at the first ``step``/``init_step`` instead of a
+cryptic broadcast failure.
+
+**Randomness.**  ``rand="hw"`` (default) draws inside the kernel from
+the TPU core PRNG, decorrelated per step by a seed folded from the
+algorithm key — reproducible per key, but not bit-identical to the XLA
+path's Threefry draws (the same trade JAX's ``rbg`` PRNG makes;
+BASELINE.md measures both).  ``rand="input"`` draws Threefry uniforms
+outside the kernel and feeds them in — deterministic across backends
+(and how the CPU interpret-mode tests run the full padded path), at the
+cost of materializing the two (N, D) draw tensors the hw mode avoids.
 """
 
 from __future__ import annotations
@@ -31,13 +55,72 @@ __all__ = ["PallasPSO"]
 class PallasPSO(PSO):
     """Inertia/cognitive/social PSO with a single-pass fused move kernel."""
 
-    def step(self, state: State, evaluate: EvalFn) -> State:
+    def __init__(
+        self,
+        pop_size: int,
+        lb: jax.Array,
+        ub: jax.Array,
+        w: float = 0.6,
+        phi_p: float = 2.5,
+        phi_g: float = 0.8,
+        dtype=jnp.float32,
+        rand: str = "hw",
+    ):
         from ....ops.pallas_gate import pallas_enabled
-        from ....ops.pso_step import fused_pso_move, supports_shape
+        from ....ops.pso_step import pad_dim, supports_shape
 
-        if not pallas_enabled() or not supports_shape(
-            self.pop_size, self.dim, jnp.dtype(self.dtype).itemsize
-        ):
+        super().__init__(pop_size, lb, ub, w, phi_p, phi_g, dtype=dtype)
+        if rand not in ("hw", "input"):
+            raise ValueError(f"rand must be 'hw' or 'input', got {rand!r}")
+        self.rand = rand
+        # Static per-process decision (env gate + cached capability verdict
+        # + shape legality); everything below traces against it.
+        self.use_kernel = pallas_enabled() and supports_shape(
+            pop_size, self.dim, jnp.dtype(dtype).itemsize
+        )
+        self.true_dim = self.dim
+        if self.use_kernel and self.dim != pad_dim(self.dim):
+            pad = pad_dim(self.dim) - self.dim
+            zeros = jnp.zeros((pad,), dtype=dtype)
+            self.lb = jnp.concatenate([self.lb, zeros])
+            self.ub = jnp.concatenate([self.ub, zeros])
+            self.dim = self.dim + pad  # setup() now builds padded state
+
+    def _solutions(self, pop: jax.Array) -> jax.Array:
+        """The (N, true_dim) view problems and monitors see."""
+        return pop[:, : self.true_dim] if self.dim != self.true_dim else pop
+
+    def _check_state_width(self, state: State) -> None:
+        """The state layout depends on the construction-time kernel decision
+        (padded vs not), which is per-process (gate verdict + backend).  A
+        checkpoint written by a padded run and loaded where the gate is
+        closed (or vice versa) would otherwise die in a cryptic broadcast
+        error deep in the update math — diagnose it at trace time."""
+        width = state.pop.shape[1]
+        if width != self.dim:
+            raise ValueError(
+                f"PallasPSO: state width {width} does not match this "
+                f"instance's layout ({self.dim}, true dim {self.true_dim}). "
+                f"The lane-padded layout engages only when the Pallas gate "
+                f"is open in the constructing process — a checkpoint from a "
+                f"gate-open run must be loaded with the gate open "
+                f"(EVOX_TPU_PALLAS), and one from a gate-closed run with it "
+                f"closed."
+            )
+
+    def init_step(self, state: State, evaluate: EvalFn) -> State:
+        self._check_state_width(state)
+        # _solutions() is the identity when unpadded, so one delegation
+        # covers both the kernel and fallback layouts.
+        return super().init_step(
+            state, lambda pop: evaluate(self._solutions(pop))
+        )
+
+    def step(self, state: State, evaluate: EvalFn) -> State:
+        from ....ops.pso_step import fused_pso_move
+
+        self._check_state_width(state)
+        if not self.use_kernel:
             return super().step(state, evaluate)
 
         # Global-best fold outside the kernel: it reads only the (N,)
@@ -47,11 +130,20 @@ class PallasPSO(PSO):
             [state.global_best_location[None, :], state.pop],
             [state.global_best_fit[None], state.fit],
         )
-        key, seed_key = jax.random.split(state.key)
-        seed = jax.random.randint(
-            seed_key, (1,), minval=0, maxval=jnp.iinfo(jnp.int32).max,
-            dtype=jnp.int32,
-        )
+        key, step_key = jax.random.split(state.key)
+        if self.rand == "input":
+            rp_key, rg_key = jax.random.split(step_key)
+            draws = (
+                jax.random.uniform(rp_key, state.pop.shape, dtype=state.pop.dtype),
+                jax.random.uniform(rg_key, state.pop.shape, dtype=state.pop.dtype),
+            )
+            seed = jnp.zeros((1,), jnp.int32)  # kernel ignores it in input mode
+        else:
+            draws = None
+            seed = jax.random.randint(
+                step_key, (1,), minval=0, maxval=jnp.iinfo(jnp.int32).max,
+                dtype=jnp.int32,
+            )
         pop, velocity, local_best_location, local_best_fit = fused_pso_move(
             state.pop,
             state.velocity,
@@ -65,8 +157,10 @@ class PallasPSO(PSO):
             state.phi_p,
             state.phi_g,
             seed,
+            rand_draws=draws,
+            rand=self.rand,
         )
-        fit = evaluate(pop)
+        fit = evaluate(self._solutions(pop))
         return state.replace(
             key=key,
             pop=pop,

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["fused_pso_move"]
+__all__ = ["fused_pso_move", "pad_dim", "supports_shape"]
 
 
 def _uniform_bits(shape, dtype):
@@ -108,16 +108,39 @@ def _pso_move_kernel(
     vel_out[...] = jnp.clip(vel, lb, ub)
 
 
-def _pick_col_block(d: int) -> int:
-    """Lane-axis tile width.  A lane-UNALIGNED full-width block (e.g. the
-    north-star's d=1000) sent Mosaic's remote compile into the >25-minute
-    range on v5e, while 128-aligned blocks compile in seconds — so tile the
-    feature axis with an aligned width and let Pallas mask the edge tile.
-    Full width only when it is already aligned (or smaller than one lane
-    tile, where "equal to the array dim" is the legal escape hatch)."""
-    if d <= 128 or (d % 128 == 0 and d <= 512):
+def pad_dim(d: int) -> int:
+    """The feature width the kernel actually runs at: ``d`` rounded up to a
+    multiple of the 128-wide lane tile.  Callers (``PallasPSO``) hold their
+    state padded to this width with the pad columns pinned to zero by
+    ``lb = ub = 0`` — zero-width bounds keep them at exactly 0 through every
+    velocity/position update, so padding changes no real coordinate."""
+    return max(128, -(-d // 128) * 128)
+
+
+def _pick_col_block(d: int) -> int | None:
+    """Lane-axis tile width — 128-aligned tiles ONLY.
+
+    Lane-unaligned blocks are refused outright (``None``), not masked:
+    a masked edge tile (d=1000 -> 512+488) put the remote Mosaic compile
+    past 18 minutes, under which the single-client tunnel relay died
+    (observed 2026-07-31; same pathology as the documented >25-min
+    lane-unaligned full-width compile).  Aligned tiles — the capability
+    probe's own shape class — compile in seconds.  Unaligned ``d`` must be
+    padded by the caller (:func:`pad_dim`); the sub-lane full-width escape
+    (``d <= 128``) is kept for interpret-mode tests, and real TPU dispatch
+    via ``PallasPSO`` always pads instead of relying on it."""
+    if d <= 128:
         return d
-    return min(512, 128 * (d // 128))
+    if d % 128:
+        return None
+    # Largest 128-multiple tile (capped at 512 for VMEM) that DIVIDES d —
+    # a non-divisor cap (e.g. 512 for d=640) would leave a masked edge
+    # tile, the very pathology being refused.  128 always divides an
+    # aligned d, so a full-width tiling always exists.
+    for bd in (512, 384, 256, 128):
+        if d % bd == 0:
+            return bd
+    return 128
 
 
 def _pick_block(n: int, d: int, itemsize: int) -> int | None:
@@ -129,6 +152,8 @@ def _pick_block(n: int, d: int, itemsize: int) -> int | None:
     candidate must satisfy that too; returns ``None`` when no such block
     exists (caller falls back to the XLA path)."""
     bd = _pick_col_block(d)
+    if bd is None:
+        return None
     budget_rows = max(8, (12 * 1024 * 1024) // (10 * bd * itemsize))
     limit = min(n, 512, budget_rows)
     bn = None
@@ -141,9 +166,11 @@ def _pick_block(n: int, d: int, itemsize: int) -> int | None:
 
 
 def supports_shape(n: int, d: int, itemsize: int) -> bool:
-    """Static dispatch check: True iff a Mosaic-legal block exists for an
-    (n, d) population of the given element size."""
-    return _pick_block(n, d, itemsize) is not None
+    """Static dispatch check: True iff the kernel can serve an (n, d)
+    population of the given element size — i.e. a Mosaic-legal block exists
+    at the lane-padded width :func:`pad_dim` that ``PallasPSO`` actually
+    dispatches."""
+    return _pick_block(n, pad_dim(d), itemsize) is not None
 
 
 @functools.partial(
@@ -190,13 +217,27 @@ def fused_pso_move(
         raise ValueError(f"rand must be 'hw' or 'input', got {rand!r}")
     if rand == "input" and rand_draws is None:
         raise ValueError("rand='input' requires rand_draws=(rp, rg)")
+    if d % 128:
+        # Lane-unaligned widths are the remote-Mosaic compile pathology
+        # (masked edge tiles included) — never dispatch them on hardware.
+        # Sub-lane widths (d < 128) are tolerated in interpret mode only,
+        # where no Mosaic compile happens, so tests can run natural shapes.
+        if d > 128 or not interpret:
+            raise ValueError(
+                f"fused_pso_move: feature dim {d} is not lane-aligned — an "
+                f"unaligned tile hangs the remote Mosaic compile.  Pad the "
+                f"feature axis to pad_dim({d})={pad_dim(d)} with lb=ub=0 "
+                f"pad columns (PallasPSO does this automatically)."
+            )
 
     bn = block_rows or _pick_block(n, d, dtype.itemsize)
     if bn is None:
         raise ValueError(
             f"fused_pso_move: no Mosaic-legal block for pop shape ({n}, {d}) "
             f"— pop_size needs a divisor that is a multiple of 8 within the "
-            f"VMEM budget (check supports_shape() before dispatching)."
+            f"VMEM budget.  Note supports_shape() answers for the "
+            f"lane-padded width pad_dim(d) that PallasPSO dispatches, not "
+            f"for raw unpadded operands."
         )
     if n % bn:
         raise ValueError(

@@ -68,8 +68,13 @@ def load_state(
         leaf absent from the checkpoint keeps the template's value (with a
         warning) instead of raising ``KeyError``.
     """
+    import os
     import warnings
 
+    # ``np.savez`` silently appends ``.npz`` to suffix-less paths, so accept
+    # the same path string save_state() was given.
+    if not os.path.exists(path) and os.path.exists(f"{path}.npz"):
+        path = f"{path}.npz"
     data = np.load(path)
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
     new_leaves = []

@@ -227,6 +227,15 @@ def test_checkpoint_round_trip(tmp_path, key):
     )
 
 
+def test_checkpoint_suffixless_path_round_trips(tmp_path, key):
+    """``np.savez`` silently appends ``.npz`` to suffix-less paths;
+    ``load_state`` must accept the same path string ``save_state`` did."""
+    state = State(a=jnp.arange(3.0))
+    save_state(tmp_path / "ckpt", state)
+    restored = load_state(tmp_path / "ckpt", State(a=jnp.zeros(3)))
+    np.testing.assert_array_equal(np.asarray(restored.a), np.arange(3.0))
+
+
 def test_checkpoint_missing_leaf_raises(tmp_path, key):
     state = State(a=jnp.zeros(3))
     save_state(tmp_path / "s.npz", state)

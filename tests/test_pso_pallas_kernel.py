@@ -42,7 +42,7 @@ def _jnp_mirror(pop, vel, lbl, fit, lbf, gbl, lb, ub, w, phi_p, phi_g, rp, rg):
 
 
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
-@pytest.mark.parametrize("n,d", [(100, 37), (64, 128), (30, 5), (64, 300)])
+@pytest.mark.parametrize("n,d", [(100, 37), (64, 128), (30, 5), (64, 384)])
 def test_fused_move_matches_jnp_mirror(dtype, n, d):
     ks = jax.random.split(jax.random.key(0), 8)
     pop = jax.random.uniform(ks[0], (n, d), dtype=jnp.float32).astype(dtype)
@@ -78,32 +78,60 @@ def test_fused_move_matches_jnp_mirror(dtype, n, d):
 
 def test_pick_block_divides_and_bounds():
     for n in (100_000, 1024, 100, 7, 1):
-        bn = _pick_block(n, 1000, 2)
+        bn = _pick_block(n, 1024, 2)
         assert n % bn == 0 and 1 <= bn <= 512
         # Mosaic sublane rule: multiple of 8, or the whole array.
         assert bn % 8 == 0 or bn == n
-    # f32 at D=1000 must pick a smaller block than bf16's budget.
-    assert _pick_block(100_000, 1000, 4) <= _pick_block(100_000, 1000, 2)
+    # f32 at the padded north-star width must fit a (possibly smaller)
+    # block than bf16's budget allows.
+    assert _pick_block(100_000, 1024, 4) <= _pick_block(100_000, 1024, 2)
     # A large odd population has no legal block -> None (XLA fallback).
     from evox_tpu.ops.pso_step import supports_shape
 
-    assert _pick_block(99_999, 1000, 2) is None
+    assert _pick_block(99_999, 1024, 2) is None
     assert not supports_shape(99_999, 1000, 2)
+    # The north-star shape is served via lane padding (1000 -> 1024).
     assert supports_shape(100_000, 1000, 2)
 
 
 def test_pick_col_block_lane_rules():
-    from evox_tpu.ops.pso_step import _pick_col_block
+    from evox_tpu.ops.pso_step import _pick_col_block, pad_dim
 
     assert _pick_col_block(37) == 37  # sub-lane-tile: full width is legal
     assert _pick_col_block(256) == 256  # aligned and small: one tile
-    assert _pick_col_block(1000) == 512  # unaligned: aligned tile + edge
+    # Unaligned beyond one lane tile: REFUSED (a masked edge tile hangs
+    # the remote Mosaic compile) — callers pad via pad_dim instead.
+    assert _pick_col_block(1000) is None
+    assert pad_dim(1000) == 1024
+    assert pad_dim(128) == 128
+    assert pad_dim(37) == 128
     # Wide aligned dims must still be capped, or ~10 live blocks overflow
     # VMEM while supports_shape() claims the shape is fine.
     assert _pick_col_block(1024) == 512
     assert _pick_col_block(65536) == 512
+    # The capped tile must DIVIDE d — a non-divisor cap would leave a
+    # masked edge tile (640 = 512 + masked 128 would be the pathology).
+    assert _pick_col_block(640) == 128
+    assert _pick_col_block(1152) == 384
+    assert _pick_col_block(896) == 128
+    for d in (256, 384, 512, 640, 768, 1024, 1152, 4096):
+        bd = _pick_col_block(d)
+        assert d % bd == 0 and bd <= 512
     bn = _pick_block(8, 65536, 4)
     assert bn == 8  # wide-dim shape stays dispatchable within budget
+
+
+def test_fused_move_rejects_unaligned_wide_dim():
+    n, d = 8, 1000
+    x = jnp.zeros((n, d))
+    f = jnp.zeros((n,))
+    b = jnp.zeros((d,))
+    with pytest.raises(ValueError, match="lane-aligned"):
+        fused_pso_move(
+            x, x, x, f, f, b, b, b, 0.6, 2.5, 0.8,
+            seed=jnp.zeros((1,), jnp.int32),
+            rand_draws=(x, x), rand="input", interpret=True,
+        )
 
 
 def test_fused_move_rejects_non_divisor_block_rows():
@@ -132,6 +160,69 @@ def test_fused_move_rejects_bad_rand_mode():
             x, x, x, f, f, b, b, b, 0.6, 2.5, 0.8,
             seed=jnp.zeros((1,), jnp.int32), rand="input", interpret=True,
         )
+
+
+def test_pallas_pso_padded_kernel_path(monkeypatch):
+    """Gate forced open + rand='input': the FULL PallasPSO kernel path —
+    lane padding, padded-state kernel dispatch (interpret mode on CPU),
+    sliced evaluation — runs end-to-end.  Pad columns must stay exactly 0
+    and the sliced fitness must be consistent with the real coordinates."""
+    from evox_tpu.ops import pallas_gate
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    monkeypatch.setenv("EVOX_TPU_PALLAS", "1")
+    pallas_gate._reset_for_tests()
+    try:
+        from evox_tpu.algorithms import PallasPSO
+
+        algo = PallasPSO(32, -5.0 * jnp.ones(10), 5.0 * jnp.ones(10),
+                         rand="input")
+        assert algo.use_kernel and algo.true_dim == 10 and algo.dim == 128
+        wf = StdWorkflow(algo, Sphere())
+        s = wf.init(jax.random.key(7))
+        s = jax.jit(wf.init_step)(s)
+        step = jax.jit(wf.step)
+        first = float(jnp.min(s.algorithm.fit))
+        for _ in range(20):
+            s = step(s)
+        pop = np.asarray(s.algorithm.pop)
+        assert pop.shape == (32, 128)
+        np.testing.assert_array_equal(pop[:, 10:], 0.0)  # pads pinned at 0
+        np.testing.assert_allclose(
+            np.asarray(s.algorithm.fit),
+            (pop[:, :10] ** 2).sum(axis=1),
+            rtol=1e-5,
+        )
+        assert float(jnp.min(s.algorithm.fit)) < first  # it optimizes
+    finally:
+        pallas_gate._reset_for_tests()
+
+
+def test_pallas_pso_state_width_mismatch_is_diagnosed(monkeypatch):
+    """A padded-layout state fed to a gate-closed instance (the checkpoint
+    portability trap) must raise the descriptive layout error, not a
+    broadcast failure deep in the update math."""
+    from evox_tpu.ops import pallas_gate
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    monkeypatch.setenv("EVOX_TPU_PALLAS", "1")
+    pallas_gate._reset_for_tests()
+    try:
+        from evox_tpu.algorithms import PallasPSO
+
+        padded = PallasPSO(16, -5.0 * jnp.ones(10), 5.0 * jnp.ones(10),
+                           rand="input")
+        wf = StdWorkflow(padded, Sphere())
+        s = wf.init(jax.random.key(0))
+    finally:
+        monkeypatch.setenv("EVOX_TPU_PALLAS", "0")
+        pallas_gate._reset_for_tests()
+    closed = PallasPSO(16, -5.0 * jnp.ones(10), 5.0 * jnp.ones(10))
+    assert not closed.use_kernel
+    with pytest.raises(ValueError, match="state width 128"):
+        closed.step(s.algorithm, lambda pop: jnp.sum(pop**2, axis=1))
 
 
 def test_pallas_pso_falls_back_off_gate():

@@ -21,7 +21,8 @@ PROFILE_CFGS="nsga2_dtlz2 rank_20k rvea_dtlz2 pso_northstar_fused pso_northstar"
 for cfg in $PROFILE_CFGS; do
   rm -rf "bench_artifacts/profile_${cfg}"
 done
-rm -f bench_artifacts/nsga2_dtlz2_pallas.tpu.json
+rm -f bench_artifacts/nsga2_dtlz2_pallas.tpu.json \
+      bench_artifacts/pso_northstar_pallas.tpu.json
 
 echo "=== sweep start $(date -u +%H:%M:%S) ==="
 python bench.py --all --runs 3 --platform tpu --no-probe \
@@ -88,6 +89,13 @@ if python -m evox_tpu.ops.pallas_gate; then
   echo "=== pallas OK -> measuring nsga2_dtlz2_pallas $(date -u +%H:%M:%S) ==="
   python bench.py --config nsga2_dtlz2_pallas --runs 3 --platform tpu --no-probe \
     || echo "PALLAS BENCH FAILED rc=$?"
+  # The fused PSO move kernel's FIRST Mosaic compile at the north-star
+  # shape runs >20 min on a remote attachment; the persistent .jax_cache
+  # makes repeats fast, but a cold sweep must give run 1 room.
+  echo "=== pallas OK -> measuring pso_northstar_pallas $(date -u +%H:%M:%S) ==="
+  EVOX_TPU_BENCH_CHILD_TIMEOUT=3600 \
+  python bench.py --config pso_northstar_pallas --runs 3 --platform tpu --no-probe \
+    || echo "PALLAS PSO BENCH FAILED rc=$?"
   python tools/update_baseline.py || true
 else
   cp ~/.evox_tpu_pallas_probe.json bench_artifacts/pallas_probe_verdict.json 2>/dev/null
