@@ -137,10 +137,10 @@ def _pick_col_block(d: int) -> int | None:
     # a non-divisor cap (e.g. 512 for d=640) would leave a masked edge
     # tile, the very pathology being refused.  128 always divides an
     # aligned d, so a full-width tiling always exists.
-    for bd in (512, 384, 256, 128):
+    for bd in (512, 384, 256):
         if d % bd == 0:
             return bd
-    return 128
+    return 128  # always divides an aligned d
 
 
 def _pick_block(n: int, d: int, itemsize: int) -> int | None:
