@@ -199,6 +199,33 @@ def test_pallas_pso_padded_kernel_path(monkeypatch):
         pallas_gate._reset_for_tests()
 
 
+def test_pallas_pso_kernel_path_vmaps(monkeypatch):
+    """The HPO wrapper parallelizes instances by vmapping workflow.step —
+    the kernel path must compose with vmap (pallas_call's batching rule
+    adds a leading grid dim; exercised here in interpret mode)."""
+    from evox_tpu.ops import pallas_gate
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    monkeypatch.setenv("EVOX_TPU_PALLAS", "1")
+    pallas_gate._reset_for_tests()
+    try:
+        from evox_tpu.algorithms import PallasPSO
+
+        algo = PallasPSO(16, -5.0 * jnp.ones(8), 5.0 * jnp.ones(8),
+                         rand="input")
+        assert algo.use_kernel
+        wf = StdWorkflow(algo, Sphere())
+        keys = jax.random.split(jax.random.key(0), 4)
+        states = jax.vmap(wf.init)(keys)
+        states = jax.vmap(wf.init_step)(states)
+        states = jax.jit(jax.vmap(wf.step))(states)
+        assert states.algorithm.pop.shape == (4, 16, 128)
+        assert bool(jnp.all(jnp.isfinite(states.algorithm.fit)))
+    finally:
+        pallas_gate._reset_for_tests()
+
+
 def test_pallas_pso_state_width_mismatch_is_diagnosed(monkeypatch):
     """A padded-layout state fed to a gate-closed instance (the checkpoint
     portability trap) must raise the descriptive layout error, not a
