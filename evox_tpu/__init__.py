@@ -8,7 +8,17 @@ auto-loaded at import (``evox_tpu_ext``).
 
 __version__ = "0.1.0"
 
-from . import algorithms, core, metrics, operators, problems, utils, vis_tools, workflows
+from . import (
+    algorithms,
+    core,
+    metrics,
+    operators,
+    problems,
+    resilience,
+    utils,
+    vis_tools,
+    workflows,
+)
 from .core import (
     Algorithm,
     Monitor,
@@ -29,6 +39,7 @@ __all__ = [
     "metrics",
     "operators",
     "problems",
+    "resilience",
     "utils",
     "vis_tools",
     "workflows",

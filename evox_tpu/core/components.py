@@ -163,3 +163,11 @@ class Monitor(_Component):
         (only called when a subclass overrides this method)."""
         del aux
         return state
+
+    def record_nonfinite(self, state: State, mask: jax.Array) -> State:
+        """Hook: per-individual boolean mask of quarantined non-finite
+        fitness rows, fired by ``StdWorkflow`` before the penalty
+        substitution (see ``quarantine_nonfinite``).  Runs inside the jitted
+        step; the no-op base keeps it free for monitors that don't track it."""
+        del mask
+        return state

@@ -21,6 +21,23 @@ from ..core import Problem, State
 
 __all__ = ["ShardedProblem"]
 
+# ``shard_map`` moved to the top-level namespace after jax 0.4.x, and its
+# replication-check kwarg was renamed check_rep -> check_vma in a separate
+# release — probe each independently so the sharded path works on whichever
+# jax the container bakes in (namespace location does not imply kwarg name).
+import inspect as _inspect
+
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
 
 class ShardedProblem(Problem):
     """Wraps a Problem so evaluation is population-sharded over a mesh."""
@@ -45,10 +62,15 @@ class ShardedProblem(Problem):
         # leading pop axis, as neuroevolution problems consume); the P(axis)
         # in_spec below is a pytree prefix, sharding every leaf's axis 0.
         pop_size = jax.tree.leaves(pop)[0].shape[0]
-        assert pop_size % n_shards == 0, (
-            f"population size {pop_size} must divide over the "
-            f"{n_shards}-way '{self.axis_name}' mesh axis"
-        )
+        if pop_size % n_shards != 0:
+            # Not an assert: user-input validation must survive `python -O`,
+            # and the message carries the numbers needed to fix the config.
+            raise ValueError(
+                f"population size {pop_size} must divide over the "
+                f"{n_shards}-way '{self.axis_name}' mesh axis "
+                f"(mesh shape: {dict(self.mesh.shape)}); pad the population "
+                f"or choose a pop_size that is a multiple of {n_shards}"
+            )
         axis = self.axis_name
 
         def local_eval(pop_shard):
@@ -59,12 +81,12 @@ class ShardedProblem(Problem):
             fit, _ = self.problem.evaluate(local_state, pop_shard)
             return jax.lax.all_gather(fit, axis, axis=0, tiled=True)
 
-        fit = jax.shard_map(
+        fit = _shard_map(
             local_eval,
             mesh=self.mesh,
             in_specs=P(axis),
             out_specs=P(),
-            check_vma=False,
+            **{_CHECK_KW: False},
         )(pop)
         if "key" in state:
             state = state.replace(key=jax.random.fold_in(state.key, 0x5EED))

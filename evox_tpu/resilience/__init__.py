@@ -1,0 +1,49 @@
+"""Resilient long-run execution.
+
+Production-scale evolutionary computation assumes runs that survive
+accelerator loss: this repo's own measurement log (BASELINE.md) records two
+full benchmark rounds lost to TPU backend outages — 21 consecutive probes
+hanging ~25 minutes in backend init and exiting ``UNAVAILABLE`` — while the
+bare ``StdWorkflow.run`` fori-loop discards the whole run on any crash.
+
+This subsystem adds the missing layer:
+
+* :class:`ResilientRunner` — wraps any :class:`~evox_tpu.core.Workflow` and
+  executes N generations as chunked jitted segments with periodic atomic
+  checkpoints, auto-resume from the latest valid checkpoint, retry with
+  exponential backoff on backend-loss signatures (``UNAVAILABLE`` /
+  ``INTERNAL`` ``XlaRuntimeError``), a watchdog deadline that converts the
+  silent-hang signature into a retryable timeout, and an optional last-ditch
+  CPU fallback.
+* :class:`FaultyProblem` — a deterministic fault-injection wrapper (NaN
+  rows, host-side exceptions, artificial delays, by generation schedule) so
+  every recovery path above is testable on CPU.
+
+Non-finite fitness quarantine lives in the workflow layer itself
+(``StdWorkflow(quarantine_nonfinite=True)``, the default) so NaN/±Inf never
+silently propagate through ranking — see ``workflows/std_workflow.py``.
+"""
+
+from .faults import FaultyProblem, InjectedBackendError, InjectedFatalError
+from .runner import (
+    ResilienceError,
+    ResilientRunner,
+    RetryPolicy,
+    RunStats,
+    WatchdogTimeout,
+    default_retryable,
+    latest_checkpoint,
+)
+
+__all__ = [
+    "ResilientRunner",
+    "RetryPolicy",
+    "RunStats",
+    "ResilienceError",
+    "WatchdogTimeout",
+    "default_retryable",
+    "latest_checkpoint",
+    "FaultyProblem",
+    "InjectedBackendError",
+    "InjectedFatalError",
+]

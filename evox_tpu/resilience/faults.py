@@ -1,0 +1,181 @@
+"""Deterministic fault injection for testing recovery paths on CPU.
+
+:class:`FaultyProblem` wraps any :class:`~evox_tpu.core.Problem` and injects
+faults by **evaluation schedule** (0-based evaluation index, counted in the
+wrapper's own jitted state, so the schedule survives checkpoint/resume and
+replays deterministically):
+
+* **NaN rows** — the first ``nan_rows`` fitness entries of scheduled
+  evaluations become NaN *inside the jitted program*, exercising the
+  workflow's non-finite quarantine without leaving XLA.
+* **host-side exceptions** — an ``io_callback`` raises
+  :class:`InjectedBackendError` (message carries ``UNAVAILABLE``, the
+  BASELINE.md outage signature); XLA wraps it into the same
+  ``XlaRuntimeError: INTERNAL: CpuCallback error`` a real backend loss
+  produces, so the runner's retry predicate sees exactly what production
+  would show it.  :class:`InjectedFatalError` carries the ``NONRETRYABLE``
+  marker instead — it simulates a genuine crash/process kill that retry must
+  NOT paper over.
+* **artificial delays** — the host callback sleeps, driving the runner's
+  watchdog path (the silent-hang signature).
+
+Transient faults are **attempt-counted on the host side**: a fault fires for
+its first ``*_times`` attempts of a given evaluation index and then stops,
+modeling an outage that passes — which is what lets retry/resume tests
+complete.  Counters live on the wrapper instance (host memory), not in the
+jitted state: a retry that reloads the checkpoint rolls the evaluation index
+back but must still see the outage as "over".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+from jax.sharding import SingleDeviceSharding
+
+from ..core import Problem, State
+
+__all__ = ["FaultyProblem", "InjectedBackendError", "InjectedFatalError"]
+
+
+class InjectedBackendError(RuntimeError):
+    """Simulated transient backend loss (retryable signature)."""
+
+
+class InjectedFatalError(RuntimeError):
+    """Simulated unrecoverable crash (carries the NONRETRYABLE marker)."""
+
+
+class FaultyProblem(Problem):
+    """Wraps a problem with a deterministic, generation-scheduled fault plan.
+
+    The wrapper is numerically transparent (same fitness, no extra PRNG use)
+    — host faults raise/sleep but never touch the data path, and NaN
+    injection only fires on scheduled evaluations.  For bit-identical
+    clean-run comparators, keep the *program structure* identical too: build
+    the comparator with the SAME schedule but ``*_times=0`` (the host
+    callback stays in the compiled program — XLA fusion, and therefore
+    ulp-level float results, can differ between programs with and without
+    the callback op).
+    """
+
+    def __init__(
+        self,
+        problem: Problem,
+        *,
+        nan_generations: Sequence[int] = (),
+        nan_rows: int = 1,
+        error_generations: Sequence[int] = (),
+        error_times: int = 1,
+        error_message: str = "UNAVAILABLE: injected backend loss (fault schedule)",
+        fatal_generations: Sequence[int] = (),
+        fatal_times: int = 1,
+        delay_generations: Sequence[int] = (),
+        delay_seconds: float = 1.0,
+        delay_times: int = 1,
+    ):
+        """
+        :param nan_generations: evaluation indices whose fitness gets NaN
+            injected into its first ``nan_rows`` rows (inside jit).
+        :param error_generations: evaluation indices that raise a retryable
+            :class:`InjectedBackendError` from the host, for the first
+            ``error_times`` attempts each.
+        :param fatal_generations: evaluation indices that raise a
+            ``NONRETRYABLE`` :class:`InjectedFatalError` for the first
+            ``fatal_times`` attempts each (simulated kill; a supervisor
+            must surface it, and a later resume gets past it).
+        :param delay_generations: evaluation indices whose host callback
+            sleeps ``delay_seconds`` for the first ``delay_times`` attempts
+            each (watchdog fodder).
+        """
+        self.problem = problem
+        self.nan_generations = tuple(int(g) for g in nan_generations)
+        self.nan_rows = int(nan_rows)
+        self.error_generations = frozenset(int(g) for g in error_generations)
+        self.error_times = int(error_times)
+        self.error_message = error_message
+        self.fatal_generations = frozenset(int(g) for g in fatal_generations)
+        self.fatal_times = int(fatal_times)
+        self.delay_generations = frozenset(int(g) for g in delay_generations)
+        self.delay_seconds = float(delay_seconds)
+        self.delay_times = int(delay_times)
+        self._lock = threading.Lock()
+        self._attempts: dict[tuple[str, int], int] = {}
+        self._has_host_faults = bool(
+            self.error_generations
+            or self.fatal_generations
+            or self.delay_generations
+        )
+
+    # -- host side ---------------------------------------------------------
+    def _bump(self, kind: str, gen: int) -> int:
+        with self._lock:
+            n = self._attempts.get((kind, gen), 0) + 1
+            self._attempts[(kind, gen)] = n
+            return n
+
+    def attempts(self, kind: str, gen: int) -> int:
+        """How many times the ``kind`` fault at evaluation ``gen`` has been
+        reached so far (test observability)."""
+        with self._lock:
+            return self._attempts.get((kind, gen), 0)
+
+    def reset_faults(self) -> None:
+        """Forget all attempt counts (faults re-arm)."""
+        with self._lock:
+            self._attempts.clear()
+
+    def _host_hook(self, gen) -> None:
+        g = int(gen)
+        if g in self.fatal_generations:
+            if self._bump("fatal", g) <= self.fatal_times:
+                raise InjectedFatalError(
+                    f"NONRETRYABLE: injected unrecoverable crash at "
+                    f"evaluation {g} (simulated process kill)"
+                )
+        if g in self.error_generations:
+            if self._bump("error", g) <= self.error_times:
+                raise InjectedBackendError(f"{self.error_message} [eval {g}]")
+        if g in self.delay_generations:
+            if self._bump("delay", g) <= self.delay_times:
+                time.sleep(self.delay_seconds)
+
+    # -- component protocol ------------------------------------------------
+    def setup(self, key: jax.Array) -> State:
+        return State(
+            inner=self.problem.setup(key),
+            # 0-based evaluation index; lives in the jitted state so it is
+            # checkpointed and rolls back with the run on resume.
+            fault_generation=jnp.int32(0),
+        )
+
+    def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
+        gen = state.fault_generation
+        if self._has_host_faults:
+            # Ordered + pinned to one device: fires exactly once per
+            # evaluation, in program order, like a real backend fault would.
+            io_callback(
+                self._host_hook,
+                None,
+                gen,
+                ordered=True,
+                sharding=SingleDeviceSharding(jax.local_devices()[0]),
+            )
+        fit, inner = self.problem.evaluate(state.inner, pop)
+        if self.nan_generations:
+            scheduled = jnp.any(
+                gen == jnp.asarray(self.nan_generations, jnp.int32)
+            )
+            rows = jnp.arange(fit.shape[0]) < self.nan_rows
+            mask = rows if fit.ndim == 1 else rows[:, None]
+            fit = jnp.where(
+                jnp.logical_and(scheduled, mask),
+                jnp.asarray(jnp.nan, fit.dtype),
+                fit,
+            )
+        return fit, state.replace(inner=inner, fault_generation=gen + 1)

@@ -1,0 +1,598 @@
+"""Checkpointed, retrying run supervisor for long workflow executions.
+
+``StdWorkflow.run`` compiles N generations into one ``lax.fori_loop`` — the
+fastest shape for healthy hardware, and the most fragile for a multi-hour
+run: a backend loss anywhere inside the loop discards everything.  The
+BASELINE.md outage record shows both observed failure signatures this module
+is built against:
+
+* **hard loss** — the tunnel relay dies and every dispatch raises
+  ``XlaRuntimeError: UNAVAILABLE`` (or ``INTERNAL``);
+* **silent hang** — probes block ~25 minutes inside backend init before
+  failing; a bare ``block_until_ready`` would wedge the driver for as long.
+
+:class:`ResilientRunner` trades a sliver of dispatch overhead for
+survivability: generations run as **chunked jitted segments** (each chunk is
+still one compiled ``fori_loop`` program, so per-generation dispatch cost is
+amortized within a chunk), and between chunks the supervisor — plain Python,
+outside XLA — checkpoints atomically, enforces a watchdog deadline, retries
+with exponential backoff, and can fall back to CPU to limp a run to its next
+checkpoint.
+
+The checkpoint layout under ``checkpoint_dir`` is flat::
+
+    ckpt_00000010.npz     # state after 10 completed generations
+    ckpt_00000020.npz     # manifest records generation, versions
+
+Resume scans newest-first and loads the first checkpoint that validates
+against the template state (torn/stale files are skipped with a warning —
+the atomic writer in ``utils/checkpoint.py`` makes torn files unlikely, but
+a resume path that trusts disk blindly would turn one bad file into a lost
+run).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+import time
+import warnings
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Union
+
+import jax
+
+from ..core import State, Workflow
+from ..utils.checkpoint import (
+    CheckpointError,
+    load_state,
+    read_manifest,
+    save_state,
+)
+
+__all__ = [
+    "ResilientRunner",
+    "RetryPolicy",
+    "RunStats",
+    "ResilienceError",
+    "WatchdogTimeout",
+    "default_retryable",
+    "latest_checkpoint",
+]
+
+_CKPT_RE = re.compile(r"ckpt_(\d+)\.npz$")
+
+
+class WatchdogTimeout(RuntimeError):
+    """A segment exceeded the runner's watchdog deadline (the silent-hang
+    outage signature: dispatch blocks in backend init instead of failing)."""
+
+
+class ResilienceError(RuntimeError):
+    """A segment kept failing after the full retry budget (and CPU fallback,
+    if enabled) was exhausted.  ``__cause__`` carries the last failure."""
+
+
+# Substrings of the gRPC/XLA status messages that indicate the *backend* —
+# not the program — failed, and a retry against a recovered backend can
+# succeed.  "INTERNAL" is included because host-callback failures and
+# backend-loss both surface as INTERNAL XlaRuntimeErrors on some paths
+# (BASELINE.md round-4/5 logs show both UNAVAILABLE and INTERNAL from the
+# same outage).
+RETRYABLE_SIGNATURES = (
+    "UNAVAILABLE",
+    "INTERNAL",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "DATA_LOSS",
+    "Connection refused",
+    "Connection reset",
+    "Socket closed",
+    "failed to connect",
+)
+
+# Marker an error message can carry to opt out of retries even when the
+# surrounding transport noise matches a retryable signature (used by
+# fault-injection to simulate genuinely fatal crashes; XLA wraps every host
+# callback failure in an "INTERNAL: CpuCallback error" envelope, so the
+# inner error must be able to overrule the envelope).
+NONRETRYABLE_MARKER = "NONRETRYABLE"
+
+_XlaRuntimeError: type[BaseException]
+try:  # jax >= 0.4.14 exposes the alias; fall back to the jaxlib type.
+    _XlaRuntimeError = jax.errors.JaxRuntimeError  # type: ignore[attr-defined]
+except AttributeError:  # pragma: no cover - very old jax
+    from jaxlib.xla_extension import XlaRuntimeError as _XlaRuntimeError
+
+
+def default_retryable(exc: BaseException) -> bool:
+    """Is this failure worth retrying against a (possibly recovered) backend?
+
+    * :class:`WatchdogTimeout` — always (it is the hang signature).
+    * Errors whose message carries ``NONRETRYABLE`` — never.
+    * ``XlaRuntimeError`` / ``RuntimeError`` whose message matches a known
+      backend-loss signature (``UNAVAILABLE``, ``INTERNAL``, ...) — yes.
+    * Everything else (shape errors, user exceptions, ...) — no: retrying a
+      deterministic program bug burns the budget without hope.
+    """
+    if isinstance(exc, WatchdogTimeout):
+        return True
+    msg = str(exc)
+    if NONRETRYABLE_MARKER in msg:
+        return False
+    if isinstance(exc, (_XlaRuntimeError, RuntimeError)):
+        return any(sig in msg for sig in RETRYABLE_SIGNATURES)
+    return False
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential-backoff retry budget for one segment.
+
+    ``max_retries`` counts *retries* (the first attempt is free); the delay
+    before retry ``k`` (1-based) is ``backoff_base * backoff_factor**(k-1)``
+    capped at ``backoff_max`` seconds.
+    """
+
+    max_retries: int = 3
+    backoff_base: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max: float = 300.0
+    retryable: Callable[[BaseException], bool] = default_retryable
+
+    def delay(self, retry_index: int) -> float:
+        """Backoff delay before 1-based retry ``retry_index``."""
+        return min(
+            self.backoff_base * self.backoff_factor ** (retry_index - 1),
+            self.backoff_max,
+        )
+
+
+@dataclass
+class RunStats:
+    """Observable record of what the supervisor did during :meth:`run`."""
+
+    resumed_from_generation: int | None = None
+    completed_generations: int = 0
+    segments_run: int = 0
+    retries: int = 0
+    watchdog_timeouts: int = 0
+    cpu_fallbacks: int = 0
+    checkpoints_written: int = 0
+    failures: list[str] = field(default_factory=list)
+
+
+def _numbered_checkpoints(
+    checkpoint_dir: Union[str, Path]
+) -> list[tuple[int, Path]]:
+    """All ``ckpt_<generation>.npz`` files in the directory, sorted by
+    generation ascending.  Stray non-numbered files are ignored."""
+    out = []
+    for path in Path(checkpoint_dir).glob("ckpt_*.npz"):
+        m = _CKPT_RE.search(path.name)
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def latest_checkpoint(checkpoint_dir: Union[str, Path]) -> Path | None:
+    """Newest checkpoint file (by generation number) in ``checkpoint_dir``,
+    or ``None``.  Validity is NOT checked — resume logic probes that."""
+    numbered = _numbered_checkpoints(checkpoint_dir)
+    return numbered[-1][1] if numbered else None
+
+
+class ResilientRunner:
+    """Supervises a workflow run: chunked jitted segments + atomic
+    checkpoints + auto-resume + retry/backoff + watchdog + CPU fallback.
+
+    Usage::
+
+        wf = StdWorkflow(PSO(10_000, lb, ub), Ackley(), monitor=EvalMonitor())
+        runner = ResilientRunner(wf, "ckpts/run1", checkpoint_every=50)
+        state = runner.run(wf.init(jax.random.key(0)), n_steps=5_000)
+        # ... process dies at generation 3_217; rerun the same two lines:
+        # the runner resumes from ckpt_00003200.npz instead of restarting.
+
+    Determinism: a resumed (or retried) run is bit-identical to an
+    uninterrupted run of the same runner configuration — PRNG keys live in
+    the checkpointed state, and resume always lands on a segment boundary,
+    so the remaining compiled programs are exactly the ones the
+    uninterrupted run would have executed (tested in
+    ``tests/test_resilience.py``).  Against the single-program
+    ``workflow.run(state, n)`` the trajectory may drift by float
+    reassociation across segment boundaries, exactly like different
+    ``unroll`` factors; the supervisor trades that ulp-level equivalence
+    for survivability.
+
+    Monitor caveat: retries replay the failed chunk from its checkpoint, so
+    a monitor's *host-side history* may contain repeated generation entries
+    after a recovery (in-state metrics — top-k, ``num_nonfinite`` — are part
+    of the checkpoint and stay consistent).  The history entries carry
+    generation tags for dedup; see ``docs/guide/resilience.md``.
+    """
+
+    def __init__(
+        self,
+        workflow: Workflow,
+        checkpoint_dir: Union[str, Path],
+        *,
+        checkpoint_every: int = 10,
+        retry: RetryPolicy | None = None,
+        watchdog_timeout: float | None = None,
+        compile_timeout: float | None = None,
+        cpu_fallback: bool = False,
+        keep_checkpoints: int = 3,
+        on_event: Callable[[str], None] | None = None,
+    ):
+        """
+        :param workflow: any ``Workflow`` whose ``init_step``/``step`` are
+            jittable pure ``state -> state`` functions (``StdWorkflow`` is).
+        :param checkpoint_dir: directory for ``ckpt_<generation>.npz`` files
+            (created if absent).  Point a resumed run at the same directory.
+        :param checkpoint_every: generations per segment; each segment is one
+            compiled ``fori_loop`` program and one checkpoint.  Smaller =
+            less lost work per failure, more dispatch + checkpoint overhead.
+        :param retry: backoff budget per segment (:class:`RetryPolicy`).
+        :param watchdog_timeout: seconds a segment's *execution* (dispatch +
+            ``block_until_ready``) may take before it is abandoned and
+            treated as a retryable failure — set this to catch the
+            silent-hang outage signature.  ``None`` disables the watchdog.
+            Compilation is excluded: segments are AOT-compiled (and cached)
+            before the deadline starts, so a cold multi-minute XLA compile
+            on a healthy backend cannot trip a deadline sized for execution.
+        :param compile_timeout: optional separate deadline (seconds) for the
+            AOT compile of a segment — compiles also block forever on a hung
+            backend (the BASELINE.md probes hung in backend *init*), so a
+            long-running service should set this to its tolerance for
+            compile latency.  ``None`` (default) leaves compiles unguarded.
+        :param cpu_fallback: after the retry budget is exhausted, re-run the
+            segment on the host CPU backend (fresh retry budget) so the run
+            limps to its next checkpoint instead of dying — the in-process
+            equivalent of restarting under ``JAX_PLATFORMS=cpu``, without
+            losing the supervisor (state is ``device_put`` to the CPU
+            backend and programs re-lowered under ``jax.default_device``).
+        :param keep_checkpoints: how many newest checkpoints to retain
+            (older ones are pruned after each successful write); ``0`` keeps
+            everything.
+        :param on_event: optional callback receiving one human-readable line
+            per supervisor event (resume/retry/fallback/checkpoint) —
+            defaults to ``warnings.warn`` for failures and silence for
+            routine events.
+        """
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}"
+            )
+        if keep_checkpoints < 0:
+            raise ValueError(
+                f"keep_checkpoints must be >= 0, got {keep_checkpoints}"
+            )
+        self.workflow = workflow
+        self.checkpoint_dir = Path(checkpoint_dir)
+        self.checkpoint_every = int(checkpoint_every)
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.watchdog_timeout = watchdog_timeout
+        self.compile_timeout = compile_timeout
+        self.cpu_fallback = cpu_fallback
+        self.keep_checkpoints = int(keep_checkpoints)
+        self.on_event = on_event
+        self.stats = RunStats()
+        self._forced_cpu = False
+        # One compiled program per distinct chunk length (at most two: the
+        # steady chunk and the final ragged one).
+        self._jit_init_step = jax.jit(workflow.init_step)
+        self._jit_segment = jax.jit(self._segment, static_argnums=1)
+        # AOT-compiled executables keyed by (program, chunk, backend, state
+        # signature): compiled OUTSIDE the watchdog so cold-compile latency
+        # never counts against the execution deadline.
+        self._exec_cache: dict = {}
+
+    # -- program shapes ----------------------------------------------------
+    def _segment(self, state: State, n: int) -> State:
+        return jax.lax.fori_loop(
+            0, n, lambda _, s: self.workflow.step(s), state
+        )
+
+    # -- events ------------------------------------------------------------
+    def _event(self, msg: str, *, warn: bool = False) -> None:
+        if self.on_event is not None:
+            self.on_event(msg)
+        elif warn:
+            warnings.warn(msg)
+
+    # -- checkpointing -----------------------------------------------------
+    def _ckpt_path(self, generation: int) -> Path:
+        return self.checkpoint_dir / f"ckpt_{generation:08d}.npz"
+
+    def _write_checkpoint(self, state: State, generation: int) -> None:
+        self.checkpoint_dir.mkdir(parents=True, exist_ok=True)
+        save_state(self._ckpt_path(generation), state, generation=generation)
+        self.stats.checkpoints_written += 1
+        self._event(f"checkpoint written at generation {generation}")
+        if self.keep_checkpoints:
+            numbered = _numbered_checkpoints(self.checkpoint_dir)
+            for _, stale in numbered[: -self.keep_checkpoints]:
+                try:
+                    stale.unlink()
+                except OSError:  # pragma: no cover - racing cleaners
+                    pass
+
+    def resume(self, template: State) -> tuple[State, int] | None:
+        """Load the newest checkpoint that validates against ``template``.
+
+        Returns ``(state, completed_generations)`` or ``None`` when no
+        usable checkpoint exists.  Invalid/torn/mismatched files are skipped
+        with a warning, newest-first, so one bad file cannot lose the run.
+        """
+        if not self.checkpoint_dir.is_dir():
+            return None
+        for gen, path in reversed(_numbered_checkpoints(self.checkpoint_dir)):
+            try:
+                manifest = read_manifest(path)
+                if manifest and manifest.get("generation") not in (None, gen):
+                    raise CheckpointError(
+                        f"manifest generation {manifest['generation']} does "
+                        f"not match filename generation {gen}"
+                    )
+                state = load_state(path, template)
+            except (CheckpointError, ValueError) as e:
+                self._event(
+                    f"skipping unusable checkpoint {path.name}: {e}", warn=True
+                )
+                continue
+            self._event(f"resumed from {path.name} (generation {gen})")
+            return state, gen
+        return None
+
+    # -- guarded execution -------------------------------------------------
+    def _cpu_device(self):
+        return jax.local_devices(backend="cpu")[0]
+
+    @staticmethod
+    def _with_deadline(fn: Callable[[], State], timeout: float, what: str) -> State:
+        """Run ``fn()`` in a worker thread and abandon it past ``timeout``.
+
+        A hung dispatch/compile (a ``block_until_ready`` stuck in backend
+        init — the 25-minute BASELINE.md signature) cannot be interrupted,
+        only outwaited: the worker is left to die with its backend and the
+        supervisor proceeds to retry/fallback.
+        """
+        # A daemon thread, NOT a ThreadPoolExecutor: executor threads are
+        # non-daemon and concurrent.futures joins them at interpreter exit,
+        # so an abandoned worker wedged in a 25-minute backend hang would
+        # block process shutdown for the rest of the outage.
+        result: dict = {}
+
+        def target() -> None:
+            try:
+                result["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                result["error"] = e
+
+        worker = threading.Thread(
+            target=target, name="evox-tpu-guard", daemon=True
+        )
+        worker.start()
+        worker.join(timeout)
+        if worker.is_alive():
+            # The worker cannot be interrupted, only abandoned; being a
+            # daemon it dies with the process instead of wedging exit.
+            raise WatchdogTimeout(
+                f"{what} exceeded the {timeout:.1f}s watchdog deadline "
+                f"(hung dispatch — the backend-init hang signature); "
+                f"abandoning the attempt"
+            )
+        if "error" in result:
+            raise result["error"]
+        return result["value"]
+
+    def _abstract_sig(self, state: State):
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        return (
+            treedef,
+            tuple(
+                (getattr(l, "shape", None), str(getattr(l, "dtype", type(l))))
+                for l in leaves
+            ),
+        )
+
+    def _get_executable(
+        self, which: str, state: State, chunk: int | None
+    ) -> Callable[[State], State]:
+        """AOT-compile (once, cached) the program for this segment shape.
+
+        Compiling outside the watchdog keeps cold-compile latency from
+        eating the execution deadline; ``compile_timeout`` (when set) guards
+        the compile itself against a hung backend.
+        """
+        sig = (which, chunk, self._forced_cpu, self._abstract_sig(state))
+        fn = self._exec_cache.get(sig)
+        if fn is not None:
+            return fn
+        if which == "init":
+            traced = self._jit_init_step
+            lower = lambda: self._jit_init_step.lower(state)  # noqa: E731
+        else:
+            traced = lambda s: self._jit_segment(s, chunk)  # noqa: E731
+            lower = lambda: self._jit_segment.lower(state, chunk)  # noqa: E731
+        compile_now = lambda: lower().compile()  # noqa: E731
+        if self.compile_timeout is not None:
+            exe = self._with_deadline(
+                compile_now, self.compile_timeout, f"compile of {which}"
+            )
+        else:
+            exe = compile_now()
+
+        def call(s: State, _exe=exe, _traced=traced, _sig=sig) -> State:
+            try:
+                return _exe(s)
+            except (ValueError, TypeError) as e:
+                # AOT executables are strict about input placement/layout
+                # (e.g. mesh-sharded states); fall back to traced dispatch
+                # for this signature, which re-places inputs as needed.
+                if "sharding" in str(e).lower() or "layout" in str(e).lower():
+                    self._exec_cache[_sig] = _traced
+                    return _traced(s)
+                raise
+
+        self._exec_cache[sig] = call
+        return call
+
+    def _execute_once(
+        self, which: str, state: State, chunk: int | None
+    ) -> State:
+        """One attempt: (cached) AOT compile, then watchdog-guarded
+        execution to completion (``block_until_ready``)."""
+        if self._forced_cpu:
+            state = jax.device_put(state, self._cpu_device())
+            ctx = jax.default_device(self._cpu_device())
+        else:
+            ctx = contextlib.nullcontext()
+        with ctx:
+            exe = self._get_executable(which, state, chunk)
+            run = lambda: jax.block_until_ready(exe(state))  # noqa: E731
+            if self.watchdog_timeout is None:
+                return run()
+            return self._with_deadline(
+                run, self.watchdog_timeout, "segment execution"
+            )
+
+    def _reload_for_retry(self, state: State, generation: int) -> State:
+        """Best source of truth for a retry: the on-disk checkpoint of the
+        segment's input generation (device buffers of ``state`` may belong
+        to a dead backend); falls back to the in-memory state."""
+        path = self._ckpt_path(generation)
+        if path.exists():
+            try:
+                return load_state(path, state)
+            except (CheckpointError, ValueError) as e:  # pragma: no cover
+                self._event(
+                    f"retry reload of {path.name} failed ({e}); "
+                    f"reusing in-memory state",
+                    warn=True,
+                )
+        return state
+
+    def _attempt(
+        self,
+        which: str,
+        state: State,
+        generation: int,
+        desc: str,
+        chunk: int | None = None,
+    ) -> State:
+        """Execute one segment with the full recovery ladder: retries with
+        backoff, then (optionally) a CPU fallback with a fresh budget."""
+        failures = 0
+        while True:
+            try:
+                return self._execute_once(which, state, chunk)
+            except Exception as e:  # noqa: BLE001 - predicate filters below
+                if not self.retry.retryable(e):
+                    raise
+                failures += 1
+                if isinstance(e, WatchdogTimeout):
+                    self.stats.watchdog_timeouts += 1
+                self.stats.failures.append(f"{desc}: {type(e).__name__}: {e}")
+                if failures > self.retry.max_retries:
+                    if self.cpu_fallback and not self._forced_cpu:
+                        self._forced_cpu = True
+                        self.stats.cpu_fallbacks += 1
+                        failures = 0
+                        self._event(
+                            f"{desc}: retry budget exhausted; falling back "
+                            f"to the CPU backend",
+                            warn=True,
+                        )
+                        state = self._reload_for_retry(state, generation)
+                        continue
+                    raise ResilienceError(
+                        f"{desc} failed after {self.retry.max_retries} "
+                        f"retries"
+                        + (" and a CPU fallback" if self._forced_cpu else "")
+                    ) from e
+                delay = self.retry.delay(failures)
+                self.stats.retries += 1
+                self._event(
+                    f"{desc}: attempt {failures} failed "
+                    f"({type(e).__name__}); retrying in {delay:.2f}s",
+                    warn=True,
+                )
+                time.sleep(delay)
+                state = self._reload_for_retry(state, generation)
+
+    # -- the supervisor loop -----------------------------------------------
+    def run(
+        self,
+        state: State,
+        n_steps: int,
+        *,
+        fresh: bool = False,
+    ) -> State:
+        """Run ``n_steps`` total generations (``init_step`` + ``n_steps - 1``
+        ``step``s, matching ``StdWorkflow.run``), surviving backend loss.
+
+        :param state: the initial workflow state — also the *template* a
+            checkpoint must validate against when resuming.
+        :param n_steps: total generations for the whole run (not the
+            remainder): a resumed run passes the same ``n_steps`` and the
+            runner fast-forwards past the completed prefix.
+        :param fresh: start from ``state`` instead of resuming; existing
+            checkpoints in the directory are DELETED first so the new run's
+            lineage cannot interleave with (or resume into) a stale one.
+        :returns: the final state, identical to what an uninterrupted
+            ``workflow.run(state, n_steps)`` would have produced.
+        """
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        self.stats = RunStats()
+        # A previous run's CPU fallback must not pin THIS run to the CPU
+        # backend: give the (possibly recovered) accelerator a fresh chance.
+        self._forced_cpu = False
+        done = 0
+        if fresh and self.checkpoint_dir.is_dir():
+            # Clear the old lineage: stale higher-generation files would
+            # otherwise survive pruning (which keeps the N highest numbers)
+            # and hijack the next resume.
+            for _, path in _numbered_checkpoints(self.checkpoint_dir):
+                try:
+                    path.unlink()
+                except OSError:  # pragma: no cover - racing cleaners
+                    pass
+        if not fresh:
+            resumed = self.resume(state)
+            if resumed is not None:
+                state, done = resumed
+                if done > n_steps:
+                    raise ValueError(
+                        f"checkpoint at generation {done} is beyond "
+                        f"n_steps={n_steps}; pass fresh=True to restart or "
+                        f"point at a different checkpoint_dir"
+                    )
+                self.stats.resumed_from_generation = done
+                self.stats.completed_generations = done
+        if done == 0:
+            state = self._attempt(
+                "init", state, 0, "init_step (generation 1)"
+            )
+            done = 1
+            self.stats.segments_run += 1
+            self.stats.completed_generations = done
+            self._write_checkpoint(state, done)
+        while done < n_steps:
+            chunk = min(self.checkpoint_every, n_steps - done)
+            state = self._attempt(
+                "segment",
+                state,
+                done,
+                f"segment (generations {done + 1}..{done + chunk})",
+                chunk=chunk,
+            )
+            done += chunk
+            self.stats.segments_run += 1
+            self.stats.completed_generations = done
+            self._write_checkpoint(state, done)
+        return state

@@ -19,7 +19,7 @@ from .ops import (
     randint,
     switch,
 )
-from .checkpoint import load_state, save_state
+from .checkpoint import CheckpointError, load_state, read_manifest, save_state
 from .params_vector import ParamsAndVector
 from .vmap_ops import VmapInfo, host_op, register_vmap_op
 
@@ -42,6 +42,8 @@ __all__ = [
     "ParamsAndVector",
     "save_state",
     "load_state",
+    "read_manifest",
+    "CheckpointError",
     "register_vmap_op",
     "host_op",
     "VmapInfo",

@@ -9,6 +9,16 @@ leaves keyed by path.
 
 :func:`save_state` / :func:`load_state` write/read a single ``.npz`` file —
 dependency-free, host-portable, and exact (bit-identical resume is tested).
+Writes are **atomic**: the archive is written to a temp file in the target
+directory and ``os.replace``-d into place, so a crash mid-write (the
+BASELINE.md outage scenario: the TPU tunnel dying under a long-running
+sweep) can never leave a torn half-checkpoint where a valid one is
+expected — the file either has the old complete contents or the new ones.
+Every checkpoint carries a ``__manifest__`` entry (JSON: generation number,
+library/jax versions, leaf count, wall-clock) so resume logic can pick the
+newest valid checkpoint without deserializing the whole state; read it with
+:func:`read_manifest`.
+
 For sharded multi-host state, prefer ``orbax.checkpoint`` with the same
 pytree (it handles per-shard async writes); these helpers cover the
 single-host case and small HPO/monitor states.
@@ -16,13 +26,28 @@ single-host case and small HPO/monitor states.
 
 from __future__ import annotations
 
+import json
+import os
+import tempfile
+import time
+import warnings
 from pathlib import Path
 from typing import Any, Union
 
 import jax
 import numpy as np
 
-__all__ = ["save_state", "load_state"]
+__all__ = ["save_state", "load_state", "read_manifest", "CheckpointError"]
+
+MANIFEST_KEY = "__manifest__"
+
+
+class CheckpointError(ValueError):
+    """A checkpoint exists but cannot be loaded into the requested template
+    (missing leaf, shape mismatch, incompatible dtype, or corrupt archive).
+
+    Subclasses :class:`ValueError` so callers validating user-supplied
+    checkpoint paths can catch it generically."""
 
 
 def _path_str(key_path) -> str:
@@ -37,11 +62,28 @@ def _path_str(key_path) -> str:
     return "/".join(parts)
 
 
-def save_state(path: Union[str, Path], state: Any) -> None:
+def save_state(
+    path: Union[str, Path],
+    state: Any,
+    *,
+    generation: int | None = None,
+    metadata: dict[str, Any] | None = None,
+) -> Path:
     """Save a (nested) State / pytree of arrays to ``path`` as ``.npz``.
 
     PRNG-key arrays are stored via their raw ``uint32`` key data, so the
-    random stream resumes exactly."""
+    random stream resumes exactly.  The write is atomic (temp file +
+    ``os.replace``); a suffix-less ``path`` gains ``.npz``, mirroring
+    ``np.savez``.  Returns the final path written.
+
+    :param generation: optional generation number recorded in the manifest
+        (used by :class:`~evox_tpu.resilience.ResilientRunner` to pick the
+        resume point without loading the state).
+    :param metadata: optional extra JSON-serializable manifest entries.
+    """
+    path = Path(path)
+    if path.suffix != ".npz":
+        path = path.with_name(path.name + ".npz")
     leaves_with_paths, _ = jax.tree_util.tree_flatten_with_path(state)
     out = {}
     for key_path, leaf in leaves_with_paths:
@@ -53,7 +95,73 @@ def save_state(path: Union[str, Path], state: Any) -> None:
             out["__key__/" + name] = np.asarray(jax.random.key_data(arr))
         else:
             out[name] = np.asarray(arr)
-    np.savez(path, **out)
+    manifest = {
+        "format": 1,
+        "generation": None if generation is None else int(generation),
+        "evox_tpu_version": _library_version(),
+        "jax_version": jax.__version__,
+        "n_leaves": len(out),
+        "written_at": time.time(),
+    }
+    if metadata:
+        manifest.update(metadata)
+    out[MANIFEST_KEY] = np.array(json.dumps(manifest))
+    # Atomic publish: write the full archive to a temp file in the SAME
+    # directory (os.replace across filesystems is not atomic), then rename.
+    fd, tmp = tempfile.mkstemp(
+        dir=path.parent or Path("."), prefix=path.name + ".tmp."
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **out)
+        os.replace(tmp, path)
+    except BaseException:
+        # Leave no temp litter on failure; the destination is untouched.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def _library_version() -> str:
+    try:
+        import evox_tpu
+
+        return evox_tpu.__version__
+    except Exception:  # pragma: no cover - import cycle / stripped install
+        return "unknown"
+
+
+def _resolve(path: Union[str, Path]) -> Path:
+    # ``np.savez`` (and save_state above) appends ``.npz`` to suffix-less
+    # paths, so accept the same path string save_state() was given.
+    path = Path(path)
+    if not path.exists():
+        alt = path.with_name(path.name + ".npz")
+        if alt.exists():
+            return alt
+    return path
+
+
+def read_manifest(path: Union[str, Path]) -> dict[str, Any] | None:
+    """Read the ``__manifest__`` entry of a checkpoint written by
+    :func:`save_state`.  Returns ``None`` for pre-manifest checkpoints;
+    raises :class:`CheckpointError` if the archive itself is unreadable
+    (truncated / torn file — the signature a non-atomic writer would leave)."""
+    path = _resolve(path)
+    try:
+        with np.load(path) as data:
+            if MANIFEST_KEY not in data:
+                return None
+            return json.loads(str(data[MANIFEST_KEY]))
+    except (CheckpointError, FileNotFoundError):
+        # A missing file is "no checkpoint", not a corrupt one — keep the
+        # natural `except FileNotFoundError: start_fresh()` idiom working.
+        raise
+    except Exception as e:
+        raise CheckpointError(f"checkpoint {path} is unreadable: {e!r}") from e
 
 
 def load_state(
@@ -63,19 +171,28 @@ def load_state(
     ``like`` (a template state with the same shape — e.g. a freshly
     ``setup()`` state).  Returns a new pytree; ``like`` is unchanged.
 
+    Every mismatch raises a :class:`CheckpointError` (a ``ValueError``)
+    naming the offending leaf path and the expected vs. stored shape/dtype —
+    never a raw ``KeyError`` or a downstream shape blow-up:
+
+    * a leaf missing from the checkpoint (unless ``allow_missing``);
+    * a shape mismatch between the stored array and the template leaf;
+    * a dtype mismatch that cannot be cast safely (``same_kind``: width
+      changes like ``float64 -> float32`` from an x64-enabled writer are
+      tolerated and cast; kind changes like ``float -> int`` are not).
+
     :param allow_missing: state schemas can gain leaves between versions
         (e.g. a monitor adding a counter).  With ``allow_missing=True`` a
         leaf absent from the checkpoint keeps the template's value (with a
-        warning) instead of raising ``KeyError``.
+        warning) instead of raising.
     """
-    import os
-    import warnings
-
-    # ``np.savez`` silently appends ``.npz`` to suffix-less paths, so accept
-    # the same path string save_state() was given.
-    if not os.path.exists(path) and os.path.exists(f"{path}.npz"):
-        path = f"{path}.npz"
-    data = np.load(path)
+    path = _resolve(path)
+    try:
+        data = np.load(path)
+    except FileNotFoundError:
+        raise  # absent, not corrupt — see read_manifest
+    except Exception as e:
+        raise CheckpointError(f"checkpoint {path} is unreadable: {e!r}") from e
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
     new_leaves = []
     for key_path, leaf in leaves_with_paths:
@@ -83,10 +200,36 @@ def load_state(
         if "__key__/" + name in data:
             raw = data["__key__/" + name]
             impl = jax.random.key_impl(leaf)
-            new_leaves.append(jax.random.wrap_key_data(raw, impl=impl))
+            try:
+                restored = jax.random.wrap_key_data(raw, impl=impl)
+            except Exception as e:
+                raise CheckpointError(
+                    f"checkpoint {path}: PRNG-key leaf {name!r} has stored "
+                    f"key data of shape {raw.shape}, incompatible with the "
+                    f"template's {impl} impl: {e}"
+                ) from e
+            if restored.shape != leaf.shape:
+                raise CheckpointError(
+                    f"checkpoint {path}: PRNG-key leaf {name!r} has shape "
+                    f"{restored.shape}, but the template expects {leaf.shape}"
+                )
+            new_leaves.append(restored)
         elif name in data:
             arr = data[name]
-            if hasattr(leaf, "dtype"):
+            if hasattr(leaf, "shape") and tuple(arr.shape) != tuple(leaf.shape):
+                raise CheckpointError(
+                    f"checkpoint {path}: leaf {name!r} has shape "
+                    f"{tuple(arr.shape)}, but the template expects "
+                    f"{tuple(leaf.shape)} — was it written with a different "
+                    f"pop size / dim / config?"
+                )
+            if hasattr(leaf, "dtype") and arr.dtype != leaf.dtype:
+                if not np.can_cast(arr.dtype, leaf.dtype, casting="same_kind"):
+                    raise CheckpointError(
+                        f"checkpoint {path}: leaf {name!r} has dtype "
+                        f"{arr.dtype}, which cannot be safely cast to the "
+                        f"template's {leaf.dtype}"
+                    )
                 arr = arr.astype(leaf.dtype)
             new_leaves.append(jax.numpy.asarray(arr))
         elif allow_missing:
@@ -96,7 +239,7 @@ def load_state(
             )
             new_leaves.append(leaf)
         else:
-            raise KeyError(
+            raise CheckpointError(
                 f"checkpoint {path} has no entry for state leaf {name!r} "
                 f"(pass allow_missing=True to keep the template value for "
                 f"leaves added since the checkpoint was written)"

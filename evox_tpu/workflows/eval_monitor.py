@@ -120,6 +120,10 @@ class EvalMonitor(Monitor):
             # Instance label for history tagging; assigned by
             # ``StdWorkflow.setup(key, instance_id=...)`` when vmapping.
             instance_id=jnp.int32(-1),
+            # Cumulative count of individuals whose fitness came back
+            # non-finite and was quarantined by the workflow
+            # (``StdWorkflow(quarantine_nonfinite=True)``).
+            num_nonfinite=jnp.int32(0),
         )
 
     # -- host side channel --------------------------------------------------
@@ -133,9 +137,19 @@ class EvalMonitor(Monitor):
                 (int(gen), int(inst), slot, np.asarray(x))
             )
 
+        # An ordered callback runs on a single device by construction; pin
+        # its sharding explicitly — without the pin, XLA's SPMD sharding
+        # propagation hard-aborts (Check failed, jax 0.4.x) when the callback
+        # custom-call shares a program with shard_map partitioning (the
+        # distributed fused-run path).
+        kwargs = {}
+        if self.ordered:
+            kwargs["sharding"] = jax.sharding.SingleDeviceSharding(
+                jax.local_devices()[0]
+            )
         io_callback(
             append, None, data, state.generation, state.instance_id,
-            ordered=self.ordered,
+            ordered=self.ordered, **kwargs,
         )
 
     # -- hooks --------------------------------------------------------------
@@ -150,7 +164,12 @@ class EvalMonitor(Monitor):
             # Single-objective: maintain running top-k. The first call (empty
             # placeholder state) and later calls are separate traces, so the
             # shape switch below is a static Python branch.
-            assert fitness.shape[0] >= self.topk
+            if fitness.shape[0] < self.topk:
+                raise ValueError(
+                    f"EvalMonitor(topk={self.topk}) needs at least topk "
+                    f"fitness values per generation, got a population of "
+                    f"{fitness.shape[0]}"
+                )
             if state.topk_solutions.ndim <= 1:
                 cand_solutions = state.latest_solution
                 cand_fitness = fitness
@@ -183,6 +202,20 @@ class EvalMonitor(Monitor):
         if self.full_fit_history:
             self._sink(state.latest_fitness, HistoryType.FITNESS, state)
         return state
+
+    def record_nonfinite(self, state: State, mask: jax.Array) -> State:
+        """Count quarantined individuals (non-finite fitness rows replaced
+        by the workflow's worst-case penalty) into the cumulative
+        ``num_nonfinite`` metric.  ``mask`` is the per-individual boolean
+        quarantine mask for this evaluation."""
+        if "num_nonfinite" not in state:
+            # States restored from pre-metric checkpoints (allow_missing
+            # pathways) or handed in by custom setups may lack the counter.
+            return state
+        return state.replace(
+            num_nonfinite=state.num_nonfinite
+            + jnp.sum(mask, dtype=jnp.int32)
+        )
 
     def record_auxiliary(self, state: State, aux: dict[str, jax.Array]) -> State:
         if self.full_pop_history:
@@ -280,6 +313,12 @@ class EvalMonitor(Monitor):
     def get_latest_solution(self, state: State) -> jax.Array:
         """Population of the latest generation (pre-transform solutions)."""
         return state.latest_solution
+
+    def get_num_nonfinite(self, state: State) -> jax.Array:
+        """Cumulative count of individuals quarantined for non-finite
+        fitness (requires ``StdWorkflow(quarantine_nonfinite=True)``, the
+        default)."""
+        return state.num_nonfinite
 
     def get_topk_fitness(self, state: State) -> jax.Array:
         """Best ``topk`` fitness values so far (original sign restored)."""

@@ -59,6 +59,8 @@ class StdWorkflow(Workflow):
         enable_distributed: bool = False,
         mesh: Mesh | None = None,
         pop_axis: str = "pop",
+        quarantine_nonfinite: bool = True,
+        nonfinite_penalty: float = 1e30,
     ):
         """
         :param opt_direction: ``"min"`` or ``"max"``; for ``"max"`` fitness is
@@ -68,10 +70,25 @@ class StdWorkflow(Workflow):
             ``pop_axis`` via ``shard_map`` + ICI all-gather.
         :param mesh: the device mesh to shard over; defaults to a 1-D mesh of
             all local devices when ``enable_distributed`` is set.
+        :param quarantine_nonfinite: replace NaN/±Inf fitness values with a
+            worst-case penalty inside the jitted step, so ``argmin``/ranking
+            and the monitor's top-k never silently propagate NaN (NaN
+            compares false with everything, which can make a diverged
+            individual the "best" or freeze elite selection).  Quarantined
+            individuals are reported to ``Monitor.record_nonfinite`` —
+            ``EvalMonitor`` counts them in its ``num_nonfinite`` metric.
+            Opt out (``False``) if your problem uses non-finite fitness as
+            in-band signaling.
+        :param nonfinite_penalty: magnitude of the penalty substituted for
+            non-finite values (sign follows ``opt_direction`` so the
+            quarantined individual is always the *worst*; clamped to the
+            fitness dtype's finite range).
         """
-        assert opt_direction in ("min", "max"), (
-            f"Expect optimization direction to be `min` or `max`, got {opt_direction}"
-        )
+        if opt_direction not in ("min", "max"):
+            raise ValueError(
+                f"Expect optimization direction to be `min` or `max`, got "
+                f"{opt_direction!r}"
+            )
         self.opt_direction = 1 if opt_direction == "min" else -1
         self.algorithm = algorithm
         self.problem = problem
@@ -80,6 +97,8 @@ class StdWorkflow(Workflow):
             monitor.set_config(opt_direction=self.opt_direction)
         self.solution_transform = solution_transform
         self.fitness_transform = fitness_transform
+        self.quarantine_nonfinite = quarantine_nonfinite
+        self.nonfinite_penalty = float(nonfinite_penalty)
         self.enable_distributed = enable_distributed
         if enable_distributed and mesh is None:
             mesh = Mesh(jax.devices(), (pop_axis,))
@@ -100,6 +119,17 @@ class StdWorkflow(Workflow):
 
             if not isinstance(self.problem, ShardedProblem):
                 self.problem = ShardedProblem(self.problem, mesh, pop_axis)
+        # Sharded programs must use UNORDERED monitor callbacks: an ordered
+        # io_callback threads a token through the entry computation, and on
+        # jax 0.4.x XLA's SPMD sharding-propagation options are sized without
+        # the token parameter — the compiler hard-aborts (Check failed:
+        # sharding_propagation.cc) instead of erroring.  The monitor's
+        # history accessors re-sort by the (generation, instance) tags every
+        # payload carries, so accessor semantics are unchanged.
+        from ..parallel import ShardedProblem as _SP
+
+        if isinstance(self.problem, _SP) and getattr(self.monitor, "ordered", False):
+            self.monitor.set_config(ordered=False)
 
     # -- state -------------------------------------------------------------
     def setup(self, key: jax.Array, instance_id: jax.Array | None = None) -> State:
@@ -171,6 +201,7 @@ class StdWorkflow(Workflow):
                 pop = self.solution_transform(pop)
             mon = self.monitor.pre_eval(mon, pop)
             fit, carrier["problem"] = self._problem_eval(carrier["problem"], pop)
+            fit, mon = self._quarantine(fit, mon)
             mon = self.monitor.post_eval(mon, fit)
             if self.opt_direction == -1:
                 fit = -fit
@@ -180,6 +211,32 @@ class StdWorkflow(Workflow):
             return fit
 
         return evaluate
+
+    def _quarantine(self, fit: jax.Array, mon: State) -> tuple[jax.Array, State]:
+        """Replace non-finite fitness with a worst-case penalty (sign chosen
+        so the quarantined individual loses under the configured direction)
+        and report the per-individual mask to the monitor.  Pure/jittable;
+        a no-op when disabled or for non-floating fitness dtypes."""
+        if not self.quarantine_nonfinite or not jnp.issubdtype(
+            fit.dtype, jnp.floating
+        ):
+            return fit, mon
+        # Clamp the penalty into the dtype's finite range: 1e30 would itself
+        # round to inf in float16/bfloat16 fitness, defeating the quarantine.
+        penalty = min(self.nonfinite_penalty, float(jnp.finfo(fit.dtype).max))
+        bad = ~jnp.isfinite(fit)
+        row_bad = bad if fit.ndim == 1 else jnp.any(bad, axis=-1)
+        mon = self.monitor.record_nonfinite(mon, row_bad)
+        # Demote the WHOLE individual, not just its non-finite components:
+        # a multi-objective row like (NaN, 0.001) patched elementwise would
+        # keep a competitive finite objective and could stay non-dominated.
+        # Raw-frame worst: for "max" the raw penalty is -|p|, which the
+        # direction flip below turns into +|p| in the minimizing frame.
+        row_mask = row_bad if fit.ndim == 1 else row_bad[:, None]
+        fit = jnp.where(
+            row_mask, jnp.asarray(self.opt_direction * penalty, fit.dtype), fit
+        )
+        return fit, mon
 
     # -- stepping ----------------------------------------------------------
     def _step(self, state: State, which: str) -> State:

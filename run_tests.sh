@@ -4,13 +4,23 @@
 #
 #   ./run_tests.sh              fast lane (deselects @pytest.mark.slow)
 #   ./run_tests.sh --all        everything, incl. the convergence-quality lane
+#   ./run_tests.sh --faults     fault-injection smoke lane (resilience layer:
+#                               retry/backoff, watchdog, kill-and-resume, NaN
+#                               quarantine — all CPU, a few seconds)
+#   ./run_tests.sh --lint       repo lints (bare-assert ratchet)
 #   ./run_tests.sh <pytest args>   passthrough
+if [ "$1" = "--lint" ]; then
+  exec python tools/lint_asserts.py
+fi
 ARGS=()
 if [ $# -eq 0 ]; then
   ARGS=(tests/ -q -m "not slow")
 elif [ "$1" = "--all" ]; then
   shift
   ARGS=(tests/ -q "$@")
+elif [ "$1" = "--faults" ]; then
+  shift
+  ARGS=(tests/test_resilience.py tests/test_tooling.py -q "$@")
 else
   ARGS=("$@")
 fi

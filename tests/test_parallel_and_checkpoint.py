@@ -67,14 +67,13 @@ def test_sharded_problem_in_workflow(key):
 
 
 def test_sharded_problem_divisibility(key):
+    # ValueError (not assert: asserts vanish under `python -O`) carrying the
+    # actual pop size and mesh shape so the config is fixable from the message.
     mesh = make_pop_mesh()
     sharded = ShardedProblem(Sphere(), mesh)
     pop = jnp.zeros((10, DIM))  # 10 not divisible by 8
-    try:
+    with pytest.raises(ValueError, match="10 must divide.*8-way"):
         sharded.evaluate(State(), pop)
-        assert False, "expected divisibility assertion"
-    except AssertionError as e:
-        assert "divide" in str(e)
 
 
 def test_sharded_nsga2_with_monitor_matches_local(key):
@@ -237,14 +236,66 @@ def test_checkpoint_suffixless_path_round_trips(tmp_path, key):
 
 
 def test_checkpoint_missing_leaf_raises(tmp_path, key):
+    # A clear ValueError naming the missing leaf, not a raw KeyError.
     state = State(a=jnp.zeros(3))
     save_state(tmp_path / "s.npz", state)
     bigger = State(a=jnp.zeros(3), b=jnp.ones(2))
-    try:
+    with pytest.raises(ValueError, match="no entry for state leaf 'b'"):
         load_state(tmp_path / "s.npz", bigger)
-        assert False, "expected KeyError"
-    except KeyError:
-        pass
+
+
+def test_checkpoint_shape_mismatch_raises(tmp_path, key):
+    state = State(a=jnp.zeros(3))
+    save_state(tmp_path / "s.npz", state)
+    with pytest.raises(ValueError, match=r"leaf 'a' has shape \(3,\)"):
+        load_state(tmp_path / "s.npz", State(a=jnp.zeros(4)))
+
+
+def test_checkpoint_dtype_kind_mismatch_raises(tmp_path, key):
+    state = State(a=jnp.zeros(3, dtype=jnp.float32))
+    save_state(tmp_path / "s.npz", state)
+    # Width changes cast silently (x64-writer portability)...
+    restored = load_state(tmp_path / "s.npz", State(a=jnp.zeros(3, jnp.float16)))
+    assert restored.a.dtype == jnp.float16
+    # ...kind changes do not.
+    with pytest.raises(ValueError, match="cannot be safely cast"):
+        load_state(tmp_path / "s.npz", State(a=jnp.zeros(3, jnp.int32)))
+
+
+def test_checkpoint_manifest_round_trip(tmp_path, key):
+    from evox_tpu.utils import read_manifest
+
+    state = State(a=jnp.zeros(3))
+    written = save_state(tmp_path / "s.npz", state, generation=17)
+    man = read_manifest(written)
+    assert man["generation"] == 17
+    assert man["format"] == 1
+    assert "evox_tpu_version" in man and "jax_version" in man
+
+
+def test_checkpoint_atomic_write_replaces(tmp_path, key):
+    # Overwriting an existing checkpoint goes through temp+os.replace: the
+    # destination is never a torn file, and no temp litter remains.
+    path = tmp_path / "s.npz"
+    save_state(path, State(a=jnp.zeros(3)), generation=1)
+    save_state(path, State(a=jnp.ones(3)), generation=2)
+    from evox_tpu.utils import read_manifest
+
+    assert read_manifest(path)["generation"] == 2
+    restored = load_state(path, State(a=jnp.zeros(3)))
+    np.testing.assert_array_equal(np.asarray(restored.a), np.ones(3))
+    assert [p.name for p in tmp_path.iterdir()] == ["s.npz"]
+
+
+def test_checkpoint_truncated_file_raises_checkpoint_error(tmp_path, key):
+    from evox_tpu.utils import CheckpointError, read_manifest
+
+    path = save_state(tmp_path / "s.npz", State(a=jnp.zeros(3)))
+    path.write_bytes(path.read_bytes()[:20])  # torn write simulation
+    with pytest.raises(CheckpointError, match="unreadable"):
+        read_manifest(path)
+    with pytest.raises(CheckpointError, match="unreadable"):
+        load_state(path, State(a=jnp.zeros(3)))
 
 
 def test_checkpoint_allow_missing_keeps_template(tmp_path, key):

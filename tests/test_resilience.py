@@ -1,0 +1,477 @@
+"""Resilience layer: checkpointed run supervisor, backend-failure retry,
+watchdog, CPU fallback, and non-finite fitness quarantine.
+
+Everything here runs on CPU via deterministic fault injection
+(``resilience/faults.py``): host exceptions arrive wrapped in the same
+``XlaRuntimeError: INTERNAL: CpuCallback error`` envelope a real backend
+loss produces, so the retry predicate is exercised against production-shaped
+errors (the BASELINE.md outage signatures).
+
+Bit-identity methodology: comparators share the faulted run's *program
+structure* (same ``FaultyProblem`` schedule with ``*_times=0``) because XLA
+fusion — and therefore ulp-level floats — can differ between programs with
+and without the host-callback op.  See ``FaultyProblem``'s docstring.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.algorithms import PSO
+from evox_tpu.core import State
+from evox_tpu.problems.numerical import Sphere
+from evox_tpu.resilience import (
+    FaultyProblem,
+    InjectedBackendError,
+    ResilienceError,
+    ResilientRunner,
+    RetryPolicy,
+    WatchdogTimeout,
+    default_retryable,
+    latest_checkpoint,
+)
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+DIM = 8
+LB = -10.0 * jnp.ones(DIM)
+UB = 10.0 * jnp.ones(DIM)
+FAST_RETRY = dict(max_retries=3, backoff_base=0.01, backoff_factor=1.0)
+
+
+def _flat(state):
+    """State leaves as comparable numpy arrays (PRNG keys via key data)."""
+    out = []
+    for leaf in jax.tree_util.tree_leaves(state):
+        if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key
+        ):
+            out.append(np.asarray(jax.random.key_data(leaf)))
+        else:
+            out.append(np.asarray(leaf))
+    return out
+
+
+def _assert_states_identical(a, b):
+    la, lb = _flat(a), _flat(b)
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(x, y, err_msg=f"state leaf {i}")
+
+
+def _wf(problem, **kwargs):
+    return StdWorkflow(PSO(16, LB, UB), problem, **kwargs)
+
+
+# -- supervisor basics ------------------------------------------------------
+
+
+def test_runner_clean_run_writes_and_prunes_checkpoints(tmp_path, key):
+    wf = _wf(Sphere())
+    runner = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=3, keep_checkpoints=2
+    )
+    state = runner.run(wf.init(key), 10)
+    assert jnp.all(jnp.isfinite(state.algorithm.fit))
+    assert runner.stats.completed_generations == 10
+    assert runner.stats.retries == 0
+    # Boundaries: 1, 4, 7, 10 -> 4 writes, pruned to the newest 2.
+    assert runner.stats.checkpoints_written == 4
+    names = sorted(p.name for p in (tmp_path / "ck").glob("ckpt_*.npz"))
+    assert names == ["ckpt_00000007.npz", "ckpt_00000010.npz"]
+    assert latest_checkpoint(tmp_path / "ck").name == "ckpt_00000010.npz"
+
+
+def test_runner_input_validation(tmp_path, key):
+    wf = _wf(Sphere())
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        ResilientRunner(wf, tmp_path, checkpoint_every=0)
+    runner = ResilientRunner(wf, tmp_path / "ck")
+    with pytest.raises(ValueError, match="n_steps"):
+        runner.run(wf.init(key), 0)
+
+
+def test_kill_and_resume_bit_identical(tmp_path, key):
+    """Acceptance: a run killed at an arbitrary generation and resumed from
+    checkpoint finishes bit-identical (PRNG streams included) to an
+    uninterrupted run of the same configuration."""
+    n_steps = 12
+    schedule = dict(fatal_generations=[7], fatal_times=1)
+
+    # Uninterrupted comparator: same program structure, fault disarmed.
+    clean_prob = FaultyProblem(Sphere(), **dict(schedule, fatal_times=0))
+    clean_wf = _wf(clean_prob)
+    clean_runner = ResilientRunner(clean_wf, tmp_path / "clean", checkpoint_every=3)
+    clean_final = clean_runner.run(clean_wf.init(key), n_steps)
+
+    # Interrupted run: a NONRETRYABLE fault at evaluation 7 (inside the
+    # segment for generations 8..10) kills the supervisor mid-run.
+    prob = FaultyProblem(Sphere(), **schedule)
+    wf = _wf(prob)
+    runner = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=3, retry=RetryPolicy(**FAST_RETRY)
+    )
+    with pytest.raises(Exception, match="NONRETRYABLE"):
+        runner.run(wf.init(key), n_steps)
+    assert runner.stats.completed_generations == 7
+    assert runner.stats.retries == 0  # fatal means fatal: no retry burned
+
+    # Resume: same workflow (the outage has passed), a fresh runner, and a
+    # deliberately different init key — the state must come from disk.
+    resumed_runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    final = resumed_runner.run(wf.init(jax.random.key(999)), n_steps)
+    assert resumed_runner.stats.resumed_from_generation == 7
+    _assert_states_identical(final, clean_final)
+
+
+def test_resume_skips_torn_checkpoint(tmp_path, key):
+    """One corrupt (torn) newest file must not lose the run: resume falls
+    back to the previous valid checkpoint."""
+    wf = _wf(Sphere())
+    runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    runner.run(wf.init(key), 10)
+    newest = latest_checkpoint(tmp_path / "ck")
+    newest.write_bytes(newest.read_bytes()[:64])  # tear it
+    resumed = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    with pytest.warns(UserWarning, match="unusable checkpoint"):
+        out = resumed.resume(wf.init(key))
+    assert out is not None
+    _, gen = out
+    assert gen == 7
+
+
+def test_resume_beyond_n_steps_raises(tmp_path, key):
+    wf = _wf(Sphere())
+    runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=2)
+    runner.run(wf.init(key), 6)
+    again = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=2)
+    with pytest.raises(ValueError, match="beyond"):
+        again.run(wf.init(key), 4)
+
+
+def test_cpu_fallback_resets_between_runs(tmp_path, key):
+    """A CPU fallback in one run() must not pin the next run() to CPU."""
+    prob = FaultyProblem(Sphere(), error_generations=[3], error_times=2)
+    wf = _wf(prob)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=4,
+        cpu_fallback=True,
+        retry=RetryPolicy(max_retries=1, backoff_base=0.01),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        runner.run(wf.init(key), 8)
+    assert runner._forced_cpu  # fell back during the run...
+    runner.run(wf.init(key), 8, fresh=True)  # outage over (times consumed)
+    assert not runner._forced_cpu  # ...but the next run retried the backend
+
+
+def test_checkpoint_missing_file_raises_file_not_found(tmp_path, key):
+    """An absent path is 'no checkpoint', not a corrupt one: the natural
+    `except FileNotFoundError: start_fresh()` idiom must keep working."""
+    from evox_tpu.utils import load_state, read_manifest
+
+    with pytest.raises(FileNotFoundError):
+        load_state(tmp_path / "nope.npz", State(a=jnp.zeros(3)))
+    with pytest.raises(FileNotFoundError):
+        read_manifest(tmp_path / "nope.npz")
+
+
+def test_pruning_ignores_stray_files(tmp_path, key):
+    """A benign non-numbered ckpt_*.npz in the directory must not crash the
+    pruning pass after a successful segment."""
+    wf = _wf(Sphere())
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    (ckdir / "ckpt_backup.npz").write_bytes(b"not a checkpoint")
+    runner = ResilientRunner(wf, ckdir, checkpoint_every=3, keep_checkpoints=2)
+    state = runner.run(wf.init(key), 7)
+    assert runner.stats.completed_generations == 7
+    assert (ckdir / "ckpt_backup.npz").exists()  # strays are left alone
+
+
+def test_fresh_run_clears_stale_checkpoint_lineage(tmp_path, key):
+    """fresh=True in a reused directory removes the old lineage: the fresh
+    run's own checkpoints survive pruning, and a later resume loads the
+    fresh run — not a stale higher-generation checkpoint."""
+    wf = _wf(Sphere())
+    runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3,
+                             keep_checkpoints=3)
+    runner.run(wf.init(key), 12)  # old lineage up to generation 12
+    again = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3,
+                            keep_checkpoints=3)
+    final = again.run(wf.init(key), 7, fresh=True)
+    assert again.stats.resumed_from_generation is None
+    assert latest_checkpoint(tmp_path / "ck").name == "ckpt_00000007.npz"
+    # And the directory now resumes into the fresh lineage.
+    third = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    out = third.resume(wf.init(key))
+    assert out is not None and out[1] == 7
+    _assert_states_identical(out[0], final)
+
+
+def test_watchdog_worker_threads_are_daemon(tmp_path, key):
+    """Abandoned watchdog workers must be daemon threads: non-daemon ones
+    are joined at interpreter exit, wedging shutdown for as long as the
+    backend hang lasts."""
+    import threading
+    import time as _time
+
+    with pytest.raises(WatchdogTimeout):
+        ResilientRunner._with_deadline(
+            lambda: _time.sleep(3.0), 0.1, "probe"
+        )
+    guards = [t for t in threading.enumerate() if t.name == "evox-tpu-guard"]
+    assert guards and all(t.daemon for t in guards)
+
+
+# -- retry / backoff --------------------------------------------------------
+
+
+def test_retry_backoff_recovers_and_matches_clean_run(tmp_path, key):
+    """Acceptance: injected UNAVAILABLE-style errors are retried with
+    backoff and the run completes — bit-identical to the never-faulted run."""
+    schedule = dict(error_generations=[6], error_times=2)
+    prob = FaultyProblem(Sphere(), **schedule)
+    wf = _wf(prob)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=4,
+        retry=RetryPolicy(**FAST_RETRY),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        final = runner.run(wf.init(key), 10)
+    assert runner.stats.completed_generations == 10
+    assert runner.stats.retries == 2
+    assert prob.attempts("error", 6) == 3  # 1 failure-free pass after 2 hits
+
+    clean_prob = FaultyProblem(Sphere(), **dict(schedule, error_times=0))
+    clean_wf = _wf(clean_prob)
+    clean = ResilientRunner(clean_wf, tmp_path / "clean", checkpoint_every=4)
+    _assert_states_identical(final, clean.run(clean_wf.init(key), 10))
+
+
+def test_retry_budget_exhaustion_raises_resilience_error(tmp_path, key):
+    prob = FaultyProblem(Sphere(), error_generations=[2], error_times=99)
+    wf = _wf(prob)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=4,
+        retry=RetryPolicy(max_retries=2, backoff_base=0.01),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with pytest.raises(ResilienceError, match="after 2 retries") as exc_info:
+            runner.run(wf.init(key), 8)
+    assert runner.stats.retries == 2
+    assert "UNAVAILABLE" in str(exc_info.value.__cause__)
+
+
+def test_retry_policy_backoff_schedule():
+    policy = RetryPolicy(backoff_base=0.5, backoff_factor=2.0, backoff_max=3.0)
+    assert [policy.delay(k) for k in (1, 2, 3, 4)] == [0.5, 1.0, 2.0, 3.0]
+
+
+def test_default_retryable_predicate():
+    assert default_retryable(WatchdogTimeout("deadline"))
+    assert default_retryable(RuntimeError("UNAVAILABLE: socket closed"))
+    assert default_retryable(InjectedBackendError("INTERNAL: relay died"))
+    # The NONRETRYABLE marker overrules a retryable-looking envelope.
+    assert not default_retryable(
+        RuntimeError("INTERNAL: CpuCallback error: NONRETRYABLE: crash")
+    )
+    assert not default_retryable(ValueError("shape mismatch"))
+    assert not default_retryable(RuntimeError("plain bug"))
+
+
+# -- watchdog ----------------------------------------------------------------
+
+
+def test_watchdog_timeout_triggers_retry_and_completes(tmp_path, key):
+    """Acceptance: the silent-hang signature (evaluation blocks far past the
+    deadline) is converted into a retryable failure; the retry (delay
+    disarmed after its first hit) completes bit-identical to a clean run."""
+    schedule = dict(delay_generations=[5], delay_seconds=1.5, delay_times=1)
+    prob = FaultyProblem(Sphere(), **schedule)
+    wf = _wf(prob)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=4,
+        watchdog_timeout=0.4,
+        retry=RetryPolicy(**FAST_RETRY),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        final = runner.run(wf.init(key), 10)
+    assert runner.stats.completed_generations == 10
+    assert runner.stats.watchdog_timeouts == 1
+    assert runner.stats.retries == 1
+
+    clean_prob = FaultyProblem(Sphere(), **dict(schedule, delay_times=0))
+    clean_wf = _wf(clean_prob)
+    clean = ResilientRunner(clean_wf, tmp_path / "clean", checkpoint_every=4)
+    _assert_states_identical(final, clean.run(clean_wf.init(key), 10))
+
+
+# -- CPU fallback ------------------------------------------------------------
+
+
+def test_cpu_fallback_completes_after_budget_exhaustion(tmp_path, key):
+    """With the per-segment retry budget exhausted, cpu_fallback re-runs the
+    segment on the CPU backend (fresh budget) and the run completes."""
+    prob = FaultyProblem(Sphere(), error_generations=[3], error_times=2)
+    wf = _wf(prob)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=4,
+        cpu_fallback=True,
+        retry=RetryPolicy(max_retries=1, backoff_base=0.01),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        final = runner.run(wf.init(key), 8)
+    assert runner.stats.completed_generations == 8
+    assert runner.stats.cpu_fallbacks == 1
+    assert jnp.all(jnp.isfinite(final.algorithm.fit))
+
+
+# -- non-finite fitness quarantine -------------------------------------------
+
+
+def test_nan_quarantine_never_reported_best_and_counted(key):
+    """Acceptance: injected NaN fitness never becomes the reported best and
+    is counted in EvalMonitor.num_nonfinite."""
+    mon = EvalMonitor(full_fit_history=True)
+    prob = FaultyProblem(Sphere(), nan_generations=[1, 2], nan_rows=3)
+    wf = _wf(prob, monitor=mon)
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    for _ in range(4):
+        state = step(state)
+    jax.block_until_ready(state)
+    best = float(mon.get_best_fitness(state.monitor))
+    assert np.isfinite(best)
+    assert best < 1e29  # a real fitness, not the quarantine penalty
+    # 2 scheduled evaluations x 3 rows each.
+    assert int(mon.get_num_nonfinite(state.monitor)) == 6
+    # The quarantined generations carry the penalty, not NaN, in history.
+    for hist in mon.fitness_history:
+        assert not np.any(np.isnan(np.asarray(hist)))
+
+
+def test_nan_quarantine_inf_and_multiobjective_rows(key):
+    """±Inf quarantines like NaN; multi-objective rows count once per
+    individual even when several objectives are non-finite."""
+
+    class InfProblem:
+        def setup(self, key):
+            return State()
+
+        def evaluate(self, state, pop):
+            fit = jnp.stack([jnp.sum(pop**2, axis=1)] * 2, axis=1)
+            fit = fit.at[0, 0].set(jnp.inf)
+            fit = fit.at[1, :].set(-jnp.inf)
+            return fit, state
+
+    mon = EvalMonitor(multi_obj=True, full_fit_history=True)
+    from evox_tpu.algorithms import NSGA2
+
+    wf = StdWorkflow(
+        NSGA2(16, 2, jnp.zeros(DIM), jnp.ones(DIM)), InfProblem(), monitor=mon
+    )
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+    state = jax.jit(wf.step)(state)
+    jax.block_until_ready(state)
+    # 2 individuals quarantined per evaluation; NSGA2 evaluates once per step.
+    n = int(mon.get_num_nonfinite(state.monitor))
+    assert n == 2 * 2
+    latest = np.asarray(state.monitor.latest_fitness)
+    assert np.all(np.isfinite(latest))
+    # The WHOLE row is demoted: individual 0 had (inf, finite) — its finite
+    # objective must not survive to keep the row competitive/non-dominated.
+    assert np.all(latest[0] >= 1e29) and np.all(latest[1] >= 1e29)
+
+
+def test_nan_quarantine_opt_out_propagates(key):
+    mon = EvalMonitor()
+    prob = FaultyProblem(Sphere(), nan_generations=[1], nan_rows=2)
+    wf = _wf(prob, monitor=mon, quarantine_nonfinite=False)
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+    state = jax.jit(wf.step)(state)  # evaluation index 1: NaN lands
+    jax.block_until_ready(state)
+    assert np.isnan(np.asarray(state.monitor.latest_fitness)).sum() == 2
+
+
+def test_nan_quarantine_max_direction_penalty_is_worst(key):
+    """Under opt_direction='max' the quarantine penalty must still lose:
+    the reported best stays finite and real."""
+    mon = EvalMonitor()
+
+    class NegSphere:
+        def setup(self, key):
+            return State()
+
+        def evaluate(self, state, pop):
+            return -jnp.sum(pop**2, axis=1), state
+
+    prob = FaultyProblem(NegSphere(), nan_generations=[0, 1], nan_rows=4)
+    wf = _wf(prob, monitor=mon, opt_direction="max")
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+    state = jax.jit(wf.step)(state)
+    jax.block_until_ready(state)
+    best = float(mon.get_best_fitness(state.monitor))
+    assert np.isfinite(best)
+    assert abs(best) < 1e29
+    assert int(mon.get_num_nonfinite(state.monitor)) == 8
+
+
+def test_quarantine_through_resilient_runner(tmp_path, key):
+    """End-to-end: runner + monitor + NaN faults; the checkpointed
+    num_nonfinite metric survives kill-and-resume."""
+    schedule = dict(nan_generations=[3], nan_rows=2)
+    mon = EvalMonitor(full_fit_history=False)
+    prob = FaultyProblem(Sphere(), **schedule)
+    wf = _wf(prob, monitor=mon)
+    runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    state = runner.run(wf.init(key), 8)
+    assert int(mon.get_num_nonfinite(state.monitor)) == 2
+    assert np.isfinite(float(mon.get_best_fitness(state.monitor)))
+
+
+# -- fault injection plumbing ------------------------------------------------
+
+
+def test_faulty_problem_is_numerically_transparent(key):
+    prob = FaultyProblem(Sphere())
+    pop = jax.random.uniform(key, (16, DIM)) * 20 - 10
+    fit_direct, _ = Sphere().evaluate(State(), pop)
+    fit_wrapped, new_state = jax.jit(prob.evaluate)(prob.setup(key), pop)
+    np.testing.assert_array_equal(np.asarray(fit_direct), np.asarray(fit_wrapped))
+    assert int(new_state.fault_generation) == 1
+
+
+def test_faulty_problem_error_wrapped_as_xla_runtime_error(key):
+    """The injected host error must surface exactly like a real backend
+    loss: an XlaRuntimeError whose message matches the retry signatures."""
+    prob = FaultyProblem(Sphere(), error_generations=[0], error_times=1)
+    wf = _wf(prob)
+    state = wf.init(key)
+    with pytest.raises(Exception) as exc_info:
+        jax.block_until_ready(jax.jit(wf.init_step)(state))
+    assert default_retryable(exc_info.value)
+    assert "UNAVAILABLE" in str(exc_info.value) or "INTERNAL" in str(
+        exc_info.value
+    )

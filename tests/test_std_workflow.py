@@ -313,14 +313,18 @@ def test_multigeneration_run():
 
 def test_multigeneration_run_unroll_and_donation():
     """`run` with unroll>1 and a donated carry computes the same trajectory
-    as the plain form (unroll is a pipelining knob, not a semantic one)."""
+    as the plain form (unroll is a pipelining knob, not a semantic one).
+    Tolerance, not bitwise equality: XLA may legally reassociate float ops
+    when fusing across unrolled iterations, so the two differently-compiled
+    programs can drift by an ulp per generation."""
     wf = _make()
     state_a = wf.init(jax.random.key(3))
     state_b = wf.init(jax.random.key(3))
     out_a = jax.jit(lambda s: wf.run(s, 6))(state_a)
     out_b = jax.jit(lambda s: wf.run(s, 6, unroll=3), donate_argnums=0)(state_b)
-    np.testing.assert_array_equal(
-        np.asarray(out_a.algorithm.pop), np.asarray(out_b.algorithm.pop)
+    np.testing.assert_allclose(
+        np.asarray(out_a.algorithm.pop), np.asarray(out_b.algorithm.pop),
+        rtol=1e-5, atol=1e-5,
     )
 
 

@@ -1,0 +1,37 @@
+"""CI wiring for repo tooling: the bare-assert ratchet lint.
+
+Keeping the lint inside tier-1 means a PR that adds a bare ``assert`` for
+user-input validation to library code fails tests, not just an optional
+lint lane (the rationale and the ratchet mechanics live in
+``tools/lint_asserts.py``)."""
+
+import importlib.util
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "lint_asserts", REPO / "tools" / "lint_asserts.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_no_new_bare_asserts_in_library_code():
+    lint = _load_lint()
+    problems = lint.check(lint.scan(), lint.load_baseline())
+    assert not problems, "\n".join(problems)
+
+
+def test_resilience_subsystem_is_assert_free():
+    """New subsystems start at zero: the resilience layer must never appear
+    in the ratchet baseline."""
+    lint = _load_lint()
+    counts = lint.scan()
+    offenders = {k: v for k, v in counts.items() if k.startswith("evox_tpu/resilience")}
+    assert not offenders, offenders
+    baseline = lint.load_baseline()
+    assert not any(k.startswith("evox_tpu/resilience") for k in baseline)
