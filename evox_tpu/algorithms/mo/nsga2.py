@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import Algorithm, EvalFn, State
+from ..validation import validate_bounds
 from ...operators.crossover import simulated_binary
 from ...operators.mutation import polynomial_mutation
 from ...operators.selection import (
@@ -59,7 +60,7 @@ class NSGA2(Algorithm):
         """
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        validate_bounds(lb, ub)
         self.pop_size = pop_size
         self.n_objs = n_objs
         self.dim = lb.shape[0]

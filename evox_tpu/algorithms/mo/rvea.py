@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import Algorithm, EvalFn, Parameter, State
+from ..validation import validate_bounds
 from ...operators.crossover import simulated_binary
 from ...operators.mutation import polynomial_mutation
 from ...operators.sampling import uniform_sampling
@@ -71,7 +72,7 @@ class RVEA(Algorithm):
         """
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        validate_bounds(lb, ub)
         self.n_objs = n_objs
         self.dim = lb.shape[0]
         self.lb = lb

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, Parameter, State
+from ...validation import validate_bounds
 from .strategy import CURRENT2RAND_1, RAND_1_BIN, RAND_2_BIN, composite_trial
 
 __all__ = ["CoDE"]
@@ -36,10 +37,11 @@ class CoDE(Algorithm):
         :param param_pool: pool of (F, CR) control-parameter pairs sampled per
             strategy per individual (reference ``code.py:39``).
         """
-        assert pop_size >= 9
+        if pop_size < 9:
+            raise ValueError(f"pop_size must be >= 9, got {pop_size}")
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        validate_bounds(lb, ub)
         self.pop_size = pop_size
         self.dim = lb.shape[0]
         self.diff_padding_num = diff_padding_num

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, Parameter, State
+from ...validation import validate_bounds
 from ....operators.crossover import DE_binary_crossover
 
 __all__ = ["DE"]
@@ -36,20 +37,38 @@ class DE(Algorithm):
         stdev: jax.Array | None = None,
         dtype=jnp.float32,
     ):
-        assert pop_size >= 4
-        assert 0 < cross_probability <= 1
-        assert 1 <= num_difference_vectors < pop_size // 2
-        assert base_vector in ("rand", "best")
+        if pop_size < 4:
+            raise ValueError(f"pop_size must be >= 4, got {pop_size}")
+        if not 0 < cross_probability <= 1:
+            raise ValueError(
+                f"cross_probability must be in (0, 1], got "
+                f"{cross_probability}"
+            )
+        if not 1 <= num_difference_vectors < pop_size // 2:
+            raise ValueError(
+                f"num_difference_vectors must be in [1, pop_size // 2), "
+                f"got {num_difference_vectors} with pop_size={pop_size}"
+            )
+        if base_vector not in ("rand", "best"):
+            raise ValueError(
+                f"base_vector must be 'rand' or 'best', got "
+                f"{base_vector!r}"
+            )
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        validate_bounds(lb, ub)
         self.pop_size = pop_size
         self.dim = lb.shape[0]
         self.best_vector = base_vector == "best"
         self.num_difference_vectors = num_difference_vectors
         if num_difference_vectors > 1:
             differential_weight = jnp.asarray(differential_weight, dtype=dtype)
-            assert differential_weight.shape == (num_difference_vectors,)
+            if differential_weight.shape != (num_difference_vectors,):
+                raise ValueError(
+                    f"differential_weight must have shape "
+                    f"({num_difference_vectors},), got "
+                    f"{differential_weight.shape}"
+                )
         self.differential_weight = differential_weight
         self.cross_probability = cross_probability
         self.lb, self.ub = lb, ub

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, State
+from ...validation import validate_bounds
 from ....operators.crossover import DE_binary_crossover
 from ....operators.selection import select_rand_pbest
 
@@ -37,10 +38,11 @@ class JaDE(Algorithm):
         """
         :param c: learning rate for the adaptive means F_u / CR_u.
         """
-        assert pop_size >= 4
+        if pop_size < 4:
+            raise ValueError(f"pop_size must be >= 4, got {pop_size}")
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        validate_bounds(lb, ub)
         self.pop_size = pop_size
         self.dim = lb.shape[0]
         self.num_difference_vectors = num_difference_vectors

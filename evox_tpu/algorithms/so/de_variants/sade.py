@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, State
+from ...validation import validate_bounds
 from .strategy import (
     CURRENT2RAND_1,
     RAND2BEST_2_BIN,
@@ -50,10 +51,11 @@ class SaDE(Algorithm):
         """
         :param LP: learning-period depth of the success/failure/CR memories.
         """
-        assert pop_size >= 9
+        if pop_size < 9:
+            raise ValueError(f"pop_size must be >= 9, got {pop_size}")
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        validate_bounds(lb, ub)
         self.pop_size = pop_size
         self.dim = lb.shape[0]
         self.diff_padding_num = diff_padding_num

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, State
+from ...validation import validate_bounds
 from .strategy import CURRENT2PBEST_1_BIN, composite_trial
 
 __all__ = ["SHADE"]
@@ -39,10 +40,11 @@ class SHADE(Algorithm):
         :param diff_padding_num: static width of the padded difference-vector
             index table (reference ``shade.py:35``).
         """
-        assert pop_size >= 9
+        if pop_size < 9:
+            raise ValueError(f"pop_size must be >= 9, got {pop_size}")
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        validate_bounds(lb, ub)
         self.pop_size = pop_size
         self.dim = lb.shape[0]
         self.diff_padding_num = diff_padding_num

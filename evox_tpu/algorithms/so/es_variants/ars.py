@@ -26,8 +26,15 @@ class ARS(CenterES):
         sigma: float = 0.03,
         optimizer: Literal["adam"] | None = None,
     ):
-        assert pop_size > 1 and pop_size % 2 == 0
-        assert 0 <= elite_ratio <= 1
+        if pop_size <= 1 or pop_size % 2 != 0:
+            raise ValueError(
+                f"pop_size must be an even number > 1 (mirrored sampling), "
+                f"got {pop_size}"
+            )
+        if not 0 <= elite_ratio <= 1:
+            raise ValueError(
+                f"elite_ratio must be in [0, 1], got {elite_ratio}"
+            )
         center_init = jnp.asarray(center_init)
         self.dim = center_init.shape[0]
         self.pop_size = pop_size

@@ -31,7 +31,11 @@ class ASEBO(CenterES):
         sigma_limit: float = 0.01,
         subspace_dims: int | None = None,
     ):
-        assert pop_size > 1 and pop_size % 2 == 0
+        if pop_size <= 1 or pop_size % 2 != 0:
+            raise ValueError(
+                f"pop_size must be an even number > 1 (mirrored sampling), "
+                f"got {pop_size}"
+            )
         center_init = jnp.asarray(center_init)
         self.dim = center_init.shape[0]
         self.pop_size = pop_size

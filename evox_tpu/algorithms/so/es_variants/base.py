@@ -25,7 +25,10 @@ class CenterES(Algorithm):
     optimizer: Literal["adam"] | None
 
     def _init_optimizer(self, optimizer: Literal["adam"] | None, lr: float):
-        assert optimizer in (None, "adam"), "optimizer must be None or 'adam'"
+        if optimizer not in (None, "adam"):
+            raise ValueError(
+                f"optimizer must be None or 'adam', got {optimizer!r}"
+            )
         self.optimizer = optimizer
         self.lr = lr
 

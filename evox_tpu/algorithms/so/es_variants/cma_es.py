@@ -37,11 +37,15 @@ class CMAES(Algorithm):
         :param pop_size: λ; defaults to ``4 + floor(3 ln d)``.
         :param weights: recombination weights (μ of them); default log-rank.
         """
-        assert sigma > 0
+        if sigma <= 0:
+            raise ValueError(f"sigma must be positive, got {sigma}")
         mean_init = jnp.asarray(mean_init)
         self.dim = dim = mean_init.shape[0]
         self.pop_size = pop_size or 4 + math.floor(3 * math.log(dim))
-        assert self.pop_size > 0
+        if self.pop_size <= 0:
+            raise ValueError(
+                f"pop_size must be positive, got {self.pop_size}"
+            )
         self.mu = self.pop_size // 2
         self.mean_init = mean_init
         self.sigma_init = sigma

@@ -19,7 +19,8 @@ class DES(Algorithm):
         temperature: float = 12.5,
         sigma_init: float = 0.1,
     ):
-        assert pop_size > 1
+        if pop_size <= 1:
+            raise ValueError(f"pop_size must be > 1, got {pop_size}")
         center_init = jnp.asarray(center_init)
         self.dim = center_init.shape[0]
         self.pop_size = pop_size

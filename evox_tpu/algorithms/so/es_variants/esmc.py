@@ -25,9 +25,11 @@ class ESMC(CenterES):
         lr: float = 0.05,
         sigma: float = 0.03,
     ):
-        assert pop_size > 1 and pop_size % 2 == 1, (
-            "ESMC uses a baseline member plus mirrored pairs; pop_size must be odd"
-        )
+        if pop_size <= 1 or pop_size % 2 != 1:
+            raise ValueError(
+                f"ESMC uses a baseline member plus mirrored pairs; "
+                f"pop_size must be an odd number > 1, got {pop_size}"
+            )
         center_init = jnp.asarray(center_init)
         self.dim = center_init.shape[0]
         self.pop_size = pop_size

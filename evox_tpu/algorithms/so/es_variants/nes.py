@@ -42,7 +42,8 @@ class XNES(Algorithm):
         self.dim = dim
         if pop_size is None:
             pop_size = 4 + math.floor(3 * math.log(dim))
-        assert pop_size > 0
+        if pop_size <= 0:
+            raise ValueError(f"pop_size must be positive, got {pop_size}")
         self.pop_size = pop_size
 
         self.learning_rate_mean = learning_rate_mean or 1.0
@@ -65,9 +66,12 @@ class XNES(Algorithm):
             recombination_weights = _default_recombination_weights(pop_size)
         else:
             recombination_weights = jnp.asarray(recombination_weights)
-            assert bool(
+            if not bool(
                 jnp.all(recombination_weights[1:] <= recombination_weights[:-1])
-            ), "recombination_weights must be descending"
+            ):
+                raise ValueError(
+                    "recombination_weights must be descending"
+                )
         self.weights = recombination_weights
 
     def setup(self, key: jax.Array) -> State:
@@ -125,11 +129,16 @@ class SeparableNES(Algorithm):
         init_mean = jnp.asarray(init_mean)
         init_std = jnp.asarray(init_std)
         dim = init_mean.shape[0]
-        assert init_std.shape == (dim,)
+        if init_std.shape != (dim,):
+            raise ValueError(
+                f"init_std must have shape ({dim},) matching init_mean, "
+                f"got {init_std.shape}"
+            )
         self.dim = dim
         if pop_size is None:
             pop_size = 4 + math.floor(3 * math.log(dim))
-        assert pop_size > 0
+        if pop_size <= 0:
+            raise ValueError(f"pop_size must be positive, got {pop_size}")
         self.pop_size = pop_size
         self.learning_rate_mean = learning_rate_mean or 1.0
         self.learning_rate_var = (
@@ -141,7 +150,11 @@ class SeparableNES(Algorithm):
             recombination_weights = _default_recombination_weights(pop_size)
         else:
             recombination_weights = jnp.asarray(recombination_weights)
-            assert recombination_weights.shape == (pop_size,)
+            if recombination_weights.shape != (pop_size,):
+                raise ValueError(
+                    f"recombination_weights must have shape "
+                    f"({pop_size},), got {recombination_weights.shape}"
+                )
         self.weights = recombination_weights
         self.init_mean = init_mean
         self.init_std = init_std

@@ -28,9 +28,17 @@ class OpenES(CenterES):
         optimizer: Literal["adam"] | None = None,
         mirrored_sampling: bool = True,
     ):
-        assert noise_stdev > 0 and learning_rate > 0 and pop_size > 0
+        if noise_stdev <= 0 or learning_rate <= 0 or pop_size <= 0:
+            raise ValueError(
+                f"noise_stdev, learning_rate and pop_size must all be "
+                f"positive, got {noise_stdev}, {learning_rate}, {pop_size}"
+            )
         if mirrored_sampling:
-            assert pop_size % 2 == 0, "mirrored sampling requires even pop_size"
+            if pop_size % 2 != 0:
+                raise ValueError(
+                    f"mirrored sampling requires an even pop_size, got "
+                    f"{pop_size}"
+                )
         self.pop_size = pop_size
         center_init = jnp.asarray(center_init)
         self.dim = center_init.shape[0]

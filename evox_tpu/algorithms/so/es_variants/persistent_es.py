@@ -33,7 +33,11 @@ class PersistentES(CenterES):
         :param T: inner-problem (unroll) length.
         :param K: truncation length per step.
         """
-        assert pop_size > 1 and pop_size % 2 == 0
+        if pop_size <= 1 or pop_size % 2 != 0:
+            raise ValueError(
+                f"pop_size must be an even number > 1 (mirrored sampling), "
+                f"got {pop_size}"
+            )
         center_init = jnp.asarray(center_init)
         self.dim = center_init.shape[0]
         self.pop_size = pop_size

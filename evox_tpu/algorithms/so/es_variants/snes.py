@@ -24,7 +24,8 @@ class SNES(Algorithm):
         temperature: float = 12.5,
         weight_type: Literal["recomb", "temp"] = "temp",
     ):
-        assert pop_size > 1
+        if pop_size <= 1:
+            raise ValueError(f"pop_size must be > 1, got {pop_size}")
         center_init = jnp.asarray(center_init)
         dim = center_init.shape[0]
         self.dim = dim

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, Parameter, State
+from ...validation import validate_bounds
 from .utils import min_by
 
 __all__ = ["CLPSO"]
@@ -39,7 +40,7 @@ class CLPSO(Algorithm):
         """
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        validate_bounds(lb, ub)
         self.pop_size = pop_size
         self.dim = lb.shape[0]
         self.lb = lb

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, Parameter, State
+from ...validation import validate_bounds
 
 __all__ = ["CSO"]
 
@@ -38,8 +39,12 @@ class CSO(Algorithm):
         """
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
-        assert pop_size % 2 == 0, "CSO needs an even population for pairing"
+        validate_bounds(lb, ub)
+        if pop_size % 2 != 0:
+            raise ValueError(
+                f"CSO needs an even population for pairing, got "
+                f"pop_size={pop_size}"
+            )
         self.pop_size = pop_size
         self.dim = lb.shape[0]
         self.lb = lb

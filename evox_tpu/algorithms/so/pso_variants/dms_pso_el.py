@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, Parameter, State
+from ...validation import validate_bounds
 
 __all__ = ["DMSPSOEL"]
 
@@ -54,7 +55,7 @@ class DMSPSOEL(Algorithm):
         """
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        validate_bounds(lb, ub)
         self.dim = lb.shape[0]
         self.pop_size = (
             dynamic_sub_swarm_size * dynamic_sub_swarms_num + following_sub_swarm_size

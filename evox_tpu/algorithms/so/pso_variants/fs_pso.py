@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, Parameter, State
+from ...validation import validate_bounds
 from .utils import min_by
 
 __all__ = ["FSPSO"]
@@ -40,8 +41,11 @@ class FSPSO(Algorithm):
         """
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
-        assert pop_size % 2 == 0, "FSPSO needs an even population"
+        validate_bounds(lb, ub)
+        if pop_size % 2 != 0:
+            raise ValueError(
+                f"FSPSO needs an even population, got pop_size={pop_size}"
+            )
         self.pop_size = pop_size
         self.dim = lb.shape[0]
         self.lb = lb

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core import Algorithm, EvalFn, Parameter, State
+from ...validation import validate_bounds
 from .utils import min_by
 
 __all__ = ["PSO"]
@@ -42,7 +43,7 @@ class PSO(Algorithm):
         """
         lb = jnp.asarray(lb, dtype=dtype)
         ub = jnp.asarray(ub, dtype=dtype)
-        assert lb.ndim == 1 and ub.ndim == 1 and lb.shape == ub.shape
+        validate_bounds(lb, ub)
         self.pop_size = pop_size
         self.dim = lb.shape[0]
         self.lb = lb

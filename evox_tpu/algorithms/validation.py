@@ -1,0 +1,26 @@
+"""Shared constructor-argument validation for algorithms.
+
+Library code must never guard user input with bare ``assert`` — asserts
+vanish under ``python -O``, so a bad ``lb``/``ub`` pair would sail through
+and explode later as an opaque shape error inside a jitted program (the
+ratchet lint ``tools/lint_asserts.py`` enforces this).  The check every
+bounded algorithm repeats lives here once; rarer validations raise
+``ValueError`` inline at the call site, carrying the offending values.
+"""
+
+from __future__ import annotations
+
+__all__ = ["validate_bounds"]
+
+
+def validate_bounds(lb, ub) -> None:
+    """Validate a search-space bounds pair: both 1-D, identical shape.
+
+    Raises :class:`ValueError` naming the offending shapes (the error a
+    user can act on, instead of the downstream broadcast failure a bad
+    pair would otherwise cause inside ``setup``/``step``)."""
+    if lb.ndim != 1 or ub.ndim != 1 or lb.shape != ub.shape:
+        raise ValueError(
+            f"lb and ub must be 1-D arrays of identical shape, got "
+            f"lb.shape={tuple(lb.shape)}, ub.shape={tuple(ub.shape)}"
+        )

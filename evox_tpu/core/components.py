@@ -171,3 +171,11 @@ class Monitor(_Component):
         step; the no-op base keeps it free for monitors that don't track it."""
         del mask
         return state
+
+    def record_restart(self, state: State) -> State:
+        """Hook: an automatic restart fired on the run this state belongs to
+        (``ResilientRunner`` health/restart layer — see
+        ``resilience/restart.py``).  Called between jitted chunks, on the
+        host; ``EvalMonitor`` counts it into its in-state ``num_restarts``
+        metric so the count survives checkpoints."""
+        return state

@@ -15,9 +15,22 @@ This subsystem adds the missing layer:
   ``INTERNAL`` ``XlaRuntimeError``), a watchdog deadline that converts the
   silent-hang signature into a retryable timeout, and an optional last-ditch
   CPU fallback.
-* :class:`FaultyProblem` — a deterministic fault-injection wrapper (NaN
-  rows, host-side exceptions, artificial delays, by generation schedule) so
-  every recovery path above is testable on CPU.
+* :class:`HealthProbe` / :class:`HealthReport` — run-health diagnostics
+  between chunks: non-finite leaves anywhere in the state pytree, population
+  diversity collapse, ES step-size out-of-range, and best-fitness stagnation
+  — degenerate-search failure modes that never raise but waste the whole
+  remaining budget.
+* Restart policies (:class:`RollbackToCheckpoint`,
+  :class:`ReinitLargerPopulation`, :class:`PerturbAroundBest`) — applied by
+  the runner on an unhealthy verdict: rollback with perturbed PRNG streams,
+  IPOP-style population regrow with the elite preserved, or re-seeding
+  around the incumbent best.  All deterministic and bit-reproducible under
+  resume; fired restarts are recorded as :class:`RestartEvent` lineage in
+  ``RunStats`` and in every checkpoint manifest.
+* :class:`FaultyProblem` — a deterministic fault-injection wrapper (NaN/Inf
+  rows, in-state corruption, stagnation plateaus, host-side exceptions,
+  artificial delays, by evaluation schedule) so every recovery path above is
+  testable on CPU.
 
 Non-finite fitness quarantine lives in the workflow layer itself
 (``StdWorkflow(quarantine_nonfinite=True)``, the default) so NaN/±Inf never
@@ -25,6 +38,17 @@ silently propagate through ranking — see ``workflows/std_workflow.py``.
 """
 
 from .faults import FaultyProblem, InjectedBackendError, InjectedFatalError
+from .health import HealthProbe, HealthReport
+from .restart import (
+    PerturbAroundBest,
+    ReinitLargerPopulation,
+    RestartContext,
+    RestartEvent,
+    RestartPolicy,
+    RollbackToCheckpoint,
+    incumbent_best,
+    perturb_prng_keys,
+)
 from .runner import (
     ResilienceError,
     ResilientRunner,
@@ -43,6 +67,16 @@ __all__ = [
     "WatchdogTimeout",
     "default_retryable",
     "latest_checkpoint",
+    "HealthProbe",
+    "HealthReport",
+    "RestartPolicy",
+    "RestartEvent",
+    "RestartContext",
+    "RollbackToCheckpoint",
+    "ReinitLargerPopulation",
+    "PerturbAroundBest",
+    "incumbent_best",
+    "perturb_prng_keys",
     "FaultyProblem",
     "InjectedBackendError",
     "InjectedFatalError",

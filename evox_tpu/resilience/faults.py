@@ -8,6 +8,22 @@ replays deterministically):
 * **NaN rows** — the first ``nan_rows`` fitness entries of scheduled
   evaluations become NaN *inside the jitted program*, exercising the
   workflow's non-finite quarantine without leaving XLA.
+* **Inf rows** — same, with ``+inf`` (``inf_generations``/``inf_rows``):
+  overflow-style divergence, the other half of the quarantine contract.
+* **in-state corruption** — scheduled evaluations write NaN into a
+  dedicated ``corruption`` leaf of the wrapper's own jitted state
+  (``corrupt_generations``): a fitness-independent degenerate-state
+  signature the quarantine cannot mask, for
+  :class:`~evox_tpu.resilience.HealthProbe`'s non-finite-state detector.
+  Like the host-exception faults, corruption is **attempt-counted on the
+  host** (``corrupt_times``): a restart that rolls the evaluation index
+  back and replays sees the corruption as "over" — the leaf is recomputed
+  every evaluation, so the replay heals it and restart policies can
+  demonstrate recovery.
+* **stagnation plateaus** — fitness is clamped to ``plateau_floor`` for
+  every evaluation in ``[plateau_from, plateau_until)``: the best fitness
+  cannot improve during the window, driving the probe's stagnation
+  detector.
 * **host-side exceptions** — an ``io_callback`` raises
   :class:`InjectedBackendError` (message carries ``UNAVAILABLE``, the
   BASELINE.md outage signature); XLA wraps it into the same
@@ -35,6 +51,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import io_callback
 from jax.sharding import SingleDeviceSharding
 
@@ -70,6 +87,13 @@ class FaultyProblem(Problem):
         *,
         nan_generations: Sequence[int] = (),
         nan_rows: int = 1,
+        inf_generations: Sequence[int] = (),
+        inf_rows: int = 1,
+        corrupt_generations: Sequence[int] = (),
+        corrupt_times: int = 1,
+        plateau_from: int | None = None,
+        plateau_until: int | None = None,
+        plateau_floor: float = 1.0,
         error_generations: Sequence[int] = (),
         error_times: int = 1,
         error_message: str = "UNAVAILABLE: injected backend loss (fault schedule)",
@@ -82,6 +106,24 @@ class FaultyProblem(Problem):
         """
         :param nan_generations: evaluation indices whose fitness gets NaN
             injected into its first ``nan_rows`` rows (inside jit).
+        :param inf_generations: evaluation indices whose fitness gets
+            ``+inf`` injected into its first ``inf_rows`` rows (inside
+            jit) — overflow-style divergence for the quarantine's Inf path.
+        :param corrupt_generations: evaluation indices whose evaluation
+            writes NaN into the wrapper's own ``corruption`` state leaf —
+            in-state corruption the fitness quarantine cannot see, for the
+            health probe's non-finite-state detector.  Fires for the first
+            ``corrupt_times`` attempts of each index (host-counted, like
+            the exception faults), and the leaf is recomputed every
+            evaluation — so a restart that replays (rollback) or continues
+            past the schedule (reinit/perturb) heals it.
+        :param plateau_from: first evaluation index (inclusive) of a
+            stagnation plateau: fitness is clamped to at least
+            ``plateau_floor`` while the plateau lasts, so the best fitness
+            cannot improve.  ``None`` disables.
+        :param plateau_until: end of the plateau (exclusive); ``None``
+            with ``plateau_from`` set means "until the run ends".
+        :param plateau_floor: the clamp value during the plateau.
         :param error_generations: evaluation indices that raise a retryable
             :class:`InjectedBackendError` from the host, for the first
             ``error_times`` attempts each.
@@ -96,6 +138,17 @@ class FaultyProblem(Problem):
         self.problem = problem
         self.nan_generations = tuple(int(g) for g in nan_generations)
         self.nan_rows = int(nan_rows)
+        self.inf_generations = tuple(int(g) for g in inf_generations)
+        self.inf_rows = int(inf_rows)
+        self.corrupt_generations = frozenset(
+            int(g) for g in corrupt_generations
+        )
+        self.corrupt_times = int(corrupt_times)
+        self.plateau_from = None if plateau_from is None else int(plateau_from)
+        self.plateau_until = (
+            None if plateau_until is None else int(plateau_until)
+        )
+        self.plateau_floor = float(plateau_floor)
         self.error_generations = frozenset(int(g) for g in error_generations)
         self.error_times = int(error_times)
         self.error_message = error_message
@@ -130,6 +183,15 @@ class FaultyProblem(Problem):
         with self._lock:
             self._attempts.clear()
 
+    def _corrupt_flag(self, gen) -> np.bool_:
+        """Host side of the corruption schedule: True while the fault is
+        live for this evaluation index (first ``corrupt_times`` attempts)."""
+        g = int(gen)
+        if g in self.corrupt_generations:
+            if self._bump("corrupt", g) <= self.corrupt_times:
+                return np.bool_(True)
+        return np.bool_(False)
+
     def _host_hook(self, gen) -> None:
         g = int(gen)
         if g in self.fatal_generations:
@@ -152,6 +214,23 @@ class FaultyProblem(Problem):
             # 0-based evaluation index; lives in the jitted state so it is
             # checkpointed and rolls back with the run on resume.
             fault_generation=jnp.int32(0),
+            # In-state corruption canary: NaN during scheduled evaluations
+            # (``corrupt_generations``), healthy 0.0 otherwise.  Always
+            # present (even with an empty schedule) so faulted runs and
+            # their ``*_times=0`` comparators share one program structure.
+            corruption=jnp.float32(0.0),
+        )
+
+    def _inject_rows(
+        self, fit: jax.Array, gen: jax.Array, schedule: tuple, rows: int, value
+    ) -> jax.Array:
+        scheduled = jnp.any(gen == jnp.asarray(schedule, jnp.int32))
+        row_mask = jnp.arange(fit.shape[0]) < rows
+        mask = row_mask if fit.ndim == 1 else row_mask[:, None]
+        return jnp.where(
+            jnp.logical_and(scheduled, mask),
+            jnp.asarray(value, fit.dtype),
+            fit,
         )
 
     def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
@@ -168,14 +247,42 @@ class FaultyProblem(Problem):
             )
         fit, inner = self.problem.evaluate(state.inner, pop)
         if self.nan_generations:
-            scheduled = jnp.any(
-                gen == jnp.asarray(self.nan_generations, jnp.int32)
+            fit = self._inject_rows(
+                fit, gen, self.nan_generations, self.nan_rows, jnp.nan
             )
-            rows = jnp.arange(fit.shape[0]) < self.nan_rows
-            mask = rows if fit.ndim == 1 else rows[:, None]
+        if self.inf_generations:
+            fit = self._inject_rows(
+                fit, gen, self.inf_generations, self.inf_rows, jnp.inf
+            )
+        if self.plateau_from is not None:
+            in_plateau = gen >= self.plateau_from
+            if self.plateau_until is not None:
+                in_plateau = jnp.logical_and(
+                    in_plateau, gen < self.plateau_until
+                )
+            # Clamp from below: nothing can beat the floor while the
+            # plateau lasts, so the best fitness flatlines.
             fit = jnp.where(
-                jnp.logical_and(scheduled, mask),
-                jnp.asarray(jnp.nan, fit.dtype),
+                in_plateau,
+                jnp.maximum(fit, jnp.asarray(self.plateau_floor, fit.dtype)),
                 fit,
             )
-        return fit, state.replace(inner=inner, fault_generation=gen + 1)
+        if self.corrupt_generations:
+            # The live/over decision is host-counted (see class docstring);
+            # the NaN write itself happens inside the jitted program, and
+            # the leaf is recomputed per evaluation so replays heal it.
+            corrupted = io_callback(
+                self._corrupt_flag,
+                jax.ShapeDtypeStruct((), jnp.bool_),
+                gen,
+                ordered=True,
+                sharding=SingleDeviceSharding(jax.local_devices()[0]),
+            )
+            corruption = jnp.where(
+                corrupted, jnp.float32(jnp.nan), jnp.float32(0.0)
+            )
+        else:
+            corruption = jnp.float32(0.0)
+        return fit, state.replace(
+            inner=inner, fault_generation=gen + 1, corruption=corruption
+        )

@@ -1,0 +1,367 @@
+"""Run-health diagnostics for long evolutionary runs.
+
+PR 1 (``resilience/runner.py``) made runs survive *infrastructure* faults —
+backend loss, hangs, NaN fitness rows.  A multi-hour run can still silently
+waste its budget on a *degenerate search*: non-finite values creeping into
+the algorithm-state pytree (not just fitness), the population collapsing to
+a point, an ES step size under/overflowing, or the best fitness flatlining
+for thousands of generations.  None of those raise; all of them make every
+further generation worthless.
+
+:class:`HealthProbe` scans a workflow state **between** the supervisor's
+jitted chunks and renders a structured :class:`HealthReport`:
+
+* **non-finite state** — any NaN/±Inf in any floating leaf of the state
+  pytree (algorithm, problem, and monitor sub-states alike; PRNG-key and
+  integer leaves are skipped, and leaves whose path matches
+  ``nonfinite_skip`` are exempt for algorithms that use ``inf`` as an
+  in-band sentinel);
+* **diversity collapse** — the largest per-dimension spread (std over the
+  population axis) of ``state.algorithm.pop`` fell under
+  ``diversity_floor``: the whole population sits in a vanishing box and
+  recombination can no longer explore;
+* **step-size out of range** — an ES ``sigma`` leaf left
+  ``step_size_range`` (collapse to ~0 freezes the search; blow-up past the
+  bound width turns it into rejection sampling);
+* **stagnation** — the best fitness (monitor top-k when available, else
+  ``min(state.algorithm.fit)``) improved less than ``stagnation_tol`` over
+  the last ``stagnation_window`` probes.
+
+The numeric scan is one jit-compiled program per state structure (compiled
+once, then microseconds per probe — see ``tools/bench_health_overhead.py``
+for the <5 % overhead budget); only the handful of scalar verdicts cross to
+the host.  The stagnation window is host-side state: the
+:class:`~evox_tpu.resilience.ResilientRunner` persists it in each
+checkpoint's manifest so resumed runs replay probe decisions bit-identically
+(see ``restart.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.checkpoint import _path_str  # one format for leaf-path names
+
+__all__ = ["HealthProbe", "HealthReport", "scan_state"]
+
+
+def _is_prng(leaf: Any) -> bool:
+    return isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+        leaf.dtype, jax.dtypes.prng_key
+    )
+
+
+def _subtree(state: Any, name: str) -> Any | None:
+    """``state[name]`` when ``state`` is a mapping that has it, else None."""
+    if isinstance(state, Mapping) and name in state:
+        return state[name]
+    return None
+
+
+def scan_state(
+    state: Any,
+    *,
+    check_nonfinite: bool = True,
+    nonfinite_skip: Sequence[str] = (),
+    diversity: bool = False,
+    step_size: bool = False,
+) -> dict[str, Any]:
+    """Pure ``state -> {metric: scalar}`` health scan — jittable; all
+    branching is on the *structure* of ``state`` (static under jit).
+
+    Shared by :class:`HealthProbe` (which thresholds the metrics into a
+    verdict) and ``StdWorkflow.health_metrics`` (which surfaces them raw).
+    Keys are emitted only when the state supports them, so the dict is
+    stable per state structure:
+
+    * ``nonfinite`` — per-leaf-path counts of NaN/±Inf scalars (floating
+      leaves only; PRNG keys and ``nonfinite_skip`` matches excluded);
+    * ``diversity`` — largest per-dimension std of ``algorithm.pop``;
+    * ``step_size_min`` / ``step_size_max`` — extrema of ``algorithm.sigma``;
+    * ``best_fitness`` — monitor top-k best (minimizing frame) when
+      available, else ``min(algorithm.fit)``.
+    """
+    out: dict[str, Any] = {}
+    if check_nonfinite:
+        counts = {}
+        for key_path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+            name = _path_str(key_path)
+            if any(skip in name for skip in nonfinite_skip):
+                continue
+            if _is_prng(leaf) or not (
+                hasattr(leaf, "dtype")
+                and jnp.issubdtype(leaf.dtype, jnp.floating)
+            ):
+                continue
+            counts[name] = jnp.sum(~jnp.isfinite(leaf), dtype=jnp.int32)
+        out["nonfinite"] = counts
+    algo = _subtree(state, "algorithm")
+    algo = algo if algo is not None else state
+    pop = _subtree(algo, "pop")
+    if (
+        diversity
+        and pop is not None
+        and getattr(pop, "ndim", 0) == 2
+        and jnp.issubdtype(pop.dtype, jnp.floating)
+    ):
+        # Largest per-dimension spread: below a floor means EVERY dimension
+        # collapsed — the population sits in a vanishing box.
+        out["diversity"] = jnp.max(jnp.std(pop, axis=0))
+    sigma = _subtree(algo, "sigma")
+    if (
+        step_size
+        and sigma is not None
+        and hasattr(sigma, "dtype")
+        and jnp.issubdtype(sigma.dtype, jnp.floating)
+    ):
+        out["step_size_min"] = jnp.min(sigma)
+        out["step_size_max"] = jnp.max(sigma)
+    best = _best_fitness_expr(state, algo)
+    if best is not None:
+        out["best_fitness"] = best
+    return out
+
+
+def _best_fitness_expr(state: Any, algo: Any):
+    """Best fitness in the minimizing frame: the monitor's running top-k
+    when present (monotone best-so-far), else this generation's
+    ``min(fit)``.  ``None`` when the state exposes neither (e.g.
+    multi-objective states, which have no scalar best)."""
+    mon = _subtree(state, "monitor")
+    if mon is not None:
+        topk = _subtree(mon, "topk_fitness")
+        if (
+            topk is not None
+            and getattr(topk, "ndim", 0) == 1
+            and topk.size > 0
+            and jnp.issubdtype(topk.dtype, jnp.floating)
+        ):
+            return topk[0]
+    fit = _subtree(algo, "fit")
+    if (
+        fit is not None
+        and getattr(fit, "ndim", 0) == 1
+        and fit.size > 0
+        and jnp.issubdtype(fit.dtype, jnp.floating)
+    ):
+        return jnp.min(fit)
+    return None
+
+
+@dataclass
+class HealthReport:
+    """Structured verdict of one :meth:`HealthProbe.check` call.
+
+    ``healthy`` is the conjunction of the individual detectors; ``reasons``
+    carries one human-readable line per tripped detector (empty when
+    healthy).  Metric fields are ``None`` when the corresponding detector
+    did not apply to this state (no ``pop`` leaf, no ``sigma`` leaf, window
+    not yet full, ...)."""
+
+    generation: int
+    healthy: bool
+    reasons: list[str] = field(default_factory=list)
+    nonfinite_leaves: dict[str, int] = field(default_factory=dict)
+    diversity: float | None = None
+    diversity_collapse: bool = False
+    step_size_min: float | None = None
+    step_size_max: float | None = None
+    step_size_out_of_range: bool = False
+    best_fitness: float | None = None
+    stagnation_improvement: float | None = None
+    stagnating: bool = False
+
+
+class HealthProbe:
+    """Between-chunk state scanner producing :class:`HealthReport` verdicts.
+
+    Usage (standalone)::
+
+        probe = HealthProbe(diversity_floor=1e-6, stagnation_window=5)
+        report = probe.check(state, generation=120)
+        if not report.healthy:
+            print(report.reasons)
+
+    Usage (supervised — the intended path)::
+
+        runner = ResilientRunner(
+            wf, "ckpts/run",
+            health=HealthProbe(stagnation_window=5, stagnation_tol=1e-9),
+            restart=RollbackToCheckpoint(),
+        )
+
+    The probe is cheap but not free: the scan is jitted once per state
+    structure and each ``check`` costs one device->host sync of a few
+    scalars.  Determinism: ``check`` is a pure function of ``(state, the
+    probe's stagnation window)``; the runner checkpoints the window, so a
+    resumed run reaches identical verdicts.
+    """
+
+    def __init__(
+        self,
+        *,
+        check_nonfinite: bool = True,
+        nonfinite_skip: Sequence[str] = (),
+        diversity_floor: float | None = None,
+        step_size_range: tuple[float, float] | None = (1e-12, 1e6),
+        stagnation_window: int = 0,
+        stagnation_tol: float = 0.0,
+    ):
+        """
+        :param check_nonfinite: scan every floating leaf of the state pytree
+            for NaN/±Inf (PRNG-key and integer/bool leaves are skipped).
+        :param nonfinite_skip: path substrings (e.g. ``"archive_fit"``)
+            whose leaves are exempt from the non-finite scan — for
+            algorithms that legitimately keep ``inf`` sentinels in state.
+        :param diversity_floor: flag diversity collapse when the *largest*
+            per-dimension std of ``state.algorithm.pop`` drops below this;
+            ``None`` disables the detector.
+        :param step_size_range: ``(lo, hi)`` bounds on the ``sigma`` leaf of
+            the algorithm state (checked against ``min(sigma)``/``max(sigma)``
+            for per-dimension step sizes); ``None`` disables.
+        :param stagnation_window: flag stagnation when the best fitness
+            improved by less than ``stagnation_tol`` over this many
+            consecutive probes; ``0`` disables, and ``>= 2`` is required
+            otherwise (a window of 1 compares a value against itself).
+            With a runner this counts chunk boundaries, i.e.
+            ``stagnation_window * checkpoint_every`` generations.
+        :param stagnation_tol: minimum improvement (in the minimizing
+            fitness frame) the window must show to count as progress.
+        """
+        if stagnation_window < 0 or stagnation_window == 1:
+            # A window of 1 compares a value against itself: improvement is
+            # identically 0 and every probe reads as stagnant.
+            raise ValueError(
+                f"stagnation_window must be 0 (disabled) or >= 2 (a window "
+                f"of 1 cannot measure improvement), got {stagnation_window}"
+            )
+        if step_size_range is not None and not (
+            step_size_range[0] <= step_size_range[1]
+        ):
+            raise ValueError(
+                f"step_size_range must be (lo, hi) with lo <= hi, got "
+                f"{step_size_range}"
+            )
+        self.check_nonfinite = check_nonfinite
+        self.nonfinite_skip = tuple(nonfinite_skip)
+        self.diversity_floor = diversity_floor
+        self.step_size_range = step_size_range
+        self.stagnation_window = int(stagnation_window)
+        self.stagnation_tol = float(stagnation_tol)
+        self._window: list[float] = []
+        # One compiled scan per state structure (jit re-traces on structure
+        # change, e.g. after an IPOP-style population regrow).
+        self._scan = jax.jit(self._scan_impl)
+
+    # -- host-side window (persisted via checkpoint manifests) --------------
+    @property
+    def window(self) -> tuple[float, ...]:
+        """Best-fitness values of the most recent probes (newest last)."""
+        return tuple(self._window)
+
+    def reset(self) -> None:
+        """Clear the stagnation window (a fresh run's probe history)."""
+        self._window = []
+
+    def restore(self, window: Sequence[float]) -> None:
+        """Restore the stagnation window from a checkpoint manifest so a
+        resumed run replays probe decisions identically."""
+        self._window = [float(x) for x in window]
+        if self.stagnation_window:
+            del self._window[: -self.stagnation_window]
+
+    # -- the jitted scan -----------------------------------------------------
+    def _scan_impl(self, state: Any) -> dict[str, Any]:
+        return scan_state(
+            state,
+            check_nonfinite=self.check_nonfinite,
+            nonfinite_skip=self.nonfinite_skip,
+            diversity=self.diversity_floor is not None,
+            step_size=self.step_size_range is not None,
+        )
+
+    # -- the host-side verdict ----------------------------------------------
+    def check(self, state: Any, generation: int = 0) -> HealthReport:
+        """Scan ``state`` and return a :class:`HealthReport`.
+
+        Appends to the stagnation window as a side effect — call exactly
+        once per chunk boundary (the runner does)."""
+        raw = jax.device_get(self._scan(state))
+        reasons: list[str] = []
+
+        nonfinite = {
+            name: int(n)
+            for name, n in raw.get("nonfinite", {}).items()
+            if int(n) > 0
+        }
+        if nonfinite:
+            listed = ", ".join(f"{k} ({v})" for k, v in sorted(nonfinite.items()))
+            reasons.append(f"non-finite values in state leaves: {listed}")
+
+        diversity = raw.get("diversity")
+        diversity = None if diversity is None else float(diversity)
+        diversity_collapse = (
+            self.diversity_floor is not None
+            and diversity is not None
+            and diversity < self.diversity_floor
+        )
+        if diversity_collapse:
+            reasons.append(
+                f"population diversity collapsed: max per-dimension spread "
+                f"{diversity:.3e} < floor {self.diversity_floor:.3e}"
+            )
+
+        ss_min = raw.get("step_size_min")
+        ss_min = None if ss_min is None else float(ss_min)
+        ss_max = raw.get("step_size_max")
+        ss_max = None if ss_max is None else float(ss_max)
+        step_size_out_of_range = False
+        if self.step_size_range is not None and ss_min is not None:
+            lo, hi = self.step_size_range
+            # A NaN sigma is out of range too (comparisons are False, so
+            # test the healthy band and negate).
+            inside = (ss_min >= lo) and (ss_max <= hi)
+            step_size_out_of_range = not inside
+            if step_size_out_of_range:
+                reasons.append(
+                    f"step size out of range: sigma in [{ss_min:.3e}, "
+                    f"{ss_max:.3e}], allowed [{lo:.3e}, {hi:.3e}]"
+                )
+
+        best = raw.get("best_fitness")
+        best = None if best is None else float(best)
+        stagnating = False
+        improvement = None
+        if self.stagnation_window > 0 and best is not None:
+            self._window.append(best)
+            del self._window[: -self.stagnation_window]
+            if len(self._window) == self.stagnation_window:
+                improvement = self._window[0] - self._window[-1]
+                # NaN improvement compares False -> not flagged here; the
+                # non-finite detector owns that failure mode.
+                stagnating = improvement <= self.stagnation_tol
+                if stagnating:
+                    reasons.append(
+                        f"best fitness stagnating: improvement "
+                        f"{improvement:.3e} <= tol {self.stagnation_tol:.3e} "
+                        f"over the last {self.stagnation_window} probes"
+                    )
+
+        return HealthReport(
+            generation=int(generation),
+            healthy=not reasons,
+            reasons=reasons,
+            nonfinite_leaves=nonfinite,
+            diversity=diversity,
+            diversity_collapse=diversity_collapse,
+            step_size_min=ss_min,
+            step_size_max=ss_max,
+            step_size_out_of_range=step_size_out_of_range,
+            best_fitness=best,
+            stagnation_improvement=improvement,
+            stagnating=stagnating,
+        )

@@ -1,0 +1,541 @@
+"""Automatic restart policies for unhealthy evolutionary runs.
+
+When a :class:`~evox_tpu.resilience.HealthProbe` flags a degenerate search
+(non-finite state, diversity collapse, step-size blow-up, stagnation — see
+``health.py``), the supervising :class:`~evox_tpu.resilience.ResilientRunner`
+applies one of these policies instead of burning the remaining budget on a
+dead run.  Restart strategies with adapted population sizes are the standard
+remedy in large-scale ES (IPOP-CMA-ES and descendants; see arXiv:2409.11765
+for the massively-parallel variant this layer anticipates):
+
+* :class:`RollbackToCheckpoint` — reload an earlier checkpoint (the PR-1
+  checkpoint layer) and **perturb every PRNG stream** (``fold_in`` with the
+  restart index) so the retry explores a different trajectory from a known-
+  good state.  The cheapest policy; right for transient degeneration
+  (a stagnation plateau, a corrupted buffer that a re-run heals).
+* :class:`ReinitLargerPopulation` — IPOP-style: build a fresh algorithm with
+  the population grown by ``growth_factor``, re-``setup`` from a perturbed
+  key, and preserve the incumbent best (injected as an elite into the new
+  population / distribution mean).  Monitor best-so-far metrics carry over;
+  the problem sub-state is preserved (it is evaluation infrastructure, not
+  search state).
+* :class:`PerturbAroundBest` — keep shapes, re-seed the population as a
+  Gaussian cloud around the incumbent best (scaled to the search-space
+  width) and reset stale fitness to worst.  Right when the search found a
+  good basin but collapsed inside it.
+
+**Determinism contract** (matching PR 1): a policy's output is a pure
+function of ``(checkpointed state, restart index, lineage)`` — no wall
+clock, no fresh entropy.  The runner records every fired restart as a
+:class:`RestartEvent` in ``RunStats`` and in each checkpoint's manifest, so
+a killed-and-resumed run replays the same decisions bit-identically
+(``tests/test_health_restart.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from ..core import State
+from ..utils.checkpoint import load_state
+from .health import _is_prng, _subtree
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (runner imports us)
+    from .health import HealthReport
+    from .runner import ResilientRunner
+
+__all__ = [
+    "RestartPolicy",
+    "RestartEvent",
+    "RestartContext",
+    "RollbackToCheckpoint",
+    "ReinitLargerPopulation",
+    "PerturbAroundBest",
+    "perturb_prng_keys",
+    "incumbent_best",
+]
+
+
+# -- shared helpers ----------------------------------------------------------
+
+
+def perturb_prng_keys(tree: Any, salt: int) -> Any:
+    """Fold ``salt`` into every PRNG-key leaf of ``tree``.
+
+    Deterministic and collision-free per salt: two restarts with different
+    indices produce disjoint downstream streams, and a replayed restart with
+    the same index reproduces its stream exactly."""
+
+    def fold(leaf):
+        if _is_prng(leaf):
+            return jax.random.fold_in(leaf, salt)
+        return leaf
+
+    return jax.tree_util.tree_map(fold, tree)
+
+
+def _first_prng_key(tree: Any) -> jax.Array | None:
+    """First PRNG-key leaf in deterministic (flatten-order) traversal."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if _is_prng(leaf):
+            return leaf
+    return None
+
+
+def incumbent_best(state: Any) -> tuple[jax.Array | None, jax.Array | None]:
+    """The best-so-far ``(solution, fitness)`` recoverable from a workflow
+    state, in the minimizing fitness frame.
+
+    Prefers the monitor's running top-k (monotone best-so-far, survives
+    generations where the population regressed); falls back to the best
+    **finite** entry of the algorithm's current ``fit``/``pop`` pair.
+    Returns ``(None, None)`` when no finite incumbent exists (e.g.
+    multi-objective states, or a fully-diverged population) — a policy
+    must never re-seed around a NaN "best", or every restart would
+    re-inject the very corruption it is recovering from."""
+    mon = _subtree(state, "monitor")
+    if mon is not None:
+        sols = _subtree(mon, "topk_solutions")
+        fits = _subtree(mon, "topk_fitness")
+        if (
+            sols is not None
+            and fits is not None
+            and getattr(sols, "ndim", 0) == 2
+            and getattr(fits, "ndim", 0) == 1
+            and fits.size > 0
+            and bool(jnp.isfinite(fits[0]))
+            and bool(jnp.all(jnp.isfinite(sols[0])))
+        ):
+            return sols[0], fits[0]
+    algo = _subtree(state, "algorithm")
+    algo = algo if algo is not None else state
+    pop = _subtree(algo, "pop")
+    fit = _subtree(algo, "fit")
+    if (
+        pop is not None
+        and fit is not None
+        and getattr(pop, "ndim", 0) == 2
+        and getattr(fit, "ndim", 0) == 1
+        and fit.size == pop.shape[0]
+        and jnp.issubdtype(fit.dtype, jnp.floating)
+    ):
+        # Rank non-finite fitness (and rows of non-finite solutions) last.
+        usable = jnp.isfinite(fit) & jnp.all(jnp.isfinite(pop), axis=1)
+        masked = jnp.where(usable, fit, jnp.inf)
+        i = jnp.argmin(masked)
+        if bool(usable[i]):
+            return pop[i], fit[i]
+    return None, None
+
+
+# -- events ------------------------------------------------------------------
+
+
+@dataclass
+class RestartEvent:
+    """One fired restart, as recorded in ``RunStats.restarts`` and in every
+    subsequent checkpoint manifest (JSON round-trip via
+    :meth:`to_manifest`/:meth:`from_manifest` — satellite: restart lineage
+    survives resume)."""
+
+    generation: int
+    policy: str
+    restart_index: int
+    reasons: list[str] = field(default_factory=list)
+    detail: dict[str, Any] = field(default_factory=dict)
+
+    def to_manifest(self) -> dict[str, Any]:
+        """JSON-serializable form for the checkpoint manifest."""
+        return {
+            "generation": self.generation,
+            "policy": self.policy,
+            "restart_index": self.restart_index,
+            "reasons": list(self.reasons),
+            "detail": dict(self.detail),
+        }
+
+    @classmethod
+    def from_manifest(cls, data: Mapping[str, Any]) -> "RestartEvent":
+        """Inverse of :meth:`to_manifest`."""
+        return cls(
+            generation=int(data["generation"]),
+            policy=str(data["policy"]),
+            restart_index=int(data["restart_index"]),
+            reasons=list(data.get("reasons", [])),
+            detail=dict(data.get("detail", {})),
+        )
+
+
+@dataclass
+class RestartContext:
+    """Everything a policy may consult when applying a restart."""
+
+    runner: "ResilientRunner"
+    workflow: Any
+    state: State
+    generation: int
+    report: "HealthReport"
+    restart_index: int
+    lineage: tuple[RestartEvent, ...] = ()
+
+
+# -- the policy interface ----------------------------------------------------
+
+
+class RestartPolicy:
+    """A deterministic recovery action for an unhealthy run.
+
+    ``apply`` returns ``(state, generation, needs_init, detail)``:
+
+    * ``state`` — the restarted workflow state the run continues from;
+    * ``generation`` — the generation count the run resumes at (equal to
+      ``ctx.generation`` unless the policy rolled time back);
+    * ``needs_init`` — True when ``state`` is a pre-``init_step`` state
+      (fresh setup) the runner must drive through one init segment before
+      chunking resumes;
+    * ``detail`` — JSON-serializable facts for the :class:`RestartEvent`.
+
+    ``rebuild_template`` lets resume reconstruct the checkpoint-validation
+    template after restarts that changed state *shapes* (population
+    regrows); shape-preserving policies inherit the identity."""
+
+    name: str = "restart"
+
+    def apply(
+        self, ctx: RestartContext
+    ) -> tuple[State, int, bool, dict[str, Any]]:
+        raise NotImplementedError
+
+    def rebuild_template(
+        self,
+        workflow: Any,
+        template: State,
+        lineage: list[RestartEvent],
+        runner: "ResilientRunner | None" = None,
+    ) -> State:
+        """Template a checkpoint written *after* ``lineage`` validates
+        against.  Default: shapes unchanged, the caller's template."""
+        del workflow, lineage, runner
+        return template
+
+
+class RollbackToCheckpoint(RestartPolicy):
+    """Reload an earlier checkpoint and perturb every PRNG stream.
+
+    The retry re-runs the rolled-back generations with ``fold_in``-perturbed
+    keys, so it explores a *different* trajectory from a known-good state —
+    the restart analogue of the PR-1 retry ladder.  When no earlier
+    checkpoint survives (pruning, restart at the first boundary), the
+    current state is perturbed in place (time does not roll back).
+
+    :param back: how many checkpoint boundaries to roll back (1 = the
+        boundary before the unhealthy one).  Clamped to the oldest
+        retained checkpoint — size ``ResilientRunner(keep_checkpoints=...)``
+        accordingly.
+    :param salt: base value folded (offset by the restart index) into PRNG
+        leaves; change it to decorrelate two otherwise identical retries.
+    """
+
+    name = "rollback"
+
+    def __init__(self, back: int = 1, salt: int = 0x5EED):
+        if back < 1:
+            raise ValueError(f"back must be >= 1, got {back}")
+        self.back = int(back)
+        self.salt = int(salt)
+
+    def apply(self, ctx: RestartContext):
+        from ..utils.checkpoint import CheckpointError
+        from .runner import _numbered_checkpoints
+
+        candidates = [
+            (gen, path)
+            for gen, path in _numbered_checkpoints(ctx.runner.checkpoint_dir)
+            if gen < ctx.generation
+        ]
+        state, gen, detail = None, ctx.generation, {"rolled_back_to": None}
+        # Walk from the back-th candidate toward older ones: one unusable
+        # file (torn, or a pre-upgrade schema) must degrade the rollback,
+        # not abort the run ("one bad file cannot lose the run").
+        start = max(len(candidates) - self.back, 0) if candidates else -1
+        for i in range(start, -1, -1):
+            cand_gen, path = candidates[i]
+            try:
+                state = load_state(path, ctx.state, allow_missing=True)
+            except (CheckpointError, ValueError) as e:
+                ctx.runner._event(
+                    f"rollback skipping unusable checkpoint {path.name}: {e}",
+                    warn=True,
+                )
+                continue
+            gen, detail = cand_gen, {"rolled_back_to": cand_gen}
+            break
+        if state is None:
+            # No loadable earlier checkpoint: perturb in place (time does
+            # not roll back).
+            state = ctx.state
+        state = perturb_prng_keys(state, self.salt + ctx.restart_index)
+        return state, gen, False, detail
+
+
+class ReinitLargerPopulation(RestartPolicy):
+    """IPOP-style restart: fresh setup with a grown population, elite kept.
+
+    Requires a workflow exposing a mutable ``.algorithm`` attribute and an
+    ``init(key)`` state builder (``StdWorkflow`` does; distributed/sharded
+    workflows are out of scope — the population re-shard would need mesh
+    revalidation).  Across successive restarts the population compounds:
+    ``pop * growth_factor ** k``, capped at ``max_pop_size``.
+
+    What carries over from the unhealthy state:
+
+    * the **incumbent best** — written into row 0 of the new population
+      (or the new distribution ``mean`` for mean-based ES);
+    * the monitor's best-so-far metrics (top-k, ``generation``,
+      ``num_nonfinite``, ``num_restarts``, ``instance_id``);
+    * the **problem sub-state** (evaluation infrastructure — e.g. a fault
+      schedule's position — not search state).
+
+    Everything else is rebuilt by ``algorithm.setup`` from a
+    restart-index-perturbed PRNG key, so the regrown run is deterministic.
+
+    :param algorithm_factory: ``pop_size -> Algorithm`` builder for the
+        regrown algorithm (same hyperparameters, new population size).
+        Resume needs the same factory configured to reconstruct templates.
+    :param growth_factor: multiplicative population growth per restart
+        (IPOP default 2.0).
+    :param max_pop_size: hard cap on the regrown population (``None`` =
+        uncapped).
+    :param preserve_elite: inject the incumbent best into the new
+        population/mean (on by default).
+    :param salt: base PRNG fold value, offset by the restart index.
+    """
+
+    name = "reinit_larger_population"
+
+    def __init__(
+        self,
+        algorithm_factory: Callable[[int], Any],
+        growth_factor: float = 2.0,
+        max_pop_size: int | None = None,
+        preserve_elite: bool = True,
+        salt: int = 0x1B0B,
+    ):
+        if growth_factor <= 1.0:
+            raise ValueError(
+                f"growth_factor must be > 1.0 (the population must grow), "
+                f"got {growth_factor}"
+            )
+        if max_pop_size is not None and max_pop_size < 1:
+            raise ValueError(f"max_pop_size must be >= 1, got {max_pop_size}")
+        self.algorithm_factory = algorithm_factory
+        self.growth_factor = float(growth_factor)
+        self.max_pop_size = max_pop_size
+        self.preserve_elite = preserve_elite
+        self.salt = int(salt)
+
+    # carried monitor keys: scalar/metric state that must survive a regrow.
+    _CARRY_MONITOR = (
+        "topk_solutions",
+        "topk_fitness",
+        "generation",
+        "instance_id",
+        "num_nonfinite",
+        "num_restarts",
+    )
+
+    def _new_pop_size(self, current: int) -> int:
+        new_pop = max(int(round(current * self.growth_factor)), current + 1)
+        if self.max_pop_size is not None:
+            new_pop = min(new_pop, self.max_pop_size)
+        return new_pop
+
+    def _rebuild(self, workflow: Any, runner: "ResilientRunner", pop_size: int):
+        if not hasattr(workflow, "algorithm"):
+            raise ValueError(
+                f"{self.name} needs a workflow with a mutable `.algorithm` "
+                f"attribute (e.g. StdWorkflow); got {type(workflow).__name__}"
+            )
+        workflow.algorithm = self.algorithm_factory(pop_size)
+        runner._rebind_workflow()
+
+    def apply(self, ctx: RestartContext):
+        algo = getattr(ctx.workflow, "algorithm", None)
+        current = getattr(algo, "pop_size", None)
+        if current is None:
+            raise ValueError(
+                f"{self.name} needs a workflow whose `.algorithm` exposes "
+                f"`pop_size`; got {type(algo).__name__}"
+            )
+        new_pop = self._new_pop_size(int(current))
+        best, _ = incumbent_best(ctx.state)
+
+        key = _first_prng_key(ctx.state)
+        if key is None:
+            key = jax.random.key(self.salt)
+        key = jax.random.fold_in(key, self.salt + ctx.restart_index)
+
+        self._rebuild(ctx.workflow, ctx.runner, new_pop)
+        fresh = getattr(ctx.workflow, "init", ctx.workflow.setup)(key)
+
+        algo_state = _subtree(fresh, "algorithm")
+        if algo_state is None:
+            raise ValueError(
+                f"{self.name} expects workflow.init() to return a state with "
+                f"an 'algorithm' sub-state; got keys {list(fresh)}"
+            )
+        if self.preserve_elite and best is not None:
+            pop = _subtree(algo_state, "pop")
+            mean = _subtree(algo_state, "mean")
+            if (
+                pop is not None
+                and getattr(pop, "ndim", 0) == 2
+                and pop.shape[1] == best.shape[0]
+            ):
+                updates = {"pop": pop.at[0].set(best.astype(pop.dtype))}
+                # Personal-best buffers sampled in setup() still point at
+                # the pre-injection random row 0; keep them coherent so the
+                # elite's (good) fitness never gets attributed to a
+                # discarded position.
+                lbl = _subtree(algo_state, "local_best_location")
+                if lbl is not None and lbl.shape == pop.shape:
+                    updates["local_best_location"] = lbl.at[0].set(
+                        best.astype(lbl.dtype)
+                    )
+                algo_state = algo_state.replace(**updates)
+            elif mean is not None and mean.shape == best.shape:
+                algo_state = algo_state.replace(mean=best.astype(mean.dtype))
+
+        state = fresh.replace(algorithm=algo_state)
+        mon_state = _subtree(fresh, "monitor")
+        old_mon = _subtree(ctx.state, "monitor")
+        if old_mon is not None and isinstance(mon_state, State):
+            carried = {
+                k: old_mon[k]
+                for k in self._CARRY_MONITOR
+                if k in old_mon and k in mon_state
+            }
+            if carried:
+                state = state.replace(monitor=mon_state.replace(**carried))
+        old_problem = _subtree(ctx.state, "problem")
+        if old_problem is not None and "problem" in fresh:
+            state = state.replace(problem=old_problem)
+        return state, ctx.generation, True, {"pop_size": new_pop}
+
+    def rebuild_template(self, workflow, template, lineage, runner=None):
+        events = [e for e in lineage if e.policy == self.name]
+        if not events or runner is None:
+            return template
+        self._rebuild(workflow, runner, int(events[-1].detail["pop_size"]))
+        # Only structure (shapes/dtypes/treedef) matters for a template;
+        # the key value is irrelevant.
+        return getattr(workflow, "init", workflow.setup)(jax.random.key(0))
+
+
+class PerturbAroundBest(RestartPolicy):
+    """Re-seed the population as a Gaussian cloud around the incumbent best.
+
+    Shapes are preserved (no recompilation beyond PRNG perturbation): the
+    new population is ``best + scale * width * N(0, 1)`` — ``width`` being
+    the per-dimension search-space width when the algorithm exposes
+    ``lb``/``ub`` bounds (samples are clipped back into them), else 1.0 —
+    with the incumbent itself kept unperturbed in row 0 and stale fitness
+    reset to worst so the next generation re-ranks from scratch.  Mean-based
+    ES states (no ``pop``) get ``mean := best`` and, when the algorithm
+    exposes a ``sigma_init``, a step-size reset.
+
+    :param scale: cloud radius as a fraction of the search-space width.
+    :param salt: base PRNG fold value, offset by the restart index.
+    """
+
+    name = "perturb_around_best"
+
+    def __init__(self, scale: float = 0.1, salt: int = 0xBE57):
+        if scale <= 0:
+            raise ValueError(f"scale must be > 0, got {scale}")
+        self.scale = float(scale)
+        self.salt = int(salt)
+
+    def apply(self, ctx: RestartContext):
+        best, best_fit = incumbent_best(ctx.state)
+        state = perturb_prng_keys(ctx.state, self.salt + ctx.restart_index)
+        if best is None:
+            return state, ctx.generation, False, {"note": "no incumbent; PRNG perturbation only"}
+
+        algo_state = state["algorithm"] if "algorithm" in state else state
+        algo = getattr(ctx.workflow, "algorithm", None)
+        lb = getattr(algo, "lb", None)
+        ub = getattr(algo, "ub", None)
+
+        pop = _subtree(algo_state, "pop")
+        detail: dict[str, Any] = {"scale": self.scale}
+        if (
+            pop is not None
+            and getattr(pop, "ndim", 0) == 2
+            and pop.shape[1] == best.shape[0]
+        ):
+            width = (
+                (ub - lb).astype(pop.dtype)
+                if lb is not None and ub is not None
+                else jnp.ones((), pop.dtype)
+            )
+            noise_key = _first_prng_key(algo_state)
+            if noise_key is None:
+                noise_key = jax.random.key(self.salt)
+            noise_key = jax.random.fold_in(noise_key, ctx.restart_index + 1)
+            cloud = best.astype(pop.dtype) + self.scale * width * jax.random.normal(
+                noise_key, pop.shape, dtype=pop.dtype
+            )
+            cloud = cloud.at[0].set(best.astype(pop.dtype))
+            if lb is not None and ub is not None:
+                cloud = jnp.clip(cloud, lb, ub)
+            updates: dict[str, Any] = {"pop": cloud}
+            # Stale per-position records belong to the COLLAPSED positions;
+            # left in place they drag the fresh cloud straight back into
+            # the collapse (a particle's personal best would still be the
+            # old point, carrying its old score).  Re-anchor personal-best
+            # locations on the cloud and worst-out the stale scores so the
+            # next evaluation re-establishes them honestly.
+            fit = _subtree(algo_state, "fit")
+            if (
+                fit is not None
+                and getattr(fit, "ndim", 0) == 1
+                and jnp.issubdtype(fit.dtype, jnp.floating)
+            ):
+                updates["fit"] = jnp.full_like(fit, jnp.inf)
+            lbl = _subtree(algo_state, "local_best_location")
+            lbf = _subtree(algo_state, "local_best_fit")
+            if lbl is not None and lbl.shape == cloud.shape:
+                updates["local_best_location"] = cloud.astype(lbl.dtype)
+            if (
+                lbf is not None
+                and getattr(lbf, "ndim", 0) == 1
+                and jnp.issubdtype(lbf.dtype, jnp.floating)
+            ):
+                updates["local_best_fit"] = jnp.full_like(lbf, jnp.inf)
+            algo_state = algo_state.replace(**updates)
+            detail["reseeded"] = "pop"
+        else:
+            mean = _subtree(algo_state, "mean")
+            if mean is not None and mean.shape == best.shape:
+                algo_state = algo_state.replace(mean=best.astype(mean.dtype))
+                sigma = _subtree(algo_state, "sigma")
+                sigma_init = getattr(algo, "sigma_init", None)
+                if sigma is not None and sigma_init is not None:
+                    algo_state = algo_state.replace(
+                        sigma=jnp.asarray(sigma_init, dtype=sigma.dtype)
+                        * jnp.ones_like(sigma)
+                    )
+                detail["reseeded"] = "mean"
+            else:
+                detail["reseeded"] = None
+
+        if "algorithm" in state:
+            state = state.replace(algorithm=algo_state)
+        else:
+            state = algo_state
+        return state, ctx.generation, False, detail

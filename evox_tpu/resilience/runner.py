@@ -51,6 +51,8 @@ from ..utils.checkpoint import (
     read_manifest,
     save_state,
 )
+from .health import HealthProbe, HealthReport
+from .restart import RestartContext, RestartEvent, RestartPolicy
 
 __all__ = [
     "ResilientRunner",
@@ -152,7 +154,13 @@ class RetryPolicy:
 
 @dataclass
 class RunStats:
-    """Observable record of what the supervisor did during :meth:`run`."""
+    """Observable record of what the supervisor did during :meth:`run`.
+
+    ``restarts`` is the run's full restart lineage — on resume it is
+    restored from the checkpoint manifest, so events fired before a kill
+    stay visible.  ``last_report`` is the most recent
+    :class:`~evox_tpu.resilience.HealthReport` (``None`` when the runner
+    has no health probe)."""
 
     resumed_from_generation: int | None = None
     completed_generations: int = 0
@@ -162,6 +170,10 @@ class RunStats:
     cpu_fallbacks: int = 0
     checkpoints_written: int = 0
     failures: list[str] = field(default_factory=list)
+    health_checks: int = 0
+    unhealthy_probes: int = 0
+    restarts: list[RestartEvent] = field(default_factory=list)
+    last_report: HealthReport | None = None
 
 
 def _numbered_checkpoints(
@@ -226,6 +238,9 @@ class ResilientRunner:
         cpu_fallback: bool = False,
         keep_checkpoints: int = 3,
         on_event: Callable[[str], None] | None = None,
+        health: HealthProbe | None = None,
+        restart: RestartPolicy | None = None,
+        max_restarts: int = 5,
     ):
         """
         :param workflow: any ``Workflow`` whose ``init_step``/``step`` are
@@ -261,6 +276,22 @@ class ResilientRunner:
             per supervisor event (resume/retry/fallback/checkpoint) —
             defaults to ``warnings.warn`` for failures and silence for
             routine events.
+        :param health: optional :class:`~evox_tpu.resilience.HealthProbe`
+            run on the state at every chunk boundary (after the segment,
+            before the next one) — detects degenerate searches (non-finite
+            state, diversity collapse, step-size blow-up, stagnation) that
+            never raise.  Reports land in ``stats.last_report``.
+        :param restart: optional
+            :class:`~evox_tpu.resilience.RestartPolicy` applied when the
+            probe returns an unhealthy verdict (requires ``health``);
+            ``None`` downgrades unhealthy verdicts to warnings.  Fired
+            restarts are recorded in ``stats.restarts`` and in every later
+            checkpoint's manifest, so a resumed run replays them
+            bit-identically.
+        :param max_restarts: restart budget per :meth:`run`; once spent,
+            further unhealthy verdicts warn but the run continues (an
+            unhealthy run that finishes is still better than an aborted
+            one).
         """
         if checkpoint_every < 1:
             raise ValueError(
@@ -269,6 +300,14 @@ class ResilientRunner:
         if keep_checkpoints < 0:
             raise ValueError(
                 f"keep_checkpoints must be >= 0, got {keep_checkpoints}"
+            )
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        if restart is not None and health is None:
+            raise ValueError(
+                "a restart policy needs a health probe to trigger it; pass "
+                "health=HealthProbe(...) alongside restart="
+                f"{type(restart).__name__}(...)"
             )
         self.workflow = workflow
         self.checkpoint_dir = Path(checkpoint_dir)
@@ -279,11 +318,28 @@ class ResilientRunner:
         self.cpu_fallback = cpu_fallback
         self.keep_checkpoints = int(keep_checkpoints)
         self.on_event = on_event
+        self.health = health
+        self.restart = restart
+        self.max_restarts = int(max_restarts)
         self.stats = RunStats()
         self._forced_cpu = False
+        # Restart policies may swap ``workflow.algorithm`` (population
+        # regrows); remember the base configuration so every run() starts
+        # from it and resume can replay the recorded lineage on top.
+        self._base_algorithm = getattr(workflow, "algorithm", None)
+        # Manifest flag of the checkpoint a resume landed on: True when the
+        # boundary was already probed before the write (post-restart
+        # checkpoints), so the resumed run must not probe it again.
+        self._resumed_probed = False
+        self._rebind_workflow()
+
+    def _rebind_workflow(self) -> None:
+        """(Re-)derive jit-traced programs and drop AOT executables — called
+        at construction and whenever a restart policy mutates the workflow
+        (a stale trace would silently run the OLD algorithm)."""
         # One compiled program per distinct chunk length (at most two: the
         # steady chunk and the final ragged one).
-        self._jit_init_step = jax.jit(workflow.init_step)
+        self._jit_init_step = jax.jit(self.workflow.init_step)
         self._jit_segment = jax.jit(self._segment, static_argnums=1)
         # AOT-compiled executables keyed by (program, chunk, backend, state
         # signature): compiled OUTSIDE the watchdog so cold-compile latency
@@ -307,9 +363,35 @@ class ResilientRunner:
     def _ckpt_path(self, generation: int) -> Path:
         return self.checkpoint_dir / f"ckpt_{generation:08d}.npz"
 
-    def _write_checkpoint(self, state: State, generation: int) -> None:
+    def _manifest_extras(self, probed: bool) -> dict | None:
+        """Health/restart context riding in the checkpoint manifest so a
+        resumed run replays probe decisions and restart lineage exactly:
+
+        * ``restarts`` — the :class:`RestartEvent` lineage so far;
+        * ``health_window`` — the probe's stagnation window *as of this
+          write* (pre-probe for ordinary boundary checkpoints);
+        * ``health_probed`` — whether this boundary was already probed
+          before the write (post-restart checkpoints), i.e. whether a
+          resume must re-probe it.
+        """
+        if self.health is None:
+            return None
+        return {
+            "restarts": [e.to_manifest() for e in self.stats.restarts],
+            "health_window": list(self.health.window),
+            "health_probed": bool(probed),
+        }
+
+    def _write_checkpoint(
+        self, state: State, generation: int, *, probed: bool = False
+    ) -> None:
         self.checkpoint_dir.mkdir(parents=True, exist_ok=True)
-        save_state(self._ckpt_path(generation), state, generation=generation)
+        save_state(
+            self._ckpt_path(generation),
+            state,
+            generation=generation,
+            metadata=self._manifest_extras(probed),
+        )
         self.stats.checkpoints_written += 1
         self._event(f"checkpoint written at generation {generation}")
         if self.keep_checkpoints:
@@ -326,9 +408,16 @@ class ResilientRunner:
         Returns ``(state, completed_generations)`` or ``None`` when no
         usable checkpoint exists.  Invalid/torn/mismatched files are skipped
         with a warning, newest-first, so one bad file cannot lose the run.
+
+        Checkpoints written after a restart carry the restart lineage and
+        the health probe's stagnation window in their manifest; resume
+        replays the lineage (rebuilding the validation template when a
+        restart changed state shapes — population regrows) and restores the
+        window, so the continued run reaches bit-identical decisions.
         """
         if not self.checkpoint_dir.is_dir():
             return None
+        self._resumed_probed = False
         for gen, path in reversed(_numbered_checkpoints(self.checkpoint_dir)):
             try:
                 manifest = read_manifest(path)
@@ -337,15 +426,72 @@ class ResilientRunner:
                         f"manifest generation {manifest['generation']} does "
                         f"not match filename generation {gen}"
                     )
-                state = load_state(path, template)
+                try:
+                    lineage = [
+                        RestartEvent.from_manifest(d)
+                        for d in (manifest or {}).get("restarts", [])
+                    ]
+                    # Each candidate is validated under ITS lineage: start
+                    # from the base workflow configuration, then replay the
+                    # recorded restarts on top (a previous candidate may
+                    # have left the workflow mutated).
+                    self._reset_base_algorithm()
+                    candidate_template = template
+                    if lineage and self.restart is not None:
+                        candidate_template = self.restart.rebuild_template(
+                            self.workflow, template, lineage, runner=self
+                        )
+                except (CheckpointError, ValueError):
+                    raise
+                except Exception as e:
+                    # A malformed lineage entry (KeyError) or a failing
+                    # user-supplied rebuild must skip THIS candidate, not
+                    # abort the whole resume ("one bad file cannot lose
+                    # the run").
+                    raise CheckpointError(
+                        f"restart lineage in manifest is unusable: {e!r}"
+                    ) from e
+                # allow_missing: state schemas gain leaves between versions
+                # (PR 1 added num_nonfinite, this layer adds num_restarts /
+                # corruption); a pre-upgrade checkpoint keeps the template's
+                # value for new leaves (with a warning) instead of losing
+                # the whole run to a schema bump.
+                state = load_state(path, candidate_template, allow_missing=True)
             except (CheckpointError, ValueError) as e:
                 self._event(
                     f"skipping unusable checkpoint {path.name}: {e}", warn=True
                 )
                 continue
+            if lineage:
+                self.stats.restarts = lineage
+                self._event(
+                    f"restored restart lineage of {len(lineage)} event(s) "
+                    f"from {path.name}"
+                )
+            if self.health is not None and manifest:
+                self.health.restore(manifest.get("health_window", []))
+                self._resumed_probed = bool(
+                    manifest.get("health_probed", False)
+                )
             self._event(f"resumed from {path.name} (generation {gen})")
             return state, gen
+        # No candidate was usable: undo any workflow mutation a failed
+        # candidate's lineage replay left behind, so the fresh start that
+        # follows runs the base configuration.
+        self._reset_base_algorithm()
         return None
+
+    def _reset_base_algorithm(self) -> None:
+        """Undo any restart-policy mutation of ``workflow.algorithm`` so a
+        new run (or a resume candidate without lineage) starts from the
+        configuration the runner was constructed with."""
+        if (
+            self._base_algorithm is not None
+            and getattr(self.workflow, "algorithm", None)
+            is not self._base_algorithm
+        ):
+            self.workflow.algorithm = self._base_algorithm
+            self._rebind_workflow()
 
     # -- guarded execution -------------------------------------------------
     def _cpu_device(self):
@@ -524,6 +670,109 @@ class ResilientRunner:
                 time.sleep(delay)
                 state = self._reload_for_retry(state, generation)
 
+    # -- run-health probing and restarts -----------------------------------
+    def _health_boundary(
+        self, state: State, done: int, n_steps: int
+    ) -> tuple[State, int]:
+        """Probe the state at a chunk boundary; apply the restart policy on
+        an unhealthy verdict.
+
+        Called exactly once per boundary (including right after a resume
+        whose checkpoint was written pre-probe), so the probe's stagnation
+        window — persisted in checkpoint manifests — advances identically
+        in interrupted and uninterrupted runs.  Returns the (possibly
+        restarted) state and generation count.
+        """
+        if self.health is None:
+            return state, done
+        report = self.health.check(state, generation=done)
+        self.stats.health_checks += 1
+        self.stats.last_report = report
+        if report.healthy:
+            return state, done
+        self.stats.unhealthy_probes += 1
+        reasons = "; ".join(report.reasons)
+        if self.restart is None or done >= n_steps:
+            self._event(
+                f"unhealthy state at generation {done}: {reasons}", warn=True
+            )
+            return state, done
+        if len(self.stats.restarts) >= self.max_restarts:
+            self._event(
+                f"unhealthy state at generation {done} ({reasons}) but the "
+                f"restart budget of {self.max_restarts} is spent; continuing",
+                warn=True,
+            )
+            return state, done
+        idx = len(self.stats.restarts)
+        ctx = RestartContext(
+            runner=self,
+            workflow=self.workflow,
+            state=state,
+            generation=done,
+            report=report,
+            restart_index=idx,
+            lineage=tuple(self.stats.restarts),
+        )
+        new_state, new_done, needs_init, detail = self.restart.apply(ctx)
+        event = RestartEvent(
+            generation=done,
+            policy=self.restart.name,
+            restart_index=idx,
+            reasons=list(report.reasons),
+            detail=detail,
+        )
+        self.stats.restarts.append(event)
+        self._event(
+            f"restart #{idx + 1} ({self.restart.name}) at generation {done}: "
+            f"{reasons}",
+            warn=True,
+        )
+        # Give the restarted search a full window to prove itself: stale
+        # pre-restart entries would otherwise re-trip the stagnation
+        # detector at the very next boundary (the monitor's best-so-far is
+        # monotone, so a restart can never improve it instantly) and
+        # cascade restarts until the budget is gone.  The cleared window is
+        # what later checkpoints persist, so replay stays deterministic.
+        self.health.reset()
+        # Count the restart into the monitor's in-state metrics so it is
+        # visible from the checkpointed state itself (EvalMonitor surfaces
+        # it as ``num_restarts``), not only from host-side stats.
+        monitor = getattr(self.workflow, "monitor", None)
+        if monitor is not None and "monitor" in new_state:
+            new_state = new_state.replace(
+                monitor=monitor.record_restart(new_state["monitor"])
+            )
+        if needs_init:
+            # Fresh-setup policies hand back a pre-init state: drive it
+            # through one init segment (with the full retry ladder) before
+            # chunking resumes.  That evaluation costs one generation of
+            # budget, like any other.
+            new_state = self._attempt(
+                "init",
+                new_state,
+                new_done,
+                f"restart init (generation {new_done + 1})",
+            )
+            new_done += 1
+            self.stats.segments_run += 1
+        # Publish the post-restart state and invalidate the stale future:
+        # checkpoints beyond it belong to the abandoned trajectory and must
+        # not hijack a later resume.
+        self._write_checkpoint(new_state, new_done, probed=not needs_init)
+        for gen, path in _numbered_checkpoints(self.checkpoint_dir):
+            if gen > new_done:
+                try:
+                    path.unlink()
+                except OSError:  # pragma: no cover - racing cleaners
+                    pass
+        self.stats.completed_generations = new_done
+        if needs_init:
+            # The post-init state is a fresh boundary of its own: probe it
+            # (the restart budget bounds recursion depth).
+            return self._health_boundary(new_state, new_done, n_steps)
+        return new_state, new_done
+
     # -- the supervisor loop -----------------------------------------------
     def run(
         self,
@@ -552,7 +801,15 @@ class ResilientRunner:
         # A previous run's CPU fallback must not pin THIS run to the CPU
         # backend: give the (possibly recovered) accelerator a fresh chance.
         self._forced_cpu = False
+        # Likewise, a previous run's restarts must not leak search
+        # configuration or probe history into this one; resume restores
+        # both from the checkpoint manifest as needed.
+        self._reset_base_algorithm()
+        self._resumed_probed = False
+        if self.health is not None:
+            self.health.reset()
         done = 0
+        probed = False
         if fresh and self.checkpoint_dir.is_dir():
             # Clear the old lineage: stale higher-generation files would
             # otherwise survive pruning (which keeps the N highest numbers)
@@ -574,6 +831,7 @@ class ResilientRunner:
                     )
                 self.stats.resumed_from_generation = done
                 self.stats.completed_generations = done
+                probed = self._resumed_probed
         if done == 0:
             state = self._attempt(
                 "init", state, 0, "init_step (generation 1)"
@@ -582,7 +840,17 @@ class ResilientRunner:
             self.stats.segments_run += 1
             self.stats.completed_generations = done
             self._write_checkpoint(state, done)
-        while done < n_steps:
+            probed = False
+        while True:
+            if not probed:
+                # Every boundary is probed exactly once — ordinary
+                # checkpoints are written pre-probe, so a resume re-probes
+                # its landing boundary and reaches the same verdict an
+                # uninterrupted run did.
+                state, done = self._health_boundary(state, done, n_steps)
+                probed = True
+            if done >= n_steps:
+                break
             chunk = min(self.checkpoint_every, n_steps - done)
             state = self._attempt(
                 "segment",
@@ -595,4 +863,5 @@ class ResilientRunner:
             self.stats.segments_run += 1
             self.stats.completed_generations = done
             self._write_checkpoint(state, done)
+            probed = False
         return state

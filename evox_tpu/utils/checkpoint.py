@@ -17,7 +17,13 @@ expected — the file either has the old complete contents or the new ones.
 Every checkpoint carries a ``__manifest__`` entry (JSON: generation number,
 library/jax versions, leaf count, wall-clock) so resume logic can pick the
 newest valid checkpoint without deserializing the whole state; read it with
-:func:`read_manifest`.
+:func:`read_manifest`.  Callers can ride extra JSON entries via
+``save_state(..., metadata=...)`` — the resilience layer uses this to record
+the run's **restart lineage** (``manifest["restarts"]``: one dict per fired
+:class:`~evox_tpu.resilience.RestartEvent`) and the health probe's
+stagnation window (``manifest["health_window"]``/``["health_probed"]``), so
+a resumed run replays restart decisions bit-identically; see
+``resilience/runner.py``.
 
 For sharded multi-host state, prefer ``orbax.checkpoint`` with the same
 pytree (it handles per-shard async writes); these helpers cover the
@@ -176,7 +182,12 @@ def load_state(
     never a raw ``KeyError`` or a downstream shape blow-up:
 
     * a leaf missing from the checkpoint (unless ``allow_missing``);
-    * a shape mismatch between the stored array and the template leaf;
+    * a shape mismatch between the stored array and the template leaf —
+      EXCEPT when the template leaf is a size-0 **placeholder** (monitor
+      buffers like ``latest_fitness`` start as ``jnp.empty((0,))`` and only
+      take their real shape after the first step): a placeholder adopts the
+      stored array's shape, since a freshly ``init()``-ed template cannot
+      know it;
     * a dtype mismatch that cannot be cast safely (``same_kind``: width
       changes like ``float64 -> float32`` from an x64-enabled writer are
       tolerated and cast; kind changes like ``float -> int`` are not).
@@ -217,6 +228,23 @@ def load_state(
         elif name in data:
             arr = data[name]
             if hasattr(leaf, "shape") and tuple(arr.shape) != tuple(leaf.shape):
+                if getattr(leaf, "size", None) == 0:
+                    # Size-0 placeholder: the template was built before the
+                    # first step shaped this buffer — adopt the stored shape
+                    # (the dtype still goes through the same same-kind
+                    # check/cast as real-shaped leaves below).
+                    if hasattr(leaf, "dtype") and arr.dtype != leaf.dtype:
+                        if not np.can_cast(
+                            arr.dtype, leaf.dtype, casting="same_kind"
+                        ):
+                            raise CheckpointError(
+                                f"checkpoint {path}: leaf {name!r} has dtype "
+                                f"{arr.dtype}, which cannot be safely cast "
+                                f"to the template's {leaf.dtype}"
+                            )
+                        arr = arr.astype(leaf.dtype)
+                    new_leaves.append(jax.numpy.asarray(arr))
+                    continue
                 raise CheckpointError(
                     f"checkpoint {path}: leaf {name!r} has shape "
                     f"{tuple(arr.shape)}, but the template expects "

@@ -124,6 +124,9 @@ class EvalMonitor(Monitor):
             # non-finite and was quarantined by the workflow
             # (``StdWorkflow(quarantine_nonfinite=True)``).
             num_nonfinite=jnp.int32(0),
+            # Automatic restarts applied to this run by a supervising
+            # ``ResilientRunner`` health/restart policy.
+            num_restarts=jnp.int32(0),
         )
 
     # -- host side channel --------------------------------------------------
@@ -216,6 +219,17 @@ class EvalMonitor(Monitor):
             num_nonfinite=state.num_nonfinite
             + jnp.sum(mask, dtype=jnp.int32)
         )
+
+    def record_restart(self, state: State) -> State:
+        """Count an automatic restart (fired by a supervising
+        ``ResilientRunner`` restart policy) into the cumulative
+        ``num_restarts`` metric.  Runs on the host between jitted chunks —
+        the counter lives in the monitor state, so it is checkpointed and
+        survives kill-and-resume with the rest of the run."""
+        if "num_restarts" not in state:
+            # Pre-metric checkpoints / custom setups may lack the counter.
+            return state
+        return state.replace(num_restarts=state.num_restarts + 1)
 
     def record_auxiliary(self, state: State, aux: dict[str, jax.Array]) -> State:
         if self.full_pop_history:
@@ -319,6 +333,12 @@ class EvalMonitor(Monitor):
         fitness (requires ``StdWorkflow(quarantine_nonfinite=True)``, the
         default)."""
         return state.num_nonfinite
+
+    def get_num_restarts(self, state: State) -> jax.Array:
+        """Cumulative count of automatic restarts applied to this run by a
+        supervising ``ResilientRunner`` restart policy (0 for unsupervised
+        runs)."""
+        return state.num_restarts
 
     def get_topk_fitness(self, state: State) -> jax.Array:
         """Best ``topk`` fitness values so far (original sign restored)."""

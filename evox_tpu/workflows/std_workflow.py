@@ -216,10 +216,20 @@ class StdWorkflow(Workflow):
         """Replace non-finite fitness with a worst-case penalty (sign chosen
         so the quarantined individual loses under the configured direction)
         and report the per-individual mask to the monitor.  Pure/jittable;
-        a no-op when disabled or for non-floating fitness dtypes."""
-        if not self.quarantine_nonfinite or not jnp.issubdtype(
-            fit.dtype, jnp.floating
-        ):
+        a no-op when disabled.
+
+        Integer/bool fitness cannot hold NaN/±Inf, so there is nothing to
+        substitute — but the monitor still receives its (all-clear) mask:
+        short-circuiting past ``record_nonfinite`` would silently starve
+        monitors that key per-evaluation bookkeeping off the hook, making
+        metrics depend on the fitness dtype."""
+        if not self.quarantine_nonfinite:
+            return fit, mon
+        if not jnp.issubdtype(fit.dtype, jnp.floating):
+            n_rows = fit.shape[0]
+            mon = self.monitor.record_nonfinite(
+                mon, jnp.zeros((n_rows,), dtype=bool)
+            )
             return fit, mon
         # Clamp the penalty into the dtype's finite range: 1e30 would itself
         # round to inf in float16/bfloat16 fitness, defeating the quarantine.
@@ -237,6 +247,47 @@ class StdWorkflow(Workflow):
             row_mask, jnp.asarray(self.opt_direction * penalty, fit.dtype), fit
         )
         return fit, mon
+
+    # -- run-health surface -------------------------------------------------
+    def health_metrics(self, state: State) -> dict[str, jax.Array]:
+        """Jittable snapshot of the run-health metrics the resilience
+        layer's :class:`~evox_tpu.resilience.HealthProbe` thresholds —
+        exposed here so monitors/dashboards can surface them without
+        constructing a probe:
+
+        * ``nonfinite_state_values`` — count of NaN/±Inf scalars anywhere in
+          the state pytree (floating leaves; PRNG keys skipped);
+        * ``pop_diversity`` — largest per-dimension std of the population
+          (when the algorithm state carries a 2-D ``pop``);
+        * ``step_size_min`` / ``step_size_max`` — extrema of the ES
+          ``sigma`` leaf (when present);
+        * ``best_fitness`` — monitor top-k best (minimizing frame) when
+          available, else ``min(state.algorithm.fit)``;
+        * ``num_nonfinite`` / ``num_restarts`` — the monitor's cumulative
+          quarantine/restart counters (when the monitor tracks them).
+
+        Keys are present only when the underlying state supports them, so
+        the dict is stable per workflow configuration."""
+        from ..resilience.health import scan_state
+
+        raw = scan_state(state, diversity=True, step_size=True)
+        out: dict[str, jax.Array] = {}
+        nonfinite = raw.get("nonfinite")
+        if nonfinite:
+            out["nonfinite_state_values"] = sum(nonfinite.values())
+        if "diversity" in raw:
+            out["pop_diversity"] = raw["diversity"]
+        if "step_size_min" in raw:
+            out["step_size_min"] = raw["step_size_min"]
+            out["step_size_max"] = raw["step_size_max"]
+        if "best_fitness" in raw:
+            out["best_fitness"] = raw["best_fitness"]
+        mon = state.monitor if "monitor" in state else None
+        if mon is not None:
+            for key in ("num_nonfinite", "num_restarts"):
+                if key in mon:
+                    out[key] = mon[key]
+        return out
 
     # -- stepping ----------------------------------------------------------
     def _step(self, state: State, which: str) -> State:

@@ -7,7 +7,10 @@ Demonstrates the ``evox_tpu.resilience`` layer end-to-end on CPU:
    by retry-with-backoff;
 3. a simulated process kill recovered by auto-resume from the newest
    checkpoint — bit-identical to the uninterrupted run;
-4. NaN fitness quarantined in-graph and counted by the monitor.
+4. NaN fitness quarantined in-graph and counted by the monitor;
+5. a degenerate search (injected stagnation plateau) detected by the
+   between-chunk ``HealthProbe`` and recovered by an automatic restart
+   policy, with the restart lineage recorded in the checkpoint manifest.
 
 Run with:
 
@@ -23,7 +26,15 @@ import numpy as np
 
 from evox_tpu.algorithms import PSO
 from evox_tpu.problems.numerical import Ackley
-from evox_tpu.resilience import FaultyProblem, ResilientRunner, RetryPolicy
+from evox_tpu.resilience import (
+    FaultyProblem,
+    HealthProbe,
+    PerturbAroundBest,
+    ResilientRunner,
+    RetryPolicy,
+    latest_checkpoint,
+)
+from evox_tpu.utils import read_manifest
 from evox_tpu.workflows import EvalMonitor, StdWorkflow
 
 DIM = 16
@@ -103,3 +114,33 @@ best = float(nan_mon.get_best_fitness(s.monitor))
 quarantined = int(nan_mon.get_num_nonfinite(s.monitor))
 assert np.isfinite(best) and best < 1e29
 print(f"quarantined {quarantined} NaN evaluations; best stayed {best:.4f}")
+
+# -- 5. degenerate search detected + restarted ------------------------------
+# Evaluations 3..7 are clamped to a sky-high floor: the best fitness
+# flatlines (the stagnation signature).  The health probe flags it at a
+# chunk boundary and the perturb-around-best policy re-seeds the swarm.
+stagnating = FaultyProblem(
+    Ackley(), plateau_from=3, plateau_until=8, plateau_floor=1e6
+)
+health_mon = EvalMonitor()
+wf_health = StdWorkflow(PSO(64, LB, UB), stagnating, monitor=health_mon)
+health_runner = ResilientRunner(
+    wf_health,
+    f"{workdir}/health",
+    checkpoint_every=3,
+    health=HealthProbe(stagnation_window=2, stagnation_tol=1e-9),
+    restart=PerturbAroundBest(scale=0.05),
+)
+s = health_runner.run(wf_health.init(jax.random.key(4)), N_STEPS)
+for event in health_runner.stats.restarts:
+    print(
+        f"restart #{event.restart_index + 1} ({event.policy}) at "
+        f"generation {event.generation}: {event.reasons[0]}"
+    )
+manifest = read_manifest(latest_checkpoint(f"{workdir}/health"))
+assert len(manifest["restarts"]) == len(health_runner.stats.restarts)
+print(
+    f"health run: {int(health_mon.get_num_restarts(s.monitor))} restart(s) "
+    f"recorded in monitor + manifest; best "
+    f"{float(health_mon.get_best_fitness(s.monitor)):.4f}"
+)

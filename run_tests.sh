@@ -5,12 +5,27 @@
 #   ./run_tests.sh              fast lane (deselects @pytest.mark.slow)
 #   ./run_tests.sh --all        everything, incl. the convergence-quality lane
 #   ./run_tests.sh --faults     fault-injection smoke lane (resilience layer:
-#                               retry/backoff, watchdog, kill-and-resume, NaN
-#                               quarantine — all CPU, a few seconds)
+#                               retry/backoff, watchdog, kill-and-resume,
+#                               NaN/Inf quarantine, state corruption,
+#                               health/restart — all CPU, under two minutes)
+#   ./run_tests.sh --health     health/restart lane: run-health diagnostics +
+#                               restart-policy suite, then the CPU
+#                               microbenchmark asserting the between-chunk
+#                               probe adds <5% wall-clock overhead to a
+#                               200-generation run (artifact written under
+#                               bench_artifacts/)
 #   ./run_tests.sh --lint       repo lints (bare-assert ratchet)
 #   ./run_tests.sh <pytest args>   passthrough
+CPU_ENV=(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+         XLA_FLAGS="--xla_force_host_platform_device_count=8"
+         _EVOX_TPU_TEST_REEXEC=1)
 if [ "$1" = "--lint" ]; then
   exec python tools/lint_asserts.py
+fi
+if [ "$1" = "--health" ]; then
+  shift
+  "${CPU_ENV[@]}" python -m pytest tests/test_health_restart.py -q "$@" || exit 1
+  exec "${CPU_ENV[@]}" python tools/bench_health_overhead.py
 fi
 ARGS=()
 if [ $# -eq 0 ]; then
@@ -20,11 +35,8 @@ elif [ "$1" = "--all" ]; then
   ARGS=(tests/ -q "$@")
 elif [ "$1" = "--faults" ]; then
   shift
-  ARGS=(tests/test_resilience.py tests/test_tooling.py -q "$@")
+  ARGS=(tests/test_resilience.py tests/test_health_restart.py tests/test_tooling.py -q "$@")
 else
   ARGS=("$@")
 fi
-exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-  _EVOX_TPU_TEST_REEXEC=1 \
-  python -m pytest "${ARGS[@]}"
+exec "${CPU_ENV[@]}" python -m pytest "${ARGS[@]}"

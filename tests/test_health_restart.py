@@ -1,0 +1,812 @@
+"""Run-health diagnostics and automatic restart policies.
+
+Every detector (non-finite state, diversity collapse, step-size
+out-of-range, stagnation) is triggered by a ``FaultyProblem``-driven CPU
+run, and each restart policy (rollback / IPOP-style regrow / perturb-
+around-best) demonstrably recovers a deliberately-broken run to a finite,
+improving best fitness — with restart events visible in ``RunStats`` and
+``EvalMonitor``, and resume-after-restart bit-identical to an uninterrupted
+run (the PR-1 determinism guarantee extended to restarts).
+
+Bit-identity methodology matches ``test_resilience.py``: comparators share
+the faulted run's *program structure* (same ``FaultyProblem`` schedule with
+``*_times=0`` / disarmed windows) because XLA fusion — and therefore
+ulp-level floats — can differ between programs with and without the
+host-callback ops.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.algorithms import CMAES, PSO
+from evox_tpu.problems.numerical import Sphere
+from evox_tpu.resilience import (
+    FaultyProblem,
+    HealthProbe,
+    PerturbAroundBest,
+    ReinitLargerPopulation,
+    ResilientRunner,
+    RestartEvent,
+    RollbackToCheckpoint,
+)
+from evox_tpu.utils import read_manifest
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+DIM = 8
+LB = -10.0 * jnp.ones(DIM)
+UB = 10.0 * jnp.ones(DIM)
+
+
+def _flat(state):
+    out = []
+    for leaf in jax.tree_util.tree_leaves(state):
+        if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key
+        ):
+            out.append(np.asarray(jax.random.key_data(leaf)))
+        else:
+            out.append(np.asarray(leaf))
+    return out
+
+
+def _assert_states_identical(a, b):
+    la, lb = _flat(a), _flat(b)
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(x, y, err_msg=f"state leaf {i}")
+
+
+def _stepped(workflow, key, n_steps):
+    """init + (n_steps - 1) jitted steps, blocking."""
+    state = workflow.init(key)
+    state = jax.jit(workflow.init_step)(state)
+    step = jax.jit(workflow.step)
+    for _ in range(n_steps - 1):
+        state = step(state)
+    return jax.block_until_ready(state)
+
+
+# -- detectors ---------------------------------------------------------------
+
+
+def test_probe_clean_state_is_healthy(key):
+    wf = StdWorkflow(
+        PSO(16, LB, UB), FaultyProblem(Sphere()), monitor=EvalMonitor()
+    )
+    state = _stepped(wf, key, 3)
+    report = HealthProbe(
+        diversity_floor=1e-6, stagnation_window=3
+    ).check(state, generation=3)
+    assert report.healthy and not report.reasons
+    assert report.diversity is not None and report.diversity > 1e-6
+    assert np.isfinite(report.best_fitness)
+    assert report.generation == 3
+
+
+def test_probe_detects_in_state_corruption(key):
+    """FaultyProblem's corrupt fault writes NaN into its own (problem)
+    sub-state — fitness stays clean, the quarantine cannot see it, and only
+    the whole-pytree non-finite scan catches it."""
+    prob = FaultyProblem(Sphere(), corrupt_generations=[1])
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=EvalMonitor())
+    state = _stepped(wf, key, 2)  # evaluation index 1 corrupts the canary
+    report = HealthProbe().check(state, generation=2)
+    assert not report.healthy
+    assert report.nonfinite_leaves == {"problem/corruption": 1}
+    assert "non-finite values in state leaves" in report.reasons[0]
+    # fitness itself stayed finite: the quarantine had nothing to do
+    assert np.all(np.isfinite(np.asarray(state.algorithm.fit)))
+
+
+def test_probe_detects_nan_in_algorithm_state_with_quarantine_off(key):
+    """With the quarantine opted out, injected NaN fitness lands in the
+    algorithm state — the probe scans *all* leaves, not just fitness rows."""
+    prob = FaultyProblem(Sphere(), nan_generations=[1], nan_rows=2)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, quarantine_nonfinite=False)
+    state = _stepped(wf, key, 2)
+    report = HealthProbe().check(state, generation=2)
+    assert not report.healthy
+    assert any("algorithm/fit" in name for name in report.nonfinite_leaves)
+
+
+def test_probe_detects_diversity_collapse(key):
+    """A contractive swarm (no inertia, no cognitive pull) genuinely
+    collapses onto its global best within ~35 generations."""
+    wf = StdWorkflow(
+        PSO(16, LB, UB, w=0.0, phi_p=0.0, phi_g=0.5), FaultyProblem(Sphere())
+    )
+    state = _stepped(wf, key, 40)
+    probe = HealthProbe(diversity_floor=1e-2)
+    report = probe.check(state, generation=40)
+    assert report.diversity_collapse and not report.healthy
+    assert report.diversity < 1e-2
+    assert "diversity collapsed" in report.reasons[0]
+
+
+def test_probe_detects_step_size_out_of_range(key):
+    wf = StdWorkflow(CMAES(jnp.zeros(DIM), 1.0), FaultyProblem(Sphere()))
+    state = _stepped(wf, key, 2)
+    healthy = HealthProbe().check(state, generation=2)
+    assert not healthy.step_size_out_of_range
+    # Collapse sigma below the default floor (the degenerate-ES signature).
+    state = state.replace(
+        algorithm=state.algorithm.replace(sigma=jnp.asarray(1e-20))
+    )
+    report = HealthProbe().check(state, generation=2)
+    assert report.step_size_out_of_range and not report.healthy
+    assert "step size out of range" in report.reasons[0]
+
+
+def test_probe_detects_stagnation_from_plateau(key):
+    """A plateau fault clamps all fitness above a sky-high floor, so the
+    best-so-far flatlines and the sliding-window detector fires."""
+    prob = FaultyProblem(Sphere(), plateau_from=2, plateau_floor=1e6)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=EvalMonitor())
+    probe = HealthProbe(stagnation_window=3, stagnation_tol=1e-9)
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    reports = []
+    for gen in range(2, 8):
+        state = step(state)
+        reports.append(probe.check(state, generation=gen))
+    # Window fills with the frozen best: the tail reports must flag it.
+    assert reports[-1].stagnating and not reports[-1].healthy
+    assert reports[-1].stagnation_improvement == 0.0
+    assert "stagnating" in reports[-1].reasons[0]
+
+
+def test_probe_nonfinite_skip_exempts_leaves(key):
+    prob = FaultyProblem(Sphere(), corrupt_generations=[1])
+    wf = StdWorkflow(PSO(16, LB, UB), prob)
+    state = _stepped(wf, key, 2)
+    report = HealthProbe(nonfinite_skip=("corruption",)).check(state, 2)
+    assert report.healthy
+
+
+def test_probe_input_validation():
+    with pytest.raises(ValueError, match="stagnation_window"):
+        HealthProbe(stagnation_window=-1)
+    # A window of 1 compares a value against itself (improvement always 0):
+    # every probe would read as stagnant.
+    with pytest.raises(ValueError, match="cannot measure improvement"):
+        HealthProbe(stagnation_window=1)
+    with pytest.raises(ValueError, match="step_size_range"):
+        HealthProbe(step_size_range=(1.0, 0.5))
+
+
+def test_runner_requires_probe_for_restart_policy(tmp_path):
+    wf = StdWorkflow(PSO(16, LB, UB), Sphere())
+    with pytest.raises(ValueError, match="health probe"):
+        ResilientRunner(wf, tmp_path, restart=RollbackToCheckpoint())
+
+
+# -- restart policies recover broken runs ------------------------------------
+
+
+def test_rollback_recovers_corrupted_run(tmp_path, key):
+    """In-state corruption at evaluation 6 (the last eval before boundary
+    7): rollback reloads checkpoint 4 with perturbed PRNG streams, the
+    replay heals the (attempt-counted) corruption, and the run finishes
+    finite and improving."""
+    prob = FaultyProblem(Sphere(), corrupt_generations=[6], corrupt_times=1)
+    mon = EvalMonitor(full_fit_history=False)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=mon)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(),
+        restart=RollbackToCheckpoint(),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        state = runner.run(wf.init(key), 16)
+    assert [e.policy for e in runner.stats.restarts] == ["rollback"]
+    event = runner.stats.restarts[0]
+    assert event.generation == 7
+    assert event.detail == {"rolled_back_to": 4}
+    assert "non-finite" in event.reasons[0]
+    assert runner.stats.unhealthy_probes == 1
+    assert runner.stats.completed_generations == 16
+    # Restart events are visible from BOTH stats and the monitor metric.
+    assert int(mon.get_num_restarts(state.monitor)) == 1
+    best = float(mon.get_best_fitness(state.monitor))
+    # Recovered AND kept improving: a 16-generation PSO run on Sphere lands
+    # far below the ~1e2 initial best (deterministic under the fixed key).
+    assert np.isfinite(best) and best < 50.0
+
+
+def test_reinit_grows_population_preserves_elite_and_recovers(tmp_path, key):
+    """IPOP-style: corruption at evaluation 3 triggers a fresh setup with a
+    doubled population; the incumbent best and monitor metrics survive."""
+    prob = FaultyProblem(Sphere(), corrupt_generations=[3], corrupt_times=1)
+    mon = EvalMonitor(full_fit_history=False)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=mon)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(),
+        restart=ReinitLargerPopulation(lambda p: PSO(p, LB, UB)),
+    )
+    state0 = wf.init(key)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        state = runner.run(state0, 15)
+    assert [
+        (e.policy, e.detail["pop_size"]) for e in runner.stats.restarts
+    ] == [("reinit_larger_population", 32)]
+    # The run really continued with the regrown population...
+    assert state.algorithm.pop.shape == (32, DIM)
+    assert runner.stats.completed_generations == 15
+    assert int(mon.get_num_restarts(state.monitor)) == 1
+    # ...and the best-so-far metric never regressed past the regrow.
+    best = float(mon.get_best_fitness(state.monitor))
+    assert np.isfinite(best) and best < 1e29
+    # the monitor's generation counter carried across the regrow
+    assert int(state.monitor.generation) == 15
+
+
+def test_reinit_population_growth_compounds_and_caps(tmp_path, key):
+    """Two restarts compound the growth factor; max_pop_size caps it."""
+    # Corruption must land on a chunk's LAST evaluation to be visible at
+    # the boundary (the canary heals on the next eval): boundaries sit at
+    # generations 4 and — after the restart's extra init generation — 8,
+    # whose closing evaluation indices are 3 and 7.
+    prob = FaultyProblem(
+        Sphere(), corrupt_generations=[3, 7], corrupt_times=1
+    )
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=EvalMonitor())
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(),
+        restart=ReinitLargerPopulation(
+            lambda p: PSO(p, LB, UB), max_pop_size=48
+        ),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        state = runner.run(wf.init(key), 12)
+    assert [e.detail["pop_size"] for e in runner.stats.restarts] == [32, 48]
+    assert state.algorithm.pop.shape == (48, DIM)
+
+
+def test_perturb_around_best_recovers_stagnation(tmp_path, key):
+    """A plateau freezes the best-so-far; perturb-around-best re-seeds the
+    swarm (without rolling evaluations back), so the run escapes the
+    plateau window and resumes improving."""
+    prob = FaultyProblem(
+        Sphere(), plateau_from=3, plateau_until=8, plateau_floor=1e6
+    )
+    mon = EvalMonitor(full_fit_history=False)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=mon)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(stagnation_window=2, stagnation_tol=1e-9),
+        restart=PerturbAroundBest(scale=0.05),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        state = runner.run(wf.init(key), 20)
+    assert runner.stats.restarts, "stagnation never triggered a restart"
+    assert all(
+        e.policy == "perturb_around_best" for e in runner.stats.restarts
+    )
+    assert any("stagnating" in e.reasons[0] for e in runner.stats.restarts)
+    assert runner.stats.completed_generations == 20
+    assert int(mon.get_num_restarts(state.monitor)) == len(
+        runner.stats.restarts
+    )
+    # Recovered: the run escaped the plateau window and kept improving far
+    # below both the 1e6 floor and the ~1e2 initial best.
+    best = float(mon.get_best_fitness(state.monitor))
+    assert np.isfinite(best) and best < 100.0
+
+
+def test_perturb_recovers_diversity_collapse(tmp_path, key):
+    """A contractive swarm trips the diversity floor; the perturb policy
+    re-expands the cloud around the incumbent and the run completes."""
+    wf = StdWorkflow(
+        PSO(16, LB, UB, w=0.0, phi_p=0.0, phi_g=0.5),
+        FaultyProblem(Sphere()),
+        monitor=EvalMonitor(),
+    )
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=10,
+        health=HealthProbe(diversity_floor=1e-2),
+        restart=PerturbAroundBest(scale=0.05),
+        max_restarts=3,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        state = runner.run(wf.init(key), 60)
+    assert runner.stats.restarts
+    assert any(
+        "diversity collapsed" in e.reasons[0] for e in runner.stats.restarts
+    )
+    assert runner.stats.completed_generations == 60
+    assert np.all(np.isfinite(np.asarray(state.algorithm.fit)))
+
+
+def test_restart_budget_exhaustion_warns_and_continues(tmp_path, key):
+    """Permanently-unhealthy runs spend the budget, then limp to the end
+    (an unhealthy finished run beats an aborted one)."""
+    # An endless plateau: the best is frozen at the floor for the whole
+    # run, so the stagnation verdict recurs after every window refill.
+    prob = FaultyProblem(Sphere(), plateau_from=0, plateau_floor=1e6)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=EvalMonitor())
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(stagnation_window=2, stagnation_tol=1e-9),
+        restart=PerturbAroundBest(scale=0.05),
+        max_restarts=2,
+    )
+    with pytest.warns(UserWarning, match="restart budget"):
+        state = runner.run(wf.init(key), 18)
+    assert len(runner.stats.restarts) == 2
+    assert runner.stats.completed_generations == 18
+    assert runner.stats.unhealthy_probes > 2
+
+
+def test_health_without_restart_policy_warns_only(tmp_path, key):
+    prob = FaultyProblem(Sphere(), corrupt_generations=[6], corrupt_times=1)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=EvalMonitor())
+    runner = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=3, health=HealthProbe()
+    )
+    with pytest.warns(UserWarning, match="unhealthy state at generation 7"):
+        runner.run(wf.init(key), 10)
+    assert runner.stats.unhealthy_probes == 1
+    assert runner.stats.restarts == []
+    assert runner.stats.health_checks == 4  # boundaries 1, 4, 7, 10
+    assert runner.stats.last_report is not None
+
+
+# -- determinism: resume after restart ---------------------------------------
+
+
+def _perturb_setup(tmp_path, tag, fatal_times):
+    """Stagnation-driven perturb restarts + an optional fatal kill at
+    evaluation 10; all non-fatal faults are in-jit (fully deterministic)."""
+    prob = FaultyProblem(
+        Sphere(),
+        plateau_from=3,
+        plateau_until=8,
+        plateau_floor=1e6,
+        fatal_generations=[10],
+        fatal_times=fatal_times,
+    )
+    mon = EvalMonitor(full_fit_history=False)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=mon)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / tag,
+        checkpoint_every=3,
+        health=HealthProbe(stagnation_window=2, stagnation_tol=1e-9),
+        restart=PerturbAroundBest(scale=0.05),
+    )
+    return mon, wf, runner
+
+
+def test_resume_after_restart_bit_identical(tmp_path, key):
+    """Acceptance: a restart fires mid-run, the process is killed later,
+    and the resumed run — lineage and probe window restored from the
+    checkpoint manifest — finishes bit-identical to an uninterrupted run."""
+    n_steps = 18
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        _, wfc, clean_runner = _perturb_setup(tmp_path, "clean", 0)
+        clean = clean_runner.run(wfc.init(key), n_steps)
+        assert clean_runner.stats.restarts, "scenario must fire a restart"
+
+        _, wf, runner = _perturb_setup(tmp_path, "kill", 1)
+        with pytest.raises(Exception, match="NONRETRYABLE"):
+            runner.run(wf.init(key), n_steps)
+        fired_before_kill = list(runner.stats.restarts)
+        assert fired_before_kill, "a restart must fire before the kill"
+
+        # "New process": fresh runner, same directory, deliberately
+        # different init key — state, lineage and window come from disk.
+        mon2, wf2, runner2 = _perturb_setup(tmp_path, "kill", 0)
+        resumed = runner2.run(wf2.init(jax.random.key(999)), n_steps)
+    assert runner2.stats.resumed_from_generation is not None
+    _assert_states_identical(resumed, clean)
+    # The restored lineage matches the uninterrupted run's event list.
+    assert [
+        (e.generation, e.policy, e.restart_index)
+        for e in runner2.stats.restarts
+    ] == [
+        (e.generation, e.policy, e.restart_index)
+        for e in clean_runner.stats.restarts
+    ]
+    # ...and the monitor's in-state restart counter agrees.
+    assert int(mon2.get_num_restarts(resumed.monitor)) == len(
+        clean_runner.stats.restarts
+    )
+
+
+def test_resume_after_reinit_restart_rebuilds_template(tmp_path, key):
+    """Resume after an IPOP regrow: the checkpointed state has a LARGER
+    population than the base configuration, so resume must rebuild the
+    validation template from the manifest lineage before loading."""
+    n_steps = 14
+
+    def build(tag, corrupt_times, fatal_times):
+        prob = FaultyProblem(
+            Sphere(),
+            corrupt_generations=[3],
+            corrupt_times=corrupt_times,
+            fatal_generations=[9],
+            fatal_times=fatal_times,
+        )
+        mon = EvalMonitor(full_fit_history=False)
+        wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=mon)
+        runner = ResilientRunner(
+            wf,
+            tmp_path / tag,
+            checkpoint_every=3,
+            health=HealthProbe(),
+            restart=ReinitLargerPopulation(lambda p: PSO(p, LB, UB)),
+        )
+        return mon, wf, runner
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        # Comparator: corruption live (restart fires identically), no kill.
+        _, wfc, clean_runner = build("clean", 1, 0)
+        clean = clean_runner.run(wfc.init(key), n_steps)
+        assert clean.algorithm.pop.shape == (32, DIM)
+
+        _, wf, runner = build("kill", 1, 1)
+        with pytest.raises(Exception, match="NONRETRYABLE"):
+            runner.run(wf.init(key), n_steps)
+
+        # Fresh runner; both faults over (the outage passed).
+        mon2, wf2, runner2 = build("kill", 0, 0)
+        resumed = runner2.run(wf2.init(jax.random.key(999)), n_steps)
+    assert resumed.algorithm.pop.shape == (32, DIM)
+    assert runner2.stats.resumed_from_generation == 8
+    _assert_states_identical(resumed, clean)
+
+
+def test_restart_lineage_round_trips_through_manifest(tmp_path, key):
+    """Satellite: the manifest's restart lineage survives
+    ``read_manifest`` -> ``RestartEvent.from_manifest`` exactly."""
+    prob = FaultyProblem(Sphere(), corrupt_generations=[6], corrupt_times=1)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=EvalMonitor())
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(stagnation_window=4),
+        restart=RollbackToCheckpoint(),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        runner.run(wf.init(key), 13)
+    assert runner.stats.restarts
+    manifest = read_manifest(
+        sorted((tmp_path / "ck").glob("ckpt_*.npz"))[-1]
+    )
+    events = [RestartEvent.from_manifest(d) for d in manifest["restarts"]]
+    assert events == runner.stats.restarts
+    # The probe's window is persisted alongside (floats, JSON round-trip).
+    assert all(isinstance(x, float) for x in manifest["health_window"])
+    assert isinstance(manifest["health_probed"], bool)
+
+
+def test_fresh_run_clears_lineage_window_and_regrown_population(
+    tmp_path, key
+):
+    """fresh=True must not leak the previous run's restarts: the probe
+    window resets, the lineage empties, and a regrown algorithm snaps back
+    to the base configuration."""
+    prob = FaultyProblem(Sphere(), corrupt_generations=[3], corrupt_times=1)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=EvalMonitor())
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(stagnation_window=3),
+        restart=ReinitLargerPopulation(lambda p: PSO(p, LB, UB)),
+    )
+    # Build the template BEFORE run 1: the reinit restart leaves the
+    # workflow on the grown algorithm until the next run() resets it, so a
+    # template built in between would carry the grown shapes.
+    state0 = wf.init(key)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        state = runner.run(state0, 9)
+        assert state.algorithm.pop.shape == (32, DIM)
+        state2 = runner.run(state0, 9, fresh=True)
+    assert runner.stats.restarts == []  # corruption consumed in run 1
+    assert state2.algorithm.pop.shape == (16, DIM)
+
+
+def test_resume_with_eval_monitor_placeholder_template(tmp_path, key):
+    """Monitor buffers start as size-0 placeholders; a checkpoint written
+    after real steps has full shapes.  ``load_state`` adopts the stored
+    shape for placeholder leaves, so resuming with a fresh ``wf.init``
+    template works (regression: this failed before the health/restart
+    layer needed it)."""
+    schedule = dict(fatal_generations=[7], fatal_times=1)
+    mon = EvalMonitor(full_fit_history=False)
+    prob = FaultyProblem(Sphere(), **schedule)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=mon)
+    runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    with pytest.raises(Exception, match="NONRETRYABLE"):
+        runner.run(wf.init(key), 12)
+
+    resumed_runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    final = resumed_runner.run(wf.init(jax.random.key(999)), 12)
+    assert resumed_runner.stats.resumed_from_generation == 7
+
+    clean_prob = FaultyProblem(Sphere(), **dict(schedule, fatal_times=0))
+    clean_mon = EvalMonitor(full_fit_history=False)
+    clean_wf = StdWorkflow(PSO(16, LB, UB), clean_prob, monitor=clean_mon)
+    clean_runner = ResilientRunner(
+        clean_wf, tmp_path / "clean", checkpoint_every=3
+    )
+    _assert_states_identical(
+        final, clean_runner.run(clean_wf.init(key), 12)
+    )
+
+
+# -- workflow surface --------------------------------------------------------
+
+
+def test_std_workflow_health_metrics(key):
+    mon = EvalMonitor()
+    wf = StdWorkflow(PSO(16, LB, UB), FaultyProblem(Sphere()), monitor=mon)
+    state = _stepped(wf, key, 3)
+    metrics = jax.jit(wf.health_metrics)(state)
+    assert set(metrics) >= {
+        "nonfinite_state_values",
+        "pop_diversity",
+        "best_fitness",
+        "num_nonfinite",
+        "num_restarts",
+    }
+    assert int(metrics["nonfinite_state_values"]) == 0
+    assert float(metrics["pop_diversity"]) > 0
+    assert np.isfinite(float(metrics["best_fitness"]))
+    assert int(metrics["num_restarts"]) == 0
+
+
+def test_health_probe_overhead_is_small(tmp_path, key):
+    """Sanity bound in the fast lane: probing every boundary of a short
+    run must not blow up wall-clock (the real <5% assertion over 200
+    generations lives in tools/bench_health_overhead.py, run via
+    ``./run_tests.sh --health``)."""
+    import time
+
+    def run_once(tag, probe):
+        wf = StdWorkflow(
+            PSO(64, LB, UB), FaultyProblem(Sphere()), monitor=EvalMonitor()
+        )
+        runner = ResilientRunner(
+            wf, tmp_path / tag, checkpoint_every=10, health=probe
+        )
+        runner.run(wf.init(key), 40)  # warm compile caches
+        t0 = time.perf_counter()
+        runner.run(wf.init(key), 40, fresh=True)
+        return time.perf_counter() - t0
+
+    t_plain = run_once("plain", None)
+    t_health = run_once("health", HealthProbe(stagnation_window=5))
+    # Generous fast-lane bound: the probe must stay within 50% here (CI
+    # boxes are noisy); the strict 5% budget is the --health lane's job.
+    assert t_health < t_plain * 1.5 + 0.25
+
+
+# -- incumbent selection under corruption ------------------------------------
+
+
+def test_incumbent_best_ignores_nonfinite_rows(key):
+    """A policy must never re-seed around a NaN 'best': non-finite fitness
+    rows (and rows with non-finite solutions) are excluded, and a fully
+    diverged state yields no incumbent at all."""
+    from evox_tpu.core import State
+    from evox_tpu.resilience import incumbent_best
+
+    pop = jnp.arange(12.0).reshape(4, 3)
+    fit = jnp.asarray([jnp.nan, 5.0, 2.0, jnp.nan])
+    sol, best = incumbent_best(State(algorithm=State(pop=pop, fit=fit)))
+    assert float(best) == 2.0
+    np.testing.assert_array_equal(np.asarray(sol), np.asarray(pop[2]))
+
+    all_bad = State(algorithm=State(pop=pop, fit=jnp.full((4,), jnp.nan)))
+    assert incumbent_best(all_bad) == (None, None)
+
+    # A NaN-polluted monitor top-k falls through to the finite algo rows.
+    state = State(
+        algorithm=State(pop=pop, fit=fit),
+        monitor=State(
+            topk_solutions=jnp.full((1, 3), jnp.nan),
+            topk_fitness=jnp.asarray([jnp.nan]),
+        ),
+    )
+    sol, best = incumbent_best(state)
+    assert float(best) == 2.0
+
+
+def test_reinit_recovers_nan_state_without_quarantine(tmp_path, key):
+    """With the quarantine opted out, NaN fitness lands in the algorithm
+    state; the regrow policy must rebuild a finite population instead of
+    enshrining the NaN row as the elite."""
+    prob = FaultyProblem(Sphere(), nan_generations=[3], nan_rows=16)
+    wf = StdWorkflow(
+        PSO(16, LB, UB), prob, monitor=EvalMonitor(),
+        quarantine_nonfinite=False,
+    )
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(),
+        restart=ReinitLargerPopulation(lambda p: PSO(p, LB, UB)),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        state = runner.run(wf.init(key), 12)
+    assert [e.policy for e in runner.stats.restarts] == [
+        "reinit_larger_population"
+    ]
+    assert state.algorithm.pop.shape == (32, DIM)
+    # The run ended finite: the NaN generation did not poison the regrow.
+    assert np.all(np.isfinite(np.asarray(state.algorithm.fit)))
+    assert np.all(np.isfinite(np.asarray(state.algorithm.pop)))
+
+
+def test_resume_tolerates_pre_upgrade_checkpoints(tmp_path, key):
+    """Schema gains (PR 1 added num_nonfinite; this layer adds
+    num_restarts) must not strand old checkpoints: resume keeps the
+    template's value for leaves the checkpoint predates, instead of
+    skipping every file and silently restarting from generation 0."""
+    from evox_tpu.core import State
+    from evox_tpu.utils import save_state
+
+    mon = EvalMonitor(full_fit_history=False)
+    wf = StdWorkflow(PSO(16, LB, UB), FaultyProblem(Sphere()), monitor=mon)
+    runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    state = runner.run(wf.init(key), 7)
+
+    # Rewrite the newest checkpoint WITHOUT the num_restarts leaf — the
+    # shape of a checkpoint written before this layer existed.
+    old_style = state.replace(
+        monitor=State(
+            **{k: v for k, v in state.monitor.items() if k != "num_restarts"}
+        )
+    )
+    save_state(tmp_path / "ck" / "ckpt_00000007.npz", old_style, generation=7)
+
+    resumed_runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    with pytest.warns(UserWarning, match="num_restarts"):
+        out = resumed_runner.resume(wf.init(jax.random.key(1)))
+    assert out is not None
+    resumed_state, gen = out
+    assert gen == 7
+    # The missing counter fell back to the template's zero; everything
+    # else came from disk.
+    assert int(resumed_state.monitor.num_restarts) == 0
+    np.testing.assert_array_equal(
+        np.asarray(resumed_state.algorithm.pop),
+        np.asarray(state.algorithm.pop),
+    )
+
+
+def test_rollback_skips_torn_earlier_checkpoint(tmp_path, key):
+    """One bad rollback target must degrade the rollback (older candidate
+    or in-place perturb), never abort the run."""
+    prob = FaultyProblem(Sphere(), corrupt_generations=[9], corrupt_times=1)
+    mon = EvalMonitor(full_fit_history=False)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=mon)
+
+    def tear_target(msg):
+        # The boundary-10 checkpoint is written just before the probe that
+        # fires the rollback; tearing generation 7 at that moment leaves
+        # the policy its older candidates only.
+        if msg == "checkpoint written at generation 10":
+            p = tmp_path / "ck" / "ckpt_00000007.npz"
+            p.write_bytes(p.read_bytes()[:64])
+
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        keep_checkpoints=0,  # keep all, so older candidates exist
+        health=HealthProbe(),
+        restart=RollbackToCheckpoint(),
+        on_event=tear_target,
+    )
+    state = runner.run(wf.init(key), 13)
+    assert [e.policy for e in runner.stats.restarts] == ["rollback"]
+    # The torn generation-7 target was skipped; generation 4 won.
+    assert runner.stats.restarts[0].generation == 10
+    assert runner.stats.restarts[0].detail == {"rolled_back_to": 4}
+    assert runner.stats.completed_generations == 13
+    assert np.all(np.isfinite(np.asarray(state.algorithm.fit)))
+
+
+def test_stagnation_window_resets_after_restart(tmp_path, key):
+    """A fired restart clears the probe window, so the restarted search
+    gets a full window to prove itself instead of cascading restarts at
+    every subsequent boundary."""
+    prob = FaultyProblem(Sphere(), plateau_from=0, plateau_floor=1e6)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=EvalMonitor())
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(stagnation_window=2, stagnation_tol=1e-9),
+        restart=PerturbAroundBest(scale=0.05),
+        max_restarts=10,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        runner.run(wf.init(key), 19)
+    gens = [e.generation for e in runner.stats.restarts]
+    assert len(gens) >= 2  # the permanent plateau keeps re-tripping
+    # Boundaries sit 3 generations apart; with the window (2) cleared on
+    # each restart, consecutive restarts are >= 2 boundaries apart.
+    assert all(b - a >= 6 for a, b in zip(gens, gens[1:])), gens
+
+
+def test_failed_resume_resets_regrown_workflow(tmp_path, key):
+    """If every checkpoint candidate fails AFTER its lineage replay
+    regrew the workflow, resume must undo the mutation — otherwise the
+    fresh start runs the grown algorithm against base-shaped state."""
+    from evox_tpu.core import State
+    from evox_tpu.utils import save_state
+
+    def build(workflow):
+        return ResilientRunner(
+            workflow,
+            tmp_path / "ck",
+            checkpoint_every=3,
+            health=HealthProbe(),
+            restart=ReinitLargerPopulation(lambda p: PSO(p, LB, UB)),
+        )
+
+    prob = FaultyProblem(Sphere(), corrupt_generations=[3], corrupt_times=1)
+    wf = StdWorkflow(PSO(16, LB, UB), prob, monitor=EvalMonitor())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        build(wf).run(wf.init(key), 9)  # fires the regrow -> lineage
+
+    # Rewrite every checkpoint with a VALID manifest (lineage intact) but
+    # hopelessly mis-shaped data: the lineage replay succeeds (mutating
+    # the workflow to pop 32) and only then does validation fail.
+    bogus = State(algorithm=State(pop=jnp.zeros((5, 3))))
+    for p in sorted((tmp_path / "ck").glob("ckpt_*.npz")):
+        gen = int(p.stem.split("_")[1])
+        manifest = read_manifest(p)
+        save_state(
+            p, bogus, generation=gen,
+            metadata={"restarts": manifest["restarts"]},
+        )
+
+    # "New process": fresh workflow at the base configuration.
+    prob2 = FaultyProblem(Sphere(), corrupt_generations=[3], corrupt_times=0)
+    wf2 = StdWorkflow(PSO(16, LB, UB), prob2, monitor=EvalMonitor())
+    fresh = build(wf2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        out = fresh.resume(wf2.init(key))
+    assert out is None
+    # The failed candidates' lineage replay did not leak the grown
+    # algorithm into the workflow.
+    assert wf2.algorithm.pop_size == 16

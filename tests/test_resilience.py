@@ -475,3 +475,77 @@ def test_faulty_problem_error_wrapped_as_xla_runtime_error(key):
     assert "UNAVAILABLE" in str(exc_info.value) or "INTERNAL" in str(
         exc_info.value
     )
+
+
+def test_inf_quarantine_counted_and_never_best(key):
+    """Satellite: injected +Inf rows are quarantined exactly like NaN —
+    counted in num_nonfinite, never the reported best."""
+    mon = EvalMonitor(full_fit_history=True)
+    prob = FaultyProblem(Sphere(), inf_generations=[1, 2], inf_rows=3)
+    wf = _wf(prob, monitor=mon)
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    for _ in range(4):
+        state = step(state)
+    jax.block_until_ready(state)
+    best = float(mon.get_best_fitness(state.monitor))
+    assert np.isfinite(best) and best < 1e29
+    assert int(mon.get_num_nonfinite(state.monitor)) == 6  # 2 evals x 3 rows
+    for hist in mon.fitness_history:
+        assert np.all(np.isfinite(np.asarray(hist)))
+
+
+def test_inf_and_nan_schedules_compose(key):
+    """NaN and Inf injection on the same evaluation hit disjoint-or-
+    overlapping rows without interfering with the quarantine count."""
+    mon = EvalMonitor(full_fit_history=False)
+    prob = FaultyProblem(
+        Sphere(), nan_generations=[1], nan_rows=2, inf_generations=[1],
+        inf_rows=4,
+    )
+    wf = _wf(prob, monitor=mon)
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+    state = jax.jit(wf.step)(state)
+    jax.block_until_ready(state)
+    # rows 0-3 non-finite (2 NaN overwritten by Inf is still non-finite)
+    assert int(mon.get_num_nonfinite(state.monitor)) == 4
+
+
+def test_state_corruption_fault_sets_and_heals_canary(key):
+    """Satellite: the corrupt fault writes NaN into the wrapper's own
+    state leaf (invisible to the fitness quarantine) and heals on the next
+    unscheduled evaluation — the health probe's detector fodder."""
+    prob = FaultyProblem(Sphere(), corrupt_generations=[1], corrupt_times=2)
+    wf = _wf(prob)
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    state = step(state)  # evaluation 1: corrupted
+    assert np.isnan(float(state.problem.corruption))
+    # fitness stayed finite -> quarantine untouched
+    assert np.all(np.isfinite(np.asarray(state.algorithm.fit)))
+    state = step(state)  # evaluation 2: unscheduled -> healed
+    assert float(state.problem.corruption) == 0.0
+    assert prob.attempts("corrupt", 1) == 1
+
+
+def test_plateau_fault_freezes_best(key):
+    """Satellite: the plateau clamp floors fitness over [from, until), so
+    the best cannot improve during the window and recovers after it."""
+    prob = FaultyProblem(Sphere(), plateau_from=1, plateau_until=3,
+                         plateau_floor=1e6)
+    mon = EvalMonitor(full_fit_history=False)
+    wf = _wf(prob, monitor=mon)
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    best0 = float(mon.get_best_fitness(state.monitor))
+    state = step(state)  # eval 1: clamped
+    state = step(state)  # eval 2: clamped
+    assert float(mon.get_best_fitness(state.monitor)) == best0  # frozen
+    for _ in range(3):  # evals 3-5: free again
+        state = step(state)
+    jax.block_until_ready(state)
+    assert float(mon.get_best_fitness(state.monitor)) < best0

@@ -398,3 +398,80 @@ def test_evaluate_exactly_once_enforced():
     state = wf.init(jax.random.key(0))
     with pytest.raises(RuntimeError, match="never called"):
         jax.jit(wf.step)(state)
+
+
+class _IntFitnessProblem:
+    """Fitness as an integer count (e.g. constraint violations)."""
+
+    def setup(self, key):
+        from evox_tpu.core import State
+
+        return State()
+
+    def evaluate(self, state, pop):
+        fit = jnp.sum(jnp.abs(pop) > 5.0, axis=1).astype(jnp.int32)
+        return fit, state
+
+
+class _HookCountingMonitor(EvalMonitor):
+    """Counts record_nonfinite invocations (trace-level) to catch the
+    dtype-dependent short-circuit regression."""
+
+    def __init__(self):
+        super().__init__(full_fit_history=False)
+        self.nonfinite_hook_calls = 0
+
+    def record_nonfinite(self, state, mask):
+        self.nonfinite_hook_calls += 1
+        return super().record_nonfinite(state, mask)
+
+
+def test_quarantine_reports_for_integer_fitness():
+    """Regression: integer/bool fitness cannot hold NaN/Inf, but the
+    quarantine must still report its (all-clear) mask to the monitor —
+    previously it short-circuited past ``record_nonfinite`` entirely,
+    making monitor metrics depend on the fitness dtype."""
+    mon = _HookCountingMonitor()
+    wf = StdWorkflow(PSO(POP, LB, UB), _IntFitnessProblem(), monitor=mon)
+    state = wf.init(jax.random.key(0))
+    state = jax.jit(wf.init_step)(state)
+    state = jax.jit(wf.step)(state)
+    jax.block_until_ready(state)
+    # Hook fired at trace time for both programs (init_step and step)...
+    assert mon.nonfinite_hook_calls == 2
+    # ...with an all-clear mask: nothing was quarantined, values intact.
+    assert int(mon.get_num_nonfinite(state.monitor)) == 0
+    fit = np.asarray(state.monitor.latest_fitness)
+    assert fit.dtype == np.int32
+    assert np.all(fit >= 0)
+
+
+def test_quarantine_bool_fitness_passes_through():
+    """Bool fitness (a feasibility bit) takes the same graceful path: the
+    hook still fires, nothing is substituted.  (EvalMonitor's top-k cannot
+    rank bools, so observe through a bare Monitor subclass.)"""
+    from evox_tpu.core import Monitor, State
+
+    class BoolProblem:
+        def setup(self, key):
+            return State()
+
+        def evaluate(self, state, pop):
+            return jnp.any(jnp.abs(pop) > 5.0, axis=1), state
+
+    class CountingMonitor(Monitor):
+        def __init__(self):
+            self.nonfinite_hook_calls = 0
+
+        def record_nonfinite(self, state, mask):
+            self.nonfinite_hook_calls += 1
+            assert mask.dtype == jnp.bool_
+            return state
+
+    mon = CountingMonitor()
+    wf = StdWorkflow(PSO(POP, LB, UB), BoolProblem(), monitor=mon)
+    state = wf.init(jax.random.key(0))
+    state = jax.jit(wf.init_step)(state)
+    jax.block_until_ready(state)
+    assert mon.nonfinite_hook_calls == 1
+    assert np.asarray(state.algorithm.fit).dtype == np.bool_

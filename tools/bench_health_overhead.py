@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""CPU microbenchmark: between-chunk health-probe overhead budget.
+
+The :class:`~evox_tpu.resilience.HealthProbe` runs at every
+``ResilientRunner`` chunk boundary — on the critical path of a supervised
+run.  Its scan is one jit-compiled program per state structure plus a
+device->host sync of a few scalars, so the cost per boundary should be
+microseconds-to-milliseconds against a multi-second run; this benchmark
+pins that claim to a number and FAILS (exit 1) if probing a 200-generation
+run costs more than ``BUDGET`` (5%) of its wall-clock.
+
+Methodology — the asserted number is a **paired** measurement: the probe's
+``check`` calls are timed from inside the very run they belong to, and
+their sum is compared against that same run's total wall-clock.  Machine
+drift (page cache, CPU frequency, a noisy CI neighbor) hits numerator and
+denominator together, so the ratio is stable where an A/B difference of
+two separately-timed runs is not (an early version of this gate differenced
+two runs and the ~±0.5 s drift between them swamped the ~10 ms signal).
+An interleaved A/B comparison is still *recorded* for context, but not
+asserted.  Compiles are warmed out of the measurement first, as they are
+in any long production run.
+
+Run via::
+
+    ./run_tests.sh --health          # suite + this benchmark
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_health_overhead.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.algorithms import PSO  # noqa: E402
+from evox_tpu.problems.numerical import Sphere  # noqa: E402
+from evox_tpu.resilience import HealthProbe, ResilientRunner  # noqa: E402
+from evox_tpu.workflows import EvalMonitor, StdWorkflow  # noqa: E402
+
+N_STEPS = 200
+CHECKPOINT_EVERY = 20
+POP, DIM = 256, 32
+REPEATS = 3
+BUDGET = 0.05  # 5% wall-clock overhead ceiling
+
+LB = -10.0 * jnp.ones(DIM)
+UB = 10.0 * jnp.ones(DIM)
+
+
+class _TimedProbe(HealthProbe):
+    """HealthProbe that accumulates the wall-clock of its own checks."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.seconds = 0.0
+
+    def check(self, state, generation=0):
+        t0 = time.perf_counter()
+        try:
+            return super().check(state, generation)
+        finally:
+            self.seconds += time.perf_counter() - t0
+
+
+def _probe_config() -> dict:
+    return dict(
+        diversity_floor=1e-12,
+        stagnation_window=5,
+        stagnation_tol=-1.0,  # improvement is never <= -1: no restarts
+    )
+
+
+def _build(workdir: str, tag: str, probe: HealthProbe | None):
+    wf = StdWorkflow(
+        PSO(POP, LB, UB), Sphere(), monitor=EvalMonitor(full_fit_history=False)
+    )
+    runner = ResilientRunner(
+        wf,
+        os.path.join(workdir, tag),
+        checkpoint_every=CHECKPOINT_EVERY,
+        health=probe,
+    )
+    return wf, runner
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="evox_tpu_health_bench_") as wd:
+        # -- the asserted, paired measurement -----------------------------
+        probe = _TimedProbe(**_probe_config())
+        wf, runner = _build(wd, "paired", probe)
+        state0 = wf.init(jax.random.key(0))
+        runner.run(state0, N_STEPS, fresh=True)  # warm: compiles amortized
+        probe_s, total_s = [], []
+        for _ in range(REPEATS):
+            probe.seconds = 0.0
+            t0 = time.perf_counter()
+            runner.run(state0, N_STEPS, fresh=True)
+            total_s.append(time.perf_counter() - t0)
+            probe_s.append(probe.seconds)
+        boundaries = runner.stats.health_checks  # init + one per chunk
+        assert boundaries > 0 and not runner.stats.restarts
+
+        # -- informational interleaved A/B (recorded, not asserted) -------
+        wf_p, plain = _build(wd, "plain", None)
+        wf_h, health = _build(wd, "health", HealthProbe(**_probe_config()))
+        sp, sh = wf_p.init(jax.random.key(0)), wf_h.init(jax.random.key(0))
+        plain.run(sp, N_STEPS, fresh=True)
+        health.run(sh, N_STEPS, fresh=True)
+        ab_plain, ab_health = [], []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            plain.run(sp, N_STEPS, fresh=True)
+            ab_plain.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            health.run(sh, N_STEPS, fresh=True)
+            ab_health.append(time.perf_counter() - t0)
+
+    med_probe = statistics.median(probe_s)
+    med_total = statistics.median(total_s)
+    overhead = med_probe / (med_total - med_probe)
+    result = {
+        "bench": "health_probe_overhead",
+        "backend": jax.default_backend(),
+        "n_steps": N_STEPS,
+        "checkpoint_every": CHECKPOINT_EVERY,
+        "pop_size": POP,
+        "dim": DIM,
+        "repeats": REPEATS,
+        "probed_boundaries": boundaries,
+        "probe_seconds": probe_s,
+        "total_seconds": total_s,
+        "median_probe_s": med_probe,
+        "median_total_s": med_total,
+        "per_boundary_ms": med_probe / boundaries * 1e3,
+        "overhead_fraction": overhead,
+        "budget_fraction": BUDGET,
+        "within_budget": overhead < BUDGET,
+        "ab_interleaved_informational": {
+            "plain_seconds": ab_plain,
+            "health_seconds": ab_health,
+            "median_plain_s": statistics.median(ab_plain),
+            "median_health_s": statistics.median(ab_health),
+        },
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(
+        out_dir, f"health_probe_overhead.{jax.default_backend()}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"health probe overhead: {overhead * 100:.2f}% of run wall-clock "
+        f"({med_probe * 1e3:.1f} ms probing / {med_total:.3f}s total over "
+        f"{N_STEPS} generations, {boundaries} boundaries, "
+        f"{med_probe / boundaries * 1e3:.2f} ms/boundary; "
+        f"budget {BUDGET * 100:.0f}%)"
+    )
+    print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+    if overhead >= BUDGET:
+        print(
+            f"FAIL: probe overhead {overhead * 100:.2f}% exceeds the "
+            f"{BUDGET * 100:.0f}% budget",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
