@@ -170,6 +170,25 @@ def read_manifest(path: Union[str, Path]) -> dict[str, Any] | None:
         raise CheckpointError(f"checkpoint {path} is unreadable: {e!r}") from e
 
 
+def _match_weak_type(value: "jax.Array", like_leaf: Any) -> "jax.Array":
+    """Restore a leaf with the template's weak-typedness.
+
+    Scalar hyperparameters built from Python floats (``Parameter(0.05)``)
+    are *weak-typed* in the live state, but arrays round-tripped through
+    numpy come back strong-typed.  The aval mismatch is invisible to
+    ``allclose``-style checks yet forces one full recompile of every jitted
+    function on resume — the exact regression the compile sentinel
+    (``tools/graftlint/compile_sentinel.py``) gates.  Rebuilding the scalar
+    from a Python number re-enters JAX's weak-type path; if the canonical
+    dtype does not match the template's (exotic weak dtypes), fall back to
+    the strong value rather than corrupt the dtype."""
+    if getattr(like_leaf, "weak_type", False) and value.ndim == 0:
+        weak = jax.numpy.asarray(value.item())
+        if weak.dtype == value.dtype:
+            return weak
+    return value
+
+
 def load_state(
     path: Union[str, Path], like: Any, allow_missing: bool = False
 ) -> Any:
@@ -204,6 +223,13 @@ def load_state(
         raise  # absent, not corrupt — see read_manifest
     except Exception as e:
         raise CheckpointError(f"checkpoint {path} is unreadable: {e!r}") from e
+    with data:  # close the archive fd even on a mismatch raise below
+        return _restore_leaves(path, data, like, allow_missing)
+
+
+def _restore_leaves(
+    path: Path, data: Any, like: Any, allow_missing: bool
+) -> Any:
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
     new_leaves = []
     for key_path, leaf in leaves_with_paths:
@@ -259,7 +285,7 @@ def load_state(
                         f"template's {leaf.dtype}"
                     )
                 arr = arr.astype(leaf.dtype)
-            new_leaves.append(jax.numpy.asarray(arr))
+            new_leaves.append(_match_weak_type(jax.numpy.asarray(arr), leaf))
         elif allow_missing:
             warnings.warn(
                 f"checkpoint {path} has no entry for state leaf {name!r}; "

@@ -234,7 +234,11 @@ class EvalMonitor(Monitor):
     def record_auxiliary(self, state: State, aux: dict[str, jax.Array]) -> State:
         if self.full_pop_history:
             if not self.aux_keys:
-                self.aux_keys = list(aux.keys())
+                # Deliberate trace-time capture, not per-generation state:
+                # the aux slot order is static config discovered on the first
+                # trace (record_step returns the same keys every generation),
+                # and the host-side history accessors need it after the run.
+                self.aux_keys = list(aux.keys())  # graftlint: disable=GL005
             for slot, k in enumerate(self.aux_keys):
                 self._sink(aux[k], HistoryType.AUXILIARY, state, slot=slot)
         return state

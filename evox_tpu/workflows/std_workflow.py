@@ -312,7 +312,9 @@ class StdWorkflow(Workflow):
         # monitor actually overrides the hook (reference ``:178-180``).
         if type(self.monitor).record_auxiliary is not Monitor.record_auxiliary:
             aux = self.algorithm.record_step(algo_state)
-            if aux:
+            # `aux` is the record_step dict, not an array: its truthiness is
+            # container emptiness, decided at trace time.
+            if aux:  # graftlint: disable=GL003
                 mon_state = self.monitor.record_auxiliary(mon_state, aux)
         return state.replace(
             algorithm=algo_state, problem=carrier["problem"], monitor=mon_state

@@ -14,13 +14,28 @@
 #                               probe adds <5% wall-clock overhead to a
 #                               200-generation run (artifact written under
 #                               bench_artifacts/)
-#   ./run_tests.sh --lint       repo lints (bare-assert ratchet)
+#   ./run_tests.sh --lint       repo lints: the graftlint static-analysis
+#                               suite (GL000 assert ratchet + GL001-GL005
+#                               JAX-purity rules), then the lint test suite
+#                               incl. the compile-cache sentinel gate (an
+#                               algorithm matrix must compile exactly once
+#                               across 10 generations and checkpoint resume)
+#   ./run_tests.sh --lint-fix-hints
+#                               graftlint with the suggested rewrite printed
+#                               under every finding (incl. baselined debt)
 #   ./run_tests.sh <pytest args>   passthrough
 CPU_ENV=(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
          XLA_FLAGS="--xla_force_host_platform_device_count=8"
          _EVOX_TPU_TEST_REEXEC=1)
 if [ "$1" = "--lint" ]; then
-  exec python tools/lint_asserts.py
+  shift
+  python -m tools.graftlint "$@" || exit 1
+  exec "${CPU_ENV[@]}" python -m pytest \
+    tests/test_graftlint.py tests/test_compile_sentinel.py tests/test_tooling.py -q
+fi
+if [ "$1" = "--lint-fix-hints" ]; then
+  shift
+  exec python -m tools.graftlint --lint-fix-hints "$@"
 fi
 if [ "$1" = "--health" ]; then
   shift

@@ -1,0 +1,9 @@
+"""GL000 true positives: bare asserts guarding user input."""
+
+import jax.numpy as jnp
+
+
+def validate_bounds(lb, ub):
+    assert lb.shape == ub.shape  # GL000: vanishes under python -O
+    assert jnp.all(lb < ub), "lb must be strictly below ub"  # GL000
+    return lb, ub

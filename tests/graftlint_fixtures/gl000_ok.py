@@ -1,0 +1,11 @@
+"""GL000 must-not-flag: validation by explicit raise survives ``python -O``."""
+
+import jax.numpy as jnp
+
+
+def validate_bounds(lb, ub):
+    if lb.shape != ub.shape:
+        raise ValueError(f"bounds shapes differ: {lb.shape} vs {ub.shape}")
+    if not jnp.all(lb < ub):
+        raise ValueError("lb must be strictly below ub")
+    return lb, ub

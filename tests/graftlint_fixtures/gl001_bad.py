@@ -1,0 +1,35 @@
+"""GL001 true positives: PRNG key reuse in its three classic shapes."""
+
+import jax
+
+
+def double_draw(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))  # GL001: key already consumed
+    return a + b
+
+
+def consumed_by_split(key):
+    k1, k2 = jax.random.split(key)
+    noise = jax.random.normal(key, (2,))  # GL001: split used the key up
+    return noise, k1, k2
+
+
+def stored_back_unmodified(state):
+    noise = jax.random.normal(state.key, (4,))
+    # GL001: the returned state still carries the consumed key — the next
+    # step draws identical randomness.
+    return state.replace(pop=state.pop + noise)
+
+
+def consumed_then_stored(state):
+    key = state.key
+    noise = jax.random.normal(key, (4,))
+    return state.replace(pop=state.pop + noise, key=key)  # GL001: stale key stored
+
+
+def reuse_in_loop(key, xs):
+    total = 0.0
+    for x in xs:
+        total = total + jax.random.uniform(key, ())  # GL001: same key every iteration
+    return total

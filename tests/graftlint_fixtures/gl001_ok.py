@@ -1,0 +1,44 @@
+"""GL001 must-not-flag: disciplined key threading."""
+
+import jax
+
+
+def fresh_subkeys(key):
+    key, k1, k2 = jax.random.split(key, 3)
+    draws = jax.random.normal(k1, (3,)) + jax.random.uniform(k2, (3,))
+    return draws, key
+
+
+def threads_state_key(state):
+    key, sub = jax.random.split(state.key)
+    noise = jax.random.normal(sub, (4,))
+    return state.replace(key=key, pop=state.pop + noise)
+
+
+def fold_in_derivation(key, n):
+    # fold_in derives without consuming; using the parent key per index is
+    # the documented idiom for stable per-instance streams.
+    a = jax.random.normal(jax.random.fold_in(key, 0), (2,))
+    b = jax.random.normal(jax.random.fold_in(key, 1), (2,))
+    return a + b
+
+
+def one_use_per_branch(key, flag):
+    # The two consumptions are on mutually exclusive branches.
+    if flag:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))
+
+
+def resplit_inside_loop(key, xs):
+    total = 0.0
+    for x in xs:
+        key, sub = jax.random.split(key)
+        total = total + jax.random.uniform(sub, ())
+    return total, key
+
+
+def key_in_error_message(key, pop):
+    if pop.ndim != 2:
+        raise ValueError(f"expected (pop, dim), got {pop.shape} (key={key})")
+    return jax.random.permutation(key, pop)

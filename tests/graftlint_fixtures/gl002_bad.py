@@ -1,0 +1,22 @@
+"""GL002 true positives: host syncs inside the compiled step family."""
+
+import numpy as np
+
+
+class SyncingAlgorithm:
+    def step(self, state, evaluate):
+        fit = evaluate(state.pop)
+        best = float(fit.min())  # GL002: float() on a traced value
+        worst_index = fit.argmax().item()  # GL002: .item() blocks per call
+        host_pop = np.asarray(state.pop)  # GL002: numpy materializes on host
+        rows = fit.tolist()  # GL002: .tolist() transfers the whole array
+        del best, worst_index, host_pop, rows
+        return state.replace(fit=fit)
+
+    def _helper(self, fit):
+        # reachable from `tell` below, so compiled scope too
+        return int(fit.sum())  # GL002
+
+    def tell(self, state, fitness):
+        score = self._helper(fitness)
+        return state.replace(score=score)

@@ -1,0 +1,33 @@
+"""GL002 must-not-flag: static projections, config reads, host callbacks."""
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+
+class DisciplinedAlgorithm:
+    def step(self, state, evaluate):
+        fit = evaluate(state.pop)
+        n = int(state.pop.shape[0])  # shape is static under trace
+        penalty = float(jnp.finfo(fit.dtype).max)  # finfo is a host query
+        scale = float(self.learning_rate)  # self config is static
+        if fit.ndim != 1:
+            raise ValueError(f"expected 1-D fitness, got {fit.shape}")
+        return state.replace(fit=jnp.minimum(fit, penalty / (n * scale)))
+
+    def pre_tell(self, state, fitness):
+        def record(x):
+            # Host callback: .item()/np here is the POINT — it runs on the
+            # host, outside the trace.
+            self_history.append(np.asarray(x).min().item())
+
+        io_callback(record, None, fitness)
+        return state
+
+    def summarize(self, state):
+        # Not in the step family, never called from it: a host-side accessor
+        # may sync freely.
+        return float(state.fit.min()), state.fit.tolist()
+
+
+self_history = []

@@ -1,0 +1,15 @@
+"""GL003 true positives: Python control flow on traced values."""
+
+import jax.numpy as jnp
+
+
+class BranchingAlgorithm:
+    def step(self, state, evaluate):
+        fit = evaluate(state.pop)
+        if jnp.any(fit < 0.0):  # GL003: traced predicate
+            fit = -fit
+        if state.sigma > self.sigma_limit:  # GL003: traced state leaf
+            fit = fit * 0.5
+        while fit[0] > 1.0:  # GL003: traced while condition
+            fit = fit * 0.5
+        return state.replace(fit=fit)

@@ -1,0 +1,20 @@
+"""GL003 must-not-flag: static branches and the lax/jnp alternatives."""
+
+import jax
+import jax.numpy as jnp
+
+
+class StaticBranchingAlgorithm:
+    def step(self, state, evaluate):
+        fit = evaluate(state.pop)
+        if self.opt_direction == -1:  # static config
+            fit = -fit
+        if fit.ndim == 1:  # static shape metadata
+            fit = fit[:, None]
+        if "aux" in state:  # static pytree structure
+            fit = fit + state.aux
+        fit = jnp.where(fit < 0.0, -fit, fit)  # traced select, done right
+        fit = jax.lax.cond(
+            jnp.any(fit > 1e10), lambda f: f * 0.5, lambda f: f, fit
+        )
+        return state.replace(fit=fit)

@@ -1,0 +1,16 @@
+"""GL004 true positives: trace-shape hazards that recompile per generation."""
+
+import jax.numpy as jnp
+
+
+class RecompilingAlgorithm:
+    def step(self, state, evaluate):
+        fit = evaluate(state.pop)
+        bounds = jnp.array([self.lb, self.ub])  # GL004: list of non-constants
+        scales = jnp.asarray([s * 2.0 for s in self.scales])  # GL004: listcomp
+        total = 0.0
+        for row in state.pop:  # GL004: unrolls the trace over a traced array
+            total = total + row.sum()
+        cache_key = f"pop-{state.pop.shape}"  # GL004: shape-derived string
+        del bounds, scales, cache_key
+        return state.replace(fit=fit + total)

@@ -1,0 +1,17 @@
+"""GL004 must-not-flag: constants, static unrolls, lax loops."""
+
+import jax
+import jax.numpy as jnp
+
+
+class StableShapeAlgorithm:
+    def step(self, state, evaluate):
+        fit = evaluate(state.pop)
+        anchors = jnp.array([0.0, 0.5, 1.0])  # constant literal: folds once
+        for i in range(self.n_subswarms):  # static Python bound from config
+            fit = fit + anchors[i % 3]
+        fit = jax.lax.fori_loop(0, 8, lambda i, f: f * 0.99, fit)
+        total = jnp.sum(state.pop, axis=0)  # whole-array op, no unroll
+        if state.pop.ndim != 2:
+            raise ValueError(f"expected (pop, dim), got {state.pop.shape}")
+        return state.replace(fit=fit + total[0])

@@ -1,0 +1,13 @@
+"""GL005 true positives: self-mutation inside the compiled step family."""
+
+
+class ImpureAlgorithm:
+    def step(self, state, evaluate):
+        fit = evaluate(state.pop)
+        self.best_fit = fit.min()  # GL005: frozen at trace time
+        self.generation += 1  # GL005: counts traces, not generations
+        return state.replace(fit=fit)
+
+    def ask(self, state):
+        self.last_pop = state.pop  # GL005
+        return state.pop

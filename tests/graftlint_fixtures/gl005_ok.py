@@ -1,0 +1,21 @@
+"""GL005 must-not-flag: configuration in __init__, evolution in the State."""
+
+import jax.numpy as jnp
+
+
+class PureAlgorithm:
+    def __init__(self, pop_size, dim):
+        self.pop_size = pop_size  # static config: __init__ is host-side
+        self.dim = dim
+        self._scratch = None  # fine outside the step family
+
+    def configure(self, **kwargs):
+        self.options = dict(kwargs)  # host-side setter, not compiled
+        return self
+
+    def step(self, state, evaluate):
+        fit = evaluate(state.pop)
+        best = jnp.argmin(fit)
+        return state.replace(  # evolving values live in the State
+            fit=fit, best_fit=fit[best], best_at=state.pop[best]
+        )

@@ -1,0 +1,147 @@
+"""Compile-cache regression gate (tools/graftlint/compile_sentinel.py).
+
+The framework's throughput story assumes ``StdWorkflow.step`` compiles
+**once** and then replays from the jit cache for every remaining generation
+— silent per-generation recompilation turns a TPU run into a compile
+benchmark (PAPER.md; the GL004 rule catches the static hazards, this suite
+catches recompiles in fact).
+
+Matrix: one ES (OpenES), one DE (DE), one PSO (PSO), one MOEA (NSGA-II),
+each asserted to compile
+
+* exactly once across 10 generations,
+* zero additional times when stepping resumes from a ``save_state``/
+  ``load_state`` checkpoint round-trip with the same jitted callable (the
+  restored state must reproduce the avals bit-for-bit: any dtype/weak-type/
+  shape drift in the checkpoint layer shows up here as a recompile), and
+* exactly once for a FRESH jit wrapper over the restored state (a fresh
+  cache pays one compile, then replays).
+
+Plus the negative control: a deliberately hazardous workflow (population
+grows a row per generation, the classic dynamic-shape footgun) must trip the
+sentinel every generation.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu.core import Algorithm, State
+from evox_tpu.problems.numerical import DTLZ2, Sphere
+from evox_tpu.utils import load_state, save_state
+from evox_tpu.workflows import StdWorkflow
+
+from tools.graftlint import CompileSentinel, RecompileError
+
+DIM = 6
+POP = 8
+
+
+def _matrix():
+    from evox_tpu.algorithms import DE, NSGA2, PSO, OpenES
+
+    lb, ub = -5.0 * jnp.ones(DIM), 5.0 * jnp.ones(DIM)
+    return [
+        ("openes", OpenES(POP, jnp.ones(DIM), learning_rate=0.05, noise_stdev=0.1), Sphere()),
+        ("de", DE(POP, lb, ub), Sphere()),
+        ("pso", PSO(POP, lb, ub), Sphere()),
+        ("nsga2", NSGA2(POP, 3, -jnp.ones(12), jnp.ones(12)), DTLZ2()),
+    ]
+
+
+@pytest.mark.parametrize(
+    "name,algo,problem", _matrix(), ids=[m[0] for m in _matrix()]
+)
+def test_step_compiles_exactly_once_and_survives_resume(
+    name, algo, problem, tmp_path
+):
+    wf = StdWorkflow(algo, problem)
+    state = wf.init(jax.random.key(11))
+    init_step = jax.jit(wf.init_step)
+    step = jax.jit(wf.step)
+
+    with CompileSentinel() as sentinel:
+        state = init_step(state)
+        for _ in range(10):
+            state = step(state)
+        jax.block_until_ready(state)
+    sentinel.assert_compiles(1, match="init_step", exact=True)
+    sentinel.assert_compiles(1, match="step", exact=True)
+
+    # Checkpoint round-trip: the restored state must hit the SAME cache
+    # entry — zero new compiles over five more generations.
+    path = save_state(tmp_path / f"{name}.npz", state)
+    restored = load_state(path, state)
+    with CompileSentinel() as resumed:
+        for _ in range(5):
+            restored = resume_state = step(restored)
+        jax.block_until_ready(resume_state)
+    resumed.assert_compiles(0, match="step", exact=True)
+
+    # A genuinely fresh jit cache (jax keys pjit caches by function
+    # EQUALITY, so re-wrapping the same bound method would share the warm
+    # cache — wrap a new lambda instead, the cold-resume scenario): exactly
+    # one compile, then replay — proving the restored avals are stable, not
+    # just lucky.
+    def cold_step(s):
+        return wf.step(s)
+
+    fresh = jax.jit(cold_step)
+    with CompileSentinel() as fresh_sentinel:
+        for _ in range(5):
+            restored = fresh(restored)
+        jax.block_until_ready(restored)
+    fresh_sentinel.assert_compiles(1, match="cold_step", exact=True)
+
+
+class _GrowingPopHazard(Algorithm):
+    """Deliberate recompile hazard: the population gains a row every
+    generation, so every ``step`` call presents new shapes to the jit cache
+    — the dynamic-population footgun GL004 warns about, materialized."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def setup(self, key: jax.Array) -> State:
+        return State(
+            key=key,
+            pop=jnp.zeros((4, self.dim)),
+            fit=jnp.full((4,), jnp.inf),
+        )
+
+    def step(self, state: State, evaluate) -> State:
+        key, sub = jax.random.split(state.key)
+        grown = jnp.concatenate(
+            [state.pop, jax.random.normal(sub, (1, self.dim))]
+        )
+        fit = evaluate(grown)
+        return state.replace(key=key, pop=grown, fit=fit)
+
+
+def test_sentinel_trips_on_injected_recompile_hazard():
+    wf = StdWorkflow(_GrowingPopHazard(DIM), Sphere())
+    state = wf.init(jax.random.key(5))
+    step = jax.jit(wf.step)
+    n_gens = 3
+    with CompileSentinel() as sentinel:
+        for _ in range(n_gens):
+            state = step(state)
+        jax.block_until_ready(state)
+    # one compile per generation: the cache never gets a hit
+    assert sentinel.count(match="step", exact=True) == n_gens, sentinel.names()
+    with pytest.raises(RecompileError) as err:
+        sentinel.assert_compiles(1, match="step", exact=True)
+    # the error must list the events — that listing is the debugging entry
+    # point documented in docs/guide/static-analysis.md
+    assert "step" in str(err.value)
+
+
+def test_sentinel_is_quiet_and_restores_logging():
+    import logging
+
+    lg = logging.getLogger("jax._src.interpreters.pxla")
+    level, propagate = lg.level, lg.propagate
+    with CompileSentinel() as s:
+        jax.jit(lambda x: x + 1)(jnp.zeros(3))
+    assert s.count() >= 1
+    assert lg.level == level and lg.propagate == propagate

@@ -1,0 +1,505 @@
+"""graftlint static-analysis suite tests.
+
+Three layers:
+
+* **fixture corpus** — every rule must flag its ``glXXX_bad.py`` fixture and
+  stay silent on every ``glXXX_ok.py`` (false-positive regression corpus);
+* **framework mechanics** — pragma suppression (line / def-line / file),
+  ratchet baseline semantics (counts only go down; ``--update-baseline``
+  refuses increases), CLI exit codes;
+* **key-discipline regression** — the behavioral counterpart of GL001: for a
+  representative algorithm matrix the PRNG key must advance every generation
+  and successive generations must draw distinct randomness.  (The GL001/
+  GL002 sweep over ``evox_tpu/operators`` + ``evox_tpu/algorithms`` came
+  back clean — the seed's key threading is disciplined — so these tests pin
+  the invariant the linter enforces instead of accompanying fixes.)
+"""
+
+import json
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.graftlint import (  # noqa: E402
+    RULES_BY_CODE,
+    Module,
+    check_ratchet,
+    group_counts,
+    scan_paths,
+)
+from tools.graftlint.cli import main as graftlint_main  # noqa: E402
+
+FIXTURES = REPO / "tests" / "graftlint_fixtures"
+ALL_CODES = sorted(RULES_BY_CODE)
+
+
+def _findings(path, codes=None):
+    rules = [RULES_BY_CODE[c] for c in (codes or ALL_CODES)]
+    return scan_paths([pathlib.Path(path)], rules)
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("code", ALL_CODES)
+def test_bad_fixture_flags(code):
+    path = FIXTURES / f"{code.lower()}_bad.py"
+    found = [f for f in _findings(path, [code]) if f.rule == code]
+    assert found, f"{path.name} must produce at least one {code} finding"
+
+
+@pytest.mark.parametrize("code", ALL_CODES)
+def test_ok_fixture_is_clean_across_all_rules(code):
+    path = FIXTURES / f"{code.lower()}_ok.py"
+    found = _findings(path)
+    assert not found, "\n".join(f.format() for f in found)
+
+
+@pytest.mark.parametrize("code", ALL_CODES)
+def test_cli_exits_1_on_bad_fixture(code, capsys):
+    path = FIXTURES / f"{code.lower()}_bad.py"
+    rc = graftlint_main([str(path), "--select", code, "--no-baseline"])
+    assert rc == 1
+    assert code in capsys.readouterr().out
+
+
+def test_bad_fixture_finding_counts_are_exact():
+    """Each bad fixture documents its true positives with an inline GLxxx
+    comment; the rule must find exactly those (no over-firing)."""
+    for code in ALL_CODES:
+        path = FIXTURES / f"{code.lower()}_bad.py"
+        expected = sum(
+            f"# {code}" in line for line in path.read_text().splitlines()
+        )
+        found = [f for f in _findings(path, [code]) if f.rule == code]
+        assert len(found) == expected, (
+            f"{path.name}: expected {expected} {code} findings (one per "
+            f"inline marker), got {len(found)}:\n"
+            + "\n".join(f.format() for f in found)
+        )
+
+
+# ---------------------------------------------------------------------------
+# pragma suppression
+# ---------------------------------------------------------------------------
+
+_BAD_SNIPPET = """import jax
+
+def double_draw(key):
+    a = jax.random.normal(key, (3,)){line_pragma}
+    b = jax.random.uniform(key, (3,))
+    return a + b
+"""
+
+
+def test_pragma_on_flagged_line_suppresses(tmp_path):
+    src = (tmp_path / "snippet.py")
+    src.write_text(_BAD_SNIPPET.format(line_pragma=""))
+    flagged = _findings(src, ["GL001"])
+    assert len(flagged) == 1
+    line = flagged[0].line
+    lines = src.read_text().splitlines()
+    lines[line - 1] += "  # graftlint: disable=GL001"
+    src.write_text("\n".join(lines))
+    assert not _findings(src, ["GL001"])
+
+
+def test_pragma_on_def_line_suppresses_whole_function(tmp_path):
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        _BAD_SNIPPET.format(line_pragma="").replace(
+            "def double_draw(key):",
+            "def double_draw(key):  # graftlint: disable=GL001",
+        )
+    )
+    assert not _findings(src, ["GL001"])
+
+
+def test_file_pragma_suppresses_everywhere(tmp_path):
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        "# graftlint: disable-file=GL001\n" + _BAD_SNIPPET.format(line_pragma="")
+    )
+    assert not _findings(src, ["GL001"])
+
+
+def test_pragma_other_code_does_not_suppress(tmp_path):
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        _BAD_SNIPPET.format(line_pragma="").replace(
+            "def double_draw(key):",
+            "def double_draw(key):  # graftlint: disable=GL005",
+        )
+    )
+    assert len(_findings(src, ["GL001"])) == 1
+
+
+def test_lowercase_pragma_code_suppresses_only_that_rule(tmp_path):
+    """`disable=gl001` must normalize to GL001 — NOT backtrack into a bare
+    suppress-everything `disable` (review regression)."""
+    src = tmp_path / "snippet.py"
+    body = (
+        "import jax\n"
+        "class A:\n"
+        "    def step(self, state, evaluate):  # graftlint: disable=gl005\n"
+        "        fit = evaluate(state.pop)\n"
+        "        self.best = fit  # suppressed: GL005 (lowercase pragma)\n"
+        "        n = float(fit.min())  # must STILL flag: GL002\n"
+        "        return state.replace(fit=fit)\n"
+    )
+    src.write_text(body)
+    found = _findings(src)
+    assert [f.rule for f in found] == ["GL002"], [f.format() for f in found]
+
+
+def test_pragma_does_not_swallow_trailing_comment_words(tmp_path):
+    """`disable=GL001 but only here` must still suppress GL001 (the code
+    list stops at the first non-token), not silently suppress nothing."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        _BAD_SNIPPET.format(line_pragma="").replace(
+            "def double_draw(key):",
+            "def double_draw(key):  # graftlint: disable=GL001 intentional demo",
+        )
+    )
+    assert not _findings(src, ["GL001"])
+
+
+def test_with_statement_targets_are_tainted(tmp_path):
+    """`with ... as x:` binding a traced value must taint x (review found
+    the withitem branch was dead code)."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        "class A:\n"
+        "    def step(self, state, evaluate):\n"
+        "        fit = evaluate(state.pop)\n"
+        "        with make_ctx(fit) as live:\n"
+        "            if live:\n"  # GL003: traced with-target
+        "                fit = -fit\n"
+        "        return state.replace(fit=fit)\n"
+    )
+    found = _findings(src, ["GL003"])
+    assert [f.rule for f in found] == ["GL003"], [f.format() for f in found]
+
+
+def test_deep_dotted_key_with_correct_replace_is_clean(tmp_path):
+    """`self.state.key` consumed then `self.state.replace(key=fresh)` is
+    disciplined — the replace kwarg is `key`, the LAST path component
+    (review FP: partition vs rpartition)."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        "import jax\n"
+        "class A:\n"
+        "    def advance(self):\n"
+        "        fresh, sub = jax.random.split(self.state.key)\n"
+        "        noise = jax.random.normal(sub, (2,))\n"
+        "        return self.state.replace(key=fresh, pop=noise)\n"
+    )
+    assert not _findings(src, ["GL001"])
+
+
+def test_subkey_reuse_is_flagged(tmp_path):
+    """`subkey` is the fix hint's own recommended name — reusing it must be
+    visible (review false negative)."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        "import jax\n"
+        "def f(key):\n"
+        "    key, subkey = jax.random.split(key)\n"
+        "    a = jax.random.normal(subkey, (2,))\n"
+        "    b = jax.random.uniform(subkey, (2,))\n"
+        "    return a + b, key\n"
+    )
+    assert len(_findings(src, ["GL001"])) == 1
+
+
+def test_consumption_before_break_still_counts(tmp_path):
+    """break/continue leave the loop, not the function: a key consumed
+    before `break` is still consumed afterwards (review false negative).
+    Two findings: the next-iteration reuse inside the loop AND the
+    post-loop reuse."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        "import jax\n"
+        "def f(key, items):\n"
+        "    for it in items:\n"
+        "        if it:\n"
+        "            a = jax.random.normal(key, (2,))\n"
+        "            break\n"
+        "    return jax.random.uniform(key, (2,))\n"
+    )
+    assert sorted(f.line for f in _findings(src, ["GL001"])) == [5, 7]
+
+
+def test_returning_fresh_state_constructor_is_clean(tmp_path):
+    """`return State(key=new_key, ...)` after consuming state.key is
+    disciplined threading via the constructor — not reuse (review FP)."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        "import jax\n"
+        "def rebuild(state):\n"
+        "    new_key, sub = jax.random.split(state.key)\n"
+        "    noise = jax.random.normal(sub, (4,))\n"
+        "    return State(key=new_key, pop=state.pop + noise)\n"
+    )
+    assert not _findings(src, ["GL001"])
+
+
+def test_jnp_array_of_traced_scalars_is_clean(tmp_path):
+    """`jnp.array([traced, traced])` traces like jnp.stack — only
+    non-constant HOST elements are recompile hazards (review FP)."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        "import jax.numpy as jnp\n"
+        "class A:\n"
+        "    def step(self, state, evaluate):\n"
+        "        fit = evaluate(state.pop)\n"
+        "        lo = jnp.array([state.pop.min(), fit.min()])  # fine: tracers\n"
+        "        bad = jnp.array([self.lb, self.ub])  # hazard: host values\n"
+        "        return state.replace(fit=fit + lo[0] + bad[0])\n"
+    )
+    found = _findings(src, ["GL004"])
+    assert len(found) == 1 and found[0].line == 6, [f.format() for f in found]
+
+
+@pytest.mark.parametrize("typo", ["disabled=GL001", "disable-files=GL001"])
+def test_misspelled_pragma_keyword_is_inert(tmp_path, typo):
+    """`disabled=`/`disable-files=` must not prefix-match into a bare
+    suppress-everything `disable` (review regression)."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        _BAD_SNIPPET.format(line_pragma="").replace(
+            "def double_draw(key):",
+            f"def double_draw(key):  # graftlint: {typo}",
+        )
+    )
+    assert len(_findings(src, ["GL001"])) == 1
+
+
+def test_truncated_pragma_suppresses_nothing(tmp_path):
+    """`# graftlint: disable=` (codes lost mid-edit) must be inert, not a
+    silent suppress-everything (review regression)."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        _BAD_SNIPPET.format(line_pragma="").replace(
+            "def double_draw(key):",
+            "def double_draw(key):  # graftlint: disable=",
+        )
+    )
+    assert len(_findings(src, ["GL001"])) == 1
+
+
+def test_at_set_updates_stay_tainted(tmp_path):
+    """`x.at[i].set(v)` is the standard functional-update idiom — its result
+    must stay traced (review found `.at` wrongly treated as static)."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        "class A:\n"
+        "    def step(self, state, evaluate):\n"
+        "        fit = evaluate(state.pop)\n"
+        "        capped = fit.at[0].set(0.0)\n"
+        "        if capped.sum() > 0:\n"  # GL003
+        "            capped = -capped\n"
+        "        worst = float(capped.max())\n"  # GL002
+        "        return state.replace(fit=capped + worst)\n"
+    )
+    rules = sorted(f.rule for f in _findings(src, ["GL002", "GL003"]))
+    assert rules == ["GL002", "GL003"], rules
+
+
+def test_pragma_text_in_docstring_is_inert(tmp_path):
+    """Pragma syntax QUOTED in a docstring documents the escape hatch; it
+    must not BE the escape hatch (review regression)."""
+    src = tmp_path / "snippet.py"
+    src.write_text(
+        '"""Module docs: suppress with `# graftlint: disable-file=GL001`."""\n'
+        "import jax\n"
+        "def double_draw(key):\n"
+        "    a = jax.random.normal(key, (3,))\n"
+        "    b = jax.random.uniform(key, (3,))\n"
+        "    return a + b\n"
+    )
+    assert len(_findings(src, ["GL001"])) == 1
+
+
+def test_update_baseline_seeds_new_rule_but_ratchets_existing(tmp_path, monkeypatch):
+    """A rule with no baseline section yet may record first-time legacy debt
+    (the documented new-rule workflow); a rule WITH a section stays
+    only-goes-down (review found seeding was impossible)."""
+    from tools.graftlint import engine
+    from tools.graftlint.engine import update_baselines
+
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"GL003": {"x.py": 1}}))
+    monkeypatch.setattr(engine, "BASELINE_PATH", baseline)
+    findings = _findings(FIXTURES / "gl005_bad.py", ["GL005"])
+    ok, _ = update_baselines(findings, ["GL005"])  # no GL005 section: seed
+    assert ok
+    recorded = json.loads(baseline.read_text())
+    assert sum(recorded["GL005"].values()) == len(findings)
+    assert recorded["GL003"] == {"x.py": 1}  # untouched
+    grown = findings + [
+        type(findings[0])("GL005", findings[0].path, 999, 0, "extra", "")
+    ]
+    ok, messages = update_baselines(grown, ["GL005"])  # now ratcheted
+    assert not ok and any("refusing" in m for m in messages)
+
+
+def test_update_baseline_refuses_partial_scan(capsys):
+    """--update-baseline on a path subset would truncate the baseline maps
+    to the scanned files (review regression) — the CLI must refuse."""
+    rc = graftlint_main(
+        [str(FIXTURES / "gl000_bad.py"), "--select", "GL000", "--update-baseline"]
+    )
+    assert rc == 1
+    assert "full scan" in capsys.readouterr().out
+    # and the committed baseline was not touched
+    committed = json.loads((REPO / "tools" / "assert_baseline.json").read_text())
+    assert "evox_tpu/workflows/eval_monitor.py" in committed
+
+
+# ---------------------------------------------------------------------------
+# ratchet semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ratchet_allows_baselined_counts_and_catches_growth():
+    findings = _findings(FIXTURES / "gl005_bad.py", ["GL005"])
+    n = len(findings)
+    assert n >= 2
+    rel = findings[0].path
+    ok_problems, _ = check_ratchet(findings, {"GL005": {rel: n}})
+    assert not ok_problems
+    over_problems, over_findings = check_ratchet(findings, {"GL005": {rel: n - 1}})
+    assert over_problems and len(over_findings) == n
+    # files not in the baseline must be clean
+    missing_problems, _ = check_ratchet(findings, {"GL005": {}})
+    assert missing_problems
+
+
+def test_update_baseline_refuses_increase(tmp_path, monkeypatch):
+    """--update-baseline must never ratchet UP (same contract as the PR 1
+    assert lint)."""
+    from tools.graftlint import engine
+
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"GL005": {"some/file.py": 1}}))
+    monkeypatch.setattr(engine, "BASELINE_PATH", baseline)
+    findings = _findings(FIXTURES / "gl005_bad.py", ["GL005"])
+    # rewrite the findings to claim they live in the baselined file
+    findings = [
+        type(f)(f.rule, "some/file.py", f.line, f.col, f.message, f.hint)
+        for f in findings
+    ]
+    from tools.graftlint.engine import update_baselines
+
+    ok, messages = update_baselines(findings, ["GL005"])
+    assert not ok
+    assert any("refusing" in m for m in messages)
+    # decreases are recorded
+    ok, _ = update_baselines(findings[:1], ["GL005"])
+    assert ok
+    assert json.loads(baseline.read_text())["GL005"] == {"some/file.py": 1}
+
+
+def test_repo_is_clean_against_committed_baselines():
+    """The acceptance gate: the full suite over evox_tpu/ with the committed
+    ratchet baselines must be clean (`python -m tools.graftlint` exits 0)."""
+    rc = graftlint_main([])
+    assert rc == 0
+
+
+def test_new_rules_start_at_zero():
+    """GL001-GL005 carry NO baselined debt: the library is clean outside the
+    two pragma'd intentional sites, and new code must stay clean.  The
+    sections exist but are EMPTY — present so `--update-baseline`'s
+    refuse-increases check always applies to them (an absent section is the
+    first-time-seed path reserved for future rules)."""
+    committed = json.loads(
+        (REPO / "tools" / "graftlint" / "baseline.json").read_text()
+    )
+    assert sorted(committed) == ["GL001", "GL002", "GL003", "GL004", "GL005"]
+    assert all(files == {} for files in committed.values()), (
+        "GL001+ baselines must stay empty — fix or pragma new findings "
+        f"instead of baselining them: {committed}"
+    )
+
+
+def test_counts_match_gl000_baseline_exactly():
+    """The GL000 scan equals the committed assert baseline — stale entries
+    (fixed files still holding budget) fail here, keeping the ratchet tight."""
+    findings = scan_paths([REPO / "evox_tpu"], [RULES_BY_CODE["GL000"]])
+    counts = group_counts(findings).get("GL000", {})
+    committed = json.loads((REPO / "tools" / "assert_baseline.json").read_text())
+    assert counts == committed
+
+
+# ---------------------------------------------------------------------------
+# key-discipline regression (behavioral GL001)
+# ---------------------------------------------------------------------------
+
+
+def _algorithms():
+    from evox_tpu.algorithms import DE, NSGA2, PSO, OpenES
+
+    dim = 6
+    lb, ub = -5.0 * jnp.ones(dim), 5.0 * jnp.ones(dim)
+    return [
+        ("pso", PSO(8, lb, ub)),
+        ("de", DE(8, lb, ub)),
+        ("openes", OpenES(8, jnp.zeros(dim), learning_rate=0.05, noise_stdev=0.1)),
+        ("nsga2", NSGA2(8, 3, -jnp.ones(12), jnp.ones(12))),
+    ]
+
+
+def _workflow_for(name, algo):
+    from evox_tpu.problems.numerical import DTLZ2, Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    problem = DTLZ2() if name == "nsga2" else Sphere()
+    return StdWorkflow(algo, problem)
+
+
+@pytest.mark.parametrize("name,algo", _algorithms(), ids=lambda a: a if isinstance(a, str) else "")
+def test_key_advances_every_generation(name, algo):
+    """The state's PRNG key must change every step — a stale key (GL001's
+    stored-back-consumed pattern) would re-draw identical randomness."""
+    wf = _workflow_for(name, algo)
+    state = wf.init(jax.random.key(7))
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    seen = {jax.random.key_data(state.algorithm.key).tobytes()}
+    for _ in range(4):
+        state = step(state)
+        data = jax.random.key_data(state.algorithm.key).tobytes()
+        assert data not in seen, f"{name}: PRNG key did not advance"
+        seen.add(data)
+
+
+@pytest.mark.parametrize("name,algo", _algorithms(), ids=lambda a: a if isinstance(a, str) else "")
+def test_distinct_draws_across_generations(name, algo):
+    """Successive generations must produce distinct populations — under key
+    reuse the per-generation random increments repeat exactly."""
+    wf = _workflow_for(name, algo)
+    state = wf.init(jax.random.key(3))
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    # ES variants keep only the search distribution (center) in state; the
+    # sampled population is ephemeral.  Either leaf must move every step.
+    leaf = "pop" if "pop" in state.algorithm else "center"
+    snaps = []
+    for _ in range(3):
+        state = step(state)
+        snaps.append(state.algorithm[leaf])
+    # Bitwise comparison, not allclose: near an optimum the legitimate
+    # updates are tiny, but a repeated draw would reproduce them EXACTLY.
+    assert not jnp.array_equal(snaps[0], snaps[1]), f"{name}: generation repeated"
+    assert not jnp.array_equal(snaps[1], snaps[2]), f"{name}: generation repeated"

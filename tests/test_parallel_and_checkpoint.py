@@ -226,6 +226,26 @@ def test_checkpoint_round_trip(tmp_path, key):
     )
 
 
+def test_checkpoint_preserves_weak_typed_scalars(tmp_path, key):
+    """Scalar hyperparameters built from Python floats (``Parameter(0.05)``)
+    are weak-typed; a round-trip must hand back the SAME avals, or every
+    jitted function recompiles once on resume (the compile-sentinel gate,
+    tests/test_compile_sentinel.py, caught exactly this on OpenES)."""
+    from evox_tpu.core import Parameter
+
+    state = State(lr=Parameter(0.05), steps=Parameter(3), pop=jnp.zeros((4, 2)))
+    save_state(tmp_path / "weak.npz", state)
+    restored = load_state(tmp_path / "weak.npz", state)
+    for name in ("lr", "steps", "pop"):
+        live, back = state[name], restored[name]
+        assert jax.api_util.shaped_abstractify(live) == jax.api_util.shaped_abstractify(
+            back
+        ), name
+        np.testing.assert_array_equal(np.asarray(live), np.asarray(back))
+    assert restored.lr.weak_type and restored.steps.weak_type
+    assert not restored.pop.weak_type
+
+
 def test_checkpoint_suffixless_path_round_trips(tmp_path, key):
     """``np.savez`` silently appends ``.npz`` to suffix-less paths;
     ``load_state`` must accept the same path string ``save_state`` did."""

@@ -1,17 +1,22 @@
-"""CI wiring for repo tooling: the bare-assert ratchet lint.
+"""CI wiring for repo tooling: the graftlint static-analysis suite.
 
-Keeping the lint inside tier-1 means a PR that adds a bare ``assert`` for
-user-input validation to library code fails tests, not just an optional
-lint lane (the rationale and the ratchet mechanics live in
-``tools/lint_asserts.py``)."""
+Keeping the lints inside tier-1 means a PR that adds a bare ``assert``, a
+PRNG key reuse, a host sync in a jitted step, or any other GL-rule violation
+to library code fails tests, not just an optional lint lane.  Rule mechanics
+live in ``tools/graftlint/`` (GL000 is PR 1's assert ratchet, folded in
+behind its original baseline and the ``tools/lint_asserts.py`` shim)."""
 
 import importlib.util
 import pathlib
+import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
 
 
 def _load_lint():
+    """Load the lint_asserts SHIM exactly the way external callers would —
+    by file path — so the backwards-compatible surface stays locked."""
     spec = importlib.util.spec_from_file_location(
         "lint_asserts", REPO / "tools" / "lint_asserts.py"
     )
@@ -35,3 +40,44 @@ def test_resilience_subsystem_is_assert_free():
     assert not offenders, offenders
     baseline = lint.load_baseline()
     assert not any(k.startswith("evox_tpu/resilience") for k in baseline)
+
+
+def test_graftlint_full_suite_clean_against_baselines():
+    """The whole rule set (GL000-GL005) over evox_tpu/ must be clean against
+    the committed ratchet baselines — the tier-1 equivalent of
+    ``python -m tools.graftlint`` exiting 0."""
+    from tools.graftlint import check_ratchet, load_baselines, scan_paths
+    from tools.graftlint.rules import RULES
+
+    findings = scan_paths([REPO / "evox_tpu"], RULES)
+    problems, violating = check_ratchet(findings, load_baselines())
+    assert not problems, "\n".join(
+        [f.format(hints=True) for f in violating] + problems
+    )
+
+
+def test_lint_asserts_shim_cli_matches_graftlint_gl000():
+    """The shim's scan() must agree with running graftlint GL000 directly."""
+    from tools.graftlint import group_counts, scan_paths
+    from tools.graftlint.rules import RULES_BY_CODE
+
+    lint = _load_lint()
+    direct = group_counts(
+        scan_paths([REPO / "evox_tpu"], [RULES_BY_CODE["GL000"]])
+    ).get("GL000", {})
+    assert lint.scan() == dict(sorted(direct.items()))
+
+
+def test_update_baseline_shim_reexports_bench_table():
+    """tools/update_baseline.py stays a working entry point after the merge
+    into `python -m tools.graftlint bench-table`."""
+    spec = importlib.util.spec_from_file_location(
+        "update_baseline", REPO / "tools" / "update_baseline.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for attr in ("main", "build_table", "rebaseline_history", "BEGIN", "END", "ROWS"):
+        assert hasattr(mod, attr), attr
+    # --check against the committed table must pass (the table is mechanical
+    # and may never drift from BENCH_ALL.json).
+    assert mod.main(["--check"]) == 0

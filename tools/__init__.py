@@ -1,0 +1,4 @@
+# Package marker so `python -m tools.graftlint` (and intra-tool imports)
+# resolve from the repo root.  Scripts in this directory remain directly
+# runnable (`python tools/lint_asserts.py`) — they insert the repo root on
+# sys.path themselves.

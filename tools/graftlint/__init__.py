@@ -1,0 +1,59 @@
+"""graftlint — JAX-aware static analysis + compile-cache sentinels for evox_tpu.
+
+Static side (``engine.py`` + ``rules.py``): AST rules GL000-GL005 over the
+library, each with a ``# graftlint: disable=GLxxx`` pragma and a per-rule
+ratchet baseline (finding counts only go DOWN — the same semantics PR 1's
+assert lint established).  CLI: ``python -m tools.graftlint``.
+
+Runtime side (``compile_sentinel.py``): :class:`CompileSentinel`, a context
+manager over ``jax.log_compiles`` that counts XLA compilations so tests can
+assert a workflow step compiles exactly once across a run — the compile-cache
+regression gate (``tests/test_compile_sentinel.py``).
+"""
+
+from .engine import (
+    Finding,
+    Module,
+    Rule,
+    check_ratchet,
+    group_counts,
+    load_baselines,
+    scan_paths,
+    update_baselines,
+)
+from .rules import RULES, RULES_BY_CODE, STEP_FAMILY
+
+__all__ = [
+    "CompileSentinel",
+    "RecompileError",
+    "Finding",
+    "Module",
+    "Rule",
+    "RULES",
+    "RULES_BY_CODE",
+    "STEP_FAMILY",
+    "scan_paths",
+    "group_counts",
+    "check_ratchet",
+    "load_baselines",
+    "update_baselines",
+    "main",
+]
+
+
+def main(argv=None):
+    """CLI entry point (see ``cli.py``)."""
+    from .cli import main as _main
+
+    return _main(argv)
+
+
+def __getattr__(name):
+    # CompileSentinel pulls in jax; import it lazily so the static-analysis
+    # CLI stays jax-free (the lint lane runs outside the CPU-pinned test env
+    # and must never touch the TPU tunnel).
+    if name in ("CompileSentinel", "RecompileError"):
+        from . import compile_sentinel
+
+        return getattr(compile_sentinel, name)
+    raise AttributeError(name)

@@ -1,0 +1,19 @@
+import signal
+import sys
+from pathlib import Path
+
+# Make `python tools/graftlint` work from anywhere in the repo, not just via
+# `python -m tools.graftlint` from the root.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+# Standalone process only (never in-process callers): die quietly on a
+# closed pipe (`... --lint-fix-hints | head`) instead of tracebacking.
+try:
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+except (AttributeError, ValueError):  # pragma: no cover - non-POSIX
+    pass
+
+from tools.graftlint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

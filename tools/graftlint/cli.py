@@ -1,0 +1,136 @@
+"""graftlint CLI.
+
+::
+
+    python -m tools.graftlint                      # lint evox_tpu/ against the ratchet baselines
+    python -m tools.graftlint --select GL001,GL005 # subset of rules
+    python -m tools.graftlint path/to/file.py      # explicit files/dirs
+    python -m tools.graftlint --no-baseline        # absolute mode: any finding fails
+    python -m tools.graftlint --lint-fix-hints     # print the suggested rewrite per finding
+    python -m tools.graftlint --update-baseline    # after REMOVING findings (refuses increases)
+    python -m tools.graftlint --list-rules         # rule catalog
+    python -m tools.graftlint bench-table [--check] [--rebaseline]
+                                                   # regenerate BASELINE.md's measured table
+                                                   # (absorbed tools/update_baseline.py)
+
+Exit status: 0 clean, 1 findings over baseline (or stale bench table with
+``bench-table --check``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import bench_table
+from .engine import (
+    LIBRARY_ROOT,
+    check_ratchet,
+    load_baselines,
+    scan_paths,
+    update_baselines,
+)
+from .rules import RULES, RULES_BY_CODE
+
+__all__ = ["main"]
+
+
+def _parse_select(select: str | None) -> list[str]:
+    if not select:
+        return [r.code for r in RULES]
+    codes = [c.strip().upper() for c in select.split(",") if c.strip()]
+    unknown = [c for c in codes if c not in RULES_BY_CODE]
+    if unknown:
+        raise SystemExit(
+            f"unknown rule code(s) {unknown}; known: {sorted(RULES_BY_CODE)}"
+        )
+    return codes
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "bench-table":
+        return bench_table.main(argv[1:])
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX-aware static analysis for evox_tpu (rules GL000-GL005).",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint (default: evox_tpu/)")
+    ap.add_argument("--select", help="comma-separated rule codes, e.g. GL001,GL005")
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="record current counts for the selected rules (refuses increases)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore ratchet baselines: any finding is a failure",
+    )
+    ap.add_argument(
+        "--lint-fix-hints",
+        action="store_true",
+        help="print the suggested rewrite under each finding",
+    )
+    ap.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES:
+            print(f"{rule.code}  {rule.title}")
+            print(f"       fix: {rule.hint}")
+        return 0
+
+    codes = _parse_select(args.select)
+    rules = [RULES_BY_CODE[c] for c in codes]
+    paths = [Path(p) for p in args.paths] if args.paths else [LIBRARY_ROOT]
+    findings = scan_paths(paths, rules)
+
+    if args.update_baseline:
+        if args.paths:
+            # A partial scan would rewrite each selected rule's WHOLE map
+            # from a subset of files, silently deleting every unscanned
+            # file's budget; baseline updates are repo-scope by definition.
+            print(
+                "--update-baseline only works on a full scan (no explicit "
+                "paths): a partial scan would drop the unscanned files' "
+                "baseline entries"
+            )
+            return 1
+        ok, messages = update_baselines(findings, codes)
+        print("\n".join(messages))
+        return 0 if ok else 1
+
+    baselines = {} if args.no_baseline else load_baselines()
+    problems, violating = check_ratchet(findings, baselines)
+    if problems:
+        print("graftlint ratchet violations:")
+        for f in sorted(violating, key=lambda f: (f.rule, f.path, f.line)):
+            print(f"  {f.format(hints=args.lint_fix_hints)}")
+        print()
+        for p in problems:
+            print(f"  {p}")
+        print(
+            "\nFix the findings (python -m tools.graftlint --lint-fix-hints "
+            "prints suggested rewrites), pragma genuinely-intentional sites "
+            "with `# graftlint: disable=GLxxx` + a justification, or — if "
+            "findings were REMOVED elsewhere and the baseline is stale — "
+            "run: python -m tools.graftlint --update-baseline"
+        )
+        return 1
+    n_base = sum(sum(files.values()) for files in baselines.values())
+    by_rule = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = ", ".join(f"{c}:{n}" for c, n in sorted(by_rule.items())) or "none"
+    print(
+        f"graftlint OK — {len(findings)} baselined finding(s) ({summary}); "
+        f"ratchet budget {n_base}, nothing added"
+    )
+    if args.lint_fix_hints and findings:
+        print("\nbaselined findings (legacy debt, ratcheting toward zero):")
+        for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line)):
+            print(f"  {f.format(hints=True)}")
+    return 0

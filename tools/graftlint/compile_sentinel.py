@@ -1,0 +1,139 @@
+"""Runtime recompilation sentinel built on ``jax.log_compiles``.
+
+The static rules (GL003/GL004) catch recompile *hazards* by pattern; this
+module catches recompiles *in fact*: :class:`CompileSentinel` is a context
+manager that records every XLA compilation JAX performs inside its scope, so
+a test can assert a workflow's ``step`` compiles **exactly once** across a
+whole run — the compile-once invariant the framework's throughput story rests
+on (PAPER.md: per-generation recompilation silently turns a TPU run into a
+compile benchmark).
+
+Mechanics: ``jax.log_compiles`` raises JAX's compile-path log lines
+("Compiling <name> with global shapes and types ...") to WARNING; the
+sentinel attaches a capturing handler to the emitting loggers for the
+duration of the ``with`` block.  The log fires at lowering time — i.e. on
+every *tracing-cache miss* — so it counts recompiles even when the
+persistent compilation cache (``jax_compilation_cache_dir``) serves the
+binary from disk, which is exactly the event a compile-cache regression gate
+must count.
+
+Usage::
+
+    from tools.graftlint import CompileSentinel
+
+    step = jax.jit(wf.step)
+    with CompileSentinel() as sentinel:
+        for _ in range(10):
+            state = step(state)
+    sentinel.assert_compiles(1, match="step")   # RecompileError on violation
+
+Used by ``tests/test_compile_sentinel.py`` to gate an algorithm matrix (ES /
+DE / PSO / MOEA) at one compile per jitted entry point across 10 generations
+and across checkpoint resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import jax
+
+__all__ = ["CompileEvent", "CompileSentinel", "RecompileError"]
+
+# Loggers that emit the "Compiling <name> ..." line across jax 0.4.x-0.5.x;
+# attaching to all of them keeps the sentinel robust to the exact module the
+# installed version logs from.
+_COMPILE_LOGGER_NAMES = (
+    "jax._src.interpreters.pxla",
+    "jax._src.dispatch",
+    "jax._src.compiler",
+    "jax._src.pjit",
+)
+
+
+class RecompileError(AssertionError):
+    """A jitted function compiled more often than the test budgeted for."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CompileEvent:
+    """One XLA compilation: the jitted function's name plus the raw log."""
+
+    name: str
+    message: str
+
+
+class _CaptureHandler(logging.Handler):
+    def __init__(self, events: list[CompileEvent]):
+        super().__init__(level=logging.DEBUG)
+        self._events = events
+
+    def emit(self, record: logging.LogRecord) -> None:
+        msg = record.getMessage()
+        if not msg.startswith("Compiling "):
+            return  # ignore "Finished XLA compilation ..." companions
+        name = str(record.args[0]) if record.args else msg.split()[1]
+        self._events.append(CompileEvent(name=name, message=msg))
+
+
+class CompileSentinel:
+    """Context manager recording every XLA compilation in its scope."""
+
+    def __init__(self) -> None:
+        self.events: list[CompileEvent] = []
+
+    def __enter__(self) -> "CompileSentinel":
+        self._handler = _CaptureHandler(self.events)
+        self._log_ctx = jax.log_compiles(True)
+        self._log_ctx.__enter__()
+        self._loggers = [logging.getLogger(n) for n in _COMPILE_LOGGER_NAMES]
+        self._saved = [(lg.level, lg.propagate) for lg in self._loggers]
+        for lg in self._loggers:
+            lg.addHandler(self._handler)
+            if lg.getEffectiveLevel() > logging.WARNING:
+                lg.setLevel(logging.WARNING)
+            # Capture only: keep the raised-to-WARNING compile logs out of
+            # the test output / root handlers for the duration.
+            lg.propagate = False
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        for lg, (level, propagate) in zip(self._loggers, self._saved):
+            lg.removeHandler(self._handler)
+            lg.setLevel(level)
+            lg.propagate = propagate
+        self._log_ctx.__exit__(*exc_info)
+
+    # -- queries ------------------------------------------------------------
+    def names(self) -> list[str]:
+        return [e.name for e in self.events]
+
+    def count(self, match: str | None = None, exact: bool = False) -> int:
+        """Number of compilations whose function name contains ``match``
+        (``exact=True``: equals it).  ``match=None`` counts everything."""
+        if match is None:
+            return len(self.events)
+        if exact:
+            return sum(e.name == match for e in self.events)
+        return sum(match in e.name for e in self.events)
+
+    # -- assertion ----------------------------------------------------------
+    def assert_compiles(
+        self, expected: int, match: str | None = None, exact: bool = False
+    ) -> None:
+        """Raise :class:`RecompileError` unless exactly ``expected``
+        compilations matched.  The error lists every captured event — the
+        first thing to read when the compile-cache gate trips (a second
+        "Compiling step ..." line means something in the step's trace varies
+        per call: changing shapes/dtypes/weak-types, a Python value baked
+        into the cache key, or a host branch; see
+        docs/guide/static-analysis.md)."""
+        got = self.count(match, exact=exact)
+        if got != expected:
+            what = f"functions matching {match!r}" if match else "jitted functions"
+            listing = "\n".join(f"  - {e.name}" for e in self.events) or "  (none)"
+            raise RecompileError(
+                f"expected exactly {expected} XLA compilation(s) of {what}, "
+                f"observed {got}. All compilations in scope:\n{listing}"
+            )

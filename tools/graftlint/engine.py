@@ -1,0 +1,334 @@
+"""graftlint engine: file scanning, pragma suppression, ratchet baselines.
+
+The framework's performance story rests on every ``step``/``ask``/``tell``
+path staying pure, trace-safe, and compile-once under ``jax.jit``.  graftlint
+turns those invariants into machine-checked rules (``tools/graftlint/rules.py``
+holds GL000-GL005).  This module holds everything rule-independent:
+
+* :class:`Module` — one parsed source file handed to every rule, with the
+  shared AST/pragma analyses cached on it;
+* pragma suppression — ``# graftlint: disable=GL001`` on the offending line
+  (or on the ``def`` line of any enclosing function, which suppresses the
+  whole function body), and ``# graftlint: disable-file=GL001`` anywhere in
+  the file for file-wide suppression.  A bare ``disable`` suppresses every
+  rule;
+* per-rule / per-file **ratchet baselines** with the same only-goes-down
+  semantics PR 1's assert lint established: a file's finding count for a rule
+  may only DECREASE relative to the recorded baseline, and files outside the
+  baseline must be clean.  ``--update-baseline`` refuses to record increases.
+
+GL000 (bare asserts) keeps its pre-existing baseline file
+(``tools/assert_baseline.json``, plain ``{path: count}``) so nothing that
+consumed it breaks; every other rule ratchets through
+``tools/graftlint/baseline.json`` (``{rule: {path: count}}``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from functools import cached_property
+from pathlib import Path
+from typing import Iterable
+
+REPO = Path(__file__).resolve().parent.parent.parent
+LIBRARY_ROOT = REPO / "evox_tpu"
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+ASSERT_BASELINE_PATH = REPO / "tools" / "assert_baseline.json"
+
+# Codes are matched case-insensitively and normalized to upper-case: a
+# lowercase `disable=gl005` must mean GL005, not backtrack the optional
+# group into a bare suppress-everything `disable`.
+# The keyword is anchored (no prefix matching): a typo like `disabled=` or
+# `disable-files=` must be inert, not silently widen into a bare
+# suppress-everything `disable`.
+_PRAGMA = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-file)?)(?![A-Za-z0-9-])\s*"
+    r"(?:(=)\s*([A-Za-z0-9]+(?:\s*,\s*[A-Za-z0-9]+)*)?)?"
+)
+
+__all__ = [
+    "Finding",
+    "Module",
+    "Rule",
+    "scan_paths",
+    "group_counts",
+    "check_ratchet",
+    "load_baselines",
+    "update_baselines",
+    "REPO",
+    "LIBRARY_ROOT",
+    "BASELINE_PATH",
+    "ASSERT_BASELINE_PATH",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str  # "GL001"
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    hint: str = ""  # suggested rewrite, shown by --lint-fix-hints
+
+    def format(self, hints: bool = False) -> str:
+        s = f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        if hints and self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+
+class Module:
+    """A parsed source file plus the pragma/suppression analyses every rule
+    shares.  Rules receive one Module and return Findings; the engine then
+    drops suppressed findings and applies the ratchet."""
+
+    def __init__(self, path: Path, repo: Path = REPO):
+        self.path = path
+        try:
+            self.relpath = path.resolve().relative_to(repo).as_posix()
+        except ValueError:  # outside the repo (e.g. a tmp fixture)
+            self.relpath = path.as_posix()
+        self.source = path.read_text()
+        self.tree = ast.parse(self.source, filename=str(path))
+        self.lines = self.source.splitlines()
+
+    # -- pragmas ------------------------------------------------------------
+    def _comment_tokens(self) -> list[tuple[int, str]]:
+        """``(lineno, comment_text)`` for every real COMMENT token — pragma
+        syntax QUOTED in a docstring or string literal (e.g. documentation
+        that mentions ``disable-file``) must not act as a live pragma."""
+        import io
+        import tokenize
+
+        out = []
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    out.append((tok.start[0], tok.string))
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            # Unterminated constructs etc.: fall back to raw lines (the file
+            # already parsed as AST, so this is nearly unreachable).
+            out = list(enumerate(self.lines, start=1))
+        return out
+
+    @cached_property
+    def _pragmas(self) -> tuple[dict[int, frozenset[str] | None], frozenset[str] | None]:
+        """``(line -> codes, file_codes)``; ``None`` codes = every rule."""
+        per_line: dict[int, frozenset[str] | None] = {}
+        file_codes: set[str] = set()
+        file_all = False
+        for lineno, text in self._comment_tokens():
+            m = _PRAGMA.search(text)
+            if not m:
+                continue
+            kind, eq, codes_txt = m.groups()
+            if eq and not codes_txt:
+                # Truncated pragma (`disable=` with no codes): suppressing
+                # EVERYTHING on a typo would silently hide real findings —
+                # ignore it instead.
+                continue
+            codes = (
+                frozenset(c.strip().upper() for c in codes_txt.split(",") if c.strip())
+                if codes_txt
+                else None
+            )
+            if kind == "disable-file":
+                if codes is None:
+                    file_all = True
+                else:
+                    file_codes |= codes
+            else:
+                prev = per_line.get(lineno, frozenset())
+                per_line[lineno] = (
+                    None if codes is None or prev is None else prev | codes
+                )
+        return per_line, (None if file_all else frozenset(file_codes))
+
+    @cached_property
+    def _function_spans(self) -> list[tuple[int, int, int]]:
+        """``(def_line, start, end)`` for every function, for def-line
+        pragma scoping."""
+        spans = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                spans.append((node.lineno, node.lineno, node.end_lineno or node.lineno))
+        return spans
+
+    def _line_disables(self, lineno: int, code: str) -> bool:
+        per_line, _ = self._pragmas
+        codes = per_line.get(lineno, frozenset())
+        return codes is None or code in codes
+
+    def suppressed(self, finding: Finding) -> bool:
+        _, file_codes = self._pragmas
+        if file_codes is None or finding.rule in file_codes:
+            return True
+        if self._line_disables(finding.line, finding.rule):
+            return True
+        # A pragma on the def line of any enclosing function suppresses the
+        # whole body — the ergonomic escape hatch for intentionally host-side
+        # or trace-time-impure functions.
+        for def_line, start, end in self._function_spans:
+            if start <= finding.line <= end and self._line_disables(def_line, finding.rule):
+                return True
+        return False
+
+
+class Rule:
+    """Base class: subclasses set ``code``/``title``/``hint`` and implement
+    :meth:`check`."""
+
+    code: str = "GL???"
+    title: str = ""
+    hint: str = ""
+
+    def check(self, mod: Module) -> list[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: Module, node: ast.AST, message: str, hint: str | None = None) -> Finding:
+        return Finding(
+            rule=self.code,
+            path=mod.relpath,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            hint=self.hint if hint is None else hint,
+        )
+
+
+# ---------------------------------------------------------------------------
+# scanning
+# ---------------------------------------------------------------------------
+
+def iter_python_files(paths: Iterable[Path]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+    return files
+
+
+def scan_paths(
+    paths: Iterable[Path],
+    rules: Iterable[Rule],
+    keep_suppressed: bool = False,
+) -> list[Finding]:
+    """Run ``rules`` over every ``.py`` under ``paths``; pragma-suppressed
+    findings are dropped unless ``keep_suppressed``."""
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            mod = Module(path)
+        except SyntaxError as e:
+            findings.append(
+                Finding("GL-SYNTAX", str(path), e.lineno or 1, 0, f"syntax error: {e.msg}")
+            )
+            continue
+        for rule in rules:
+            for f in rule.check(mod):
+                if keep_suppressed or not mod.suppressed(f):
+                    findings.append(f)
+    return findings
+
+
+def group_counts(findings: Iterable[Finding]) -> dict[str, dict[str, int]]:
+    """``{rule: {path: count}}`` over the given findings."""
+    counts: dict[str, dict[str, int]] = {}
+    for f in findings:
+        counts.setdefault(f.rule, {})
+        counts[f.rule][f.path] = counts[f.rule].get(f.path, 0) + 1
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# ratchet baselines
+# ---------------------------------------------------------------------------
+
+def load_baselines() -> dict[str, dict[str, int]]:
+    """``{rule: {path: allowed_count}}``.  GL000 reads the legacy assert
+    baseline file; everything else reads ``baseline.json``."""
+    baselines: dict[str, dict[str, int]] = {}
+    if BASELINE_PATH.exists():
+        baselines.update(json.loads(BASELINE_PATH.read_text()))
+    if ASSERT_BASELINE_PATH.exists():
+        baselines["GL000"] = json.loads(ASSERT_BASELINE_PATH.read_text())
+    return baselines
+
+
+def check_ratchet(
+    findings: list[Finding],
+    baselines: dict[str, dict[str, int]],
+) -> tuple[list[str], list[Finding]]:
+    """Ratchet check: per (rule, file), the finding count may not exceed the
+    baseline.  Returns ``(violation_lines, violating_findings)`` — the
+    findings of every (rule, file) cell that is over budget, so the caller
+    can print exact locations (a cell at/below budget prints nothing, which
+    is what lets legacy findings ride in the baseline)."""
+    counts = group_counts(findings)
+    problems: list[str] = []
+    violating: list[Finding] = []
+    for rule_code in sorted(counts):
+        base = baselines.get(rule_code, {})
+        for path in sorted(counts[rule_code]):
+            n, allowed = counts[rule_code][path], base.get(path, 0)
+            if n > allowed:
+                problems.append(
+                    f"{path}: {n} {rule_code} finding(s), baseline allows {allowed}"
+                )
+                violating.extend(
+                    f for f in findings if f.rule == rule_code and f.path == path
+                )
+    return problems, violating
+
+
+def update_baselines(
+    findings: list[Finding],
+    selected_rules: Iterable[str],
+) -> tuple[bool, list[str]]:
+    """Record current counts for ``selected_rules`` — refusing any increase,
+    so the baselines only ratchet toward zero.  Returns ``(ok, messages)``."""
+    counts = group_counts(findings)
+    baselines = load_baselines()
+    grew: list[str] = []
+    for rule_code in selected_rules:
+        if rule_code not in baselines:
+            continue  # first-time seed for a new rule's legacy debt: allowed
+        new = counts.get(rule_code, {})
+        old = baselines[rule_code]
+        for path, n in new.items():
+            if n > old.get(path, 0):
+                grew.append(f"  {rule_code} {path}: {old.get(path, 0)} -> {n}")
+    if grew:
+        return False, ["refusing to ratchet UP; fix these findings instead:"] + grew
+    messages = []
+    for rule_code in selected_rules:
+        new = {p: n for p, n in sorted(counts.get(rule_code, {}).items()) if n}
+        if rule_code == "GL000":
+            ASSERT_BASELINE_PATH.write_text(
+                json.dumps(new, indent=2, sort_keys=True) + "\n"
+            )
+        else:
+            all_rules = (
+                json.loads(BASELINE_PATH.read_text()) if BASELINE_PATH.exists() else {}
+            )
+            # Always write the section, even empty: popping a zeroed rule
+            # would drop it from load_baselines() and silently re-arm the
+            # "first-time seed" path — new debt could then be recorded
+            # without tripping the refuse-increases check.
+            all_rules[rule_code] = new
+            BASELINE_PATH.write_text(
+                json.dumps(all_rules, indent=2, sort_keys=True) + "\n"
+            )
+        total = sum(new.values())
+        messages.append(
+            f"{rule_code}: baseline updated ({total} finding(s) across {len(new)} file(s))"
+        )
+    return True, messages

@@ -1,0 +1,966 @@
+"""graftlint rules GL000-GL005: the JAX footguns that burn TPU runs.
+
+evosax (arXiv:2212.04180) and EvoX (arXiv:2301.12457) both identify
+tensorized purity and stable compilation caches as the load-bearing
+invariants of GPU/TPU-native EC.  Each rule below turns one class of
+violation into a machine-checked finding:
+
+* **GL000** — bare ``assert`` in library code (vanishes under ``python -O``);
+  the PR 1 assert lint folded in behind its existing baseline.
+* **GL001** — PRNG key reuse: a key consumed by ``jax.random.*``/``split``
+  (or passed into a helper) and then consumed again without re-splitting,
+  including consumed keys stored back into a returned ``State`` (explicitly
+  via ``replace(key=...)``/``State(key=...)`` or implicitly by returning a
+  state whose key leaf was consumed and never replaced).
+* **GL002** — host sync inside compiled paths: ``.item()``/``.tolist()``/
+  ``np.asarray``/``float()``/``int()``/``bool()`` on traced values inside
+  ``step``-family methods and functions reachable from them.
+* **GL003** — Python ``if``/``while`` on traced values where
+  ``jax.lax.cond``/``lax.while_loop``/``jnp.where`` is required.
+* **GL004** — recompile hazards: ``jnp.array`` built from non-constant
+  Python lists, Python ``for`` loops iterating traced arrays (silent
+  unrolling), f-strings derived from traced values or array shapes.
+* **GL005** — impure compiled methods: assignment to ``self.*`` inside the
+  ``step`` family (components must stay static under jit; evolving values
+  belong in the ``State``).
+
+**Compiled scope.**  GL002-GL005 only apply inside functions that trace
+under ``jax.jit``: methods/functions named ``step``/``init_step``/
+``final_step``/``ask``/``tell``/``evaluate`` plus the monitor hook names,
+and everything reachable from them through same-module calls (``self.x()``
+and bare ``f()``).  Nested functions inherit the enclosing scope, except
+functions handed to ``io_callback``/``pure_callback``/``jax.debug.callback``
+— those run on the host by construction and are exempt.
+
+All checks are AST heuristics tuned for zero false positives on this
+codebase; genuine-but-intentional sites carry a
+``# graftlint: disable=GLxxx`` pragma with a justification comment, and
+legacy debt rides the per-rule ratchet baselines (see ``engine.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from .engine import Finding, Module, Rule
+
+__all__ = ["RULES", "RULES_BY_CODE", "STEP_FAMILY"]
+
+# Methods that trace under jax.jit: the Algorithm/Problem/Workflow step
+# family plus the Monitor hooks StdWorkflow calls inside the jitted step.
+STEP_FAMILY = frozenset(
+    {
+        "step",
+        "init_step",
+        "final_step",
+        "ask",
+        "tell",
+        "evaluate",
+        "post_ask",
+        "pre_eval",
+        "post_eval",
+        "pre_tell",
+        "record_nonfinite",
+        "record_auxiliary",
+    }
+)
+
+# Functions whose first argument runs on the HOST, not in the trace.
+_HOST_CALLBACK_FNS = frozenset(
+    {"io_callback", "pure_callback", "callback", "debug_callback"}
+)
+
+# Attribute projections that are static (Python values) even on tracers.
+# NOT `.at`: `x.at[i].set(v)` is the standard functional-update idiom and its
+# result is every bit as traced as x.
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding"})
+
+# Calls that return static/host values even when handed traced arguments'
+# static projections (dtype etc.).
+_STATIC_CALLS = frozenset(
+    {
+        "len",
+        "isinstance",
+        "issubclass",
+        "hasattr",
+        "getattr",
+        "callable",
+        "type",
+        "range",
+        "finfo",
+        "iinfo",
+        "issubdtype",
+        "result_type",
+        "canonicalize_dtype",
+        "comb",
+        "tree_structure",
+        "ndim",
+    }
+)
+
+_KEY_NAME = re.compile(r"(^key$|_key$|^subkeys?$|^rng$|_rng$)")
+
+
+def _terminates(block: list[ast.stmt]) -> bool:
+    """Whether a block always leaves the enclosing FUNCTION (so its effects
+    never reach any later code).  Return/Raise only: break/continue merely
+    leave the loop — a key consumed before them is still consumed for the
+    post-loop code and the next iteration."""
+    return bool(block) and isinstance(block[-1], (ast.Return, ast.Raise))
+
+# Calls a key may pass through without being consumed: derivation helpers,
+# metadata queries, and host-side formatting (str(key) in an error message is
+# not a draw).
+_KEY_TRANSPARENT = frozenset(
+    {
+        "fold_in",
+        "key_data",
+        "wrap_key_data",
+        "PRNGKey",
+        "key",
+        "clone",
+        "issubdtype",
+        "isinstance",
+        "str",
+        "repr",
+        "format",
+        "print",
+        "len",
+        "type",
+        "hash",
+        "id",
+        "hasattr",
+        "getattr",
+    }
+)
+
+# A dotted "key" chain rooted at a module is API surface, not a key value
+# (``jax.dtypes.prng_key``, ``jax.random.key``).
+_MODULE_ROOTS = frozenset({"jax", "jnp", "np", "numpy", "lax", "random"})
+
+_EXC_NAME = re.compile(r"(Error|Exception|Warning)$")
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``jax.random.split`` -> "jax.random.split"; None for non-name chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _key_expr_id(node: ast.AST) -> str | None:
+    """Identity of a key-like expression: a Name matching the key pattern, or
+    a short dotted chain ending in one (``state.key``)."""
+    if isinstance(node, ast.Name) and _KEY_NAME.search(node.id):
+        return node.id
+    if isinstance(node, ast.Attribute) and _KEY_NAME.search(node.attr):
+        root = _dotted(node)
+        if root and root.count(".") <= 2 and root.split(".", 1)[0] not in _MODULE_ROOTS:
+            return root
+    return None
+
+
+def _iter_functions(
+    tree: ast.Module,
+) -> Iterator[tuple[ast.FunctionDef | ast.AsyncFunctionDef, str | None, ast.AST | None]]:
+    """Yield ``(func, class_name, enclosing_func)`` for every function."""
+
+    def walk(node: ast.AST, cls: str | None, fn: ast.AST | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls, fn
+                yield from walk(child, cls, child)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name, None)
+            else:
+                yield from walk(child, cls, fn)
+
+    yield from walk(tree, None, None)
+
+
+def _body_walk(fn: ast.AST, *, into_nested: bool = False) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested def/class scopes
+    (unless ``into_nested``); lambdas are always descended (they inline into
+    the trace)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if not into_nested:
+                continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _host_callback_names(fn: ast.AST) -> frozenset[str]:
+    """Names of nested functions passed to io_callback/pure_callback/... —
+    host-side by construction, exempt from compiled-scope rules."""
+    names = set()
+    for node in _body_walk(fn, into_nested=True):
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func) or ""
+            if chain.rsplit(".", 1)[-1] in _HOST_CALLBACK_FNS and node.args:
+                if isinstance(node.args[0], ast.Name):
+                    names.add(node.args[0].id)
+    return frozenset(names)
+
+
+def compiled_functions(mod: Module) -> list[ast.AST]:
+    """Top-level (non-nested) functions that trace under jit: the step family
+    plus same-module call-graph closure via ``self.m()`` / bare ``f()``."""
+    all_funcs = list(_iter_functions(mod.tree))
+    module_funcs: dict[str, list[ast.AST]] = {}
+    class_methods: dict[tuple[str, str], ast.AST] = {}
+    for fn, cls, enclosing in all_funcs:
+        if enclosing is not None:
+            continue  # nested defs handled inline by the body walkers
+        if cls is None:
+            module_funcs.setdefault(fn.name, []).append(fn)
+        else:
+            class_methods[(cls, fn.name)] = fn
+
+    fn_class = {id(fn): cls for fn, cls, enc in all_funcs if enc is None}
+    compiled: list[ast.AST] = []
+    seen: set[int] = set()
+    queue: list[ast.AST] = [
+        fn for fn, cls, enc in all_funcs if enc is None and fn.name in STEP_FAMILY
+    ]
+    while queue:
+        fn = queue.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        compiled.append(fn)
+        cls = fn_class.get(id(fn))
+        for node in _body_walk(fn, into_nested=True):
+            if not isinstance(node, ast.Call):
+                continue
+            callee: list[ast.AST] = []
+            if isinstance(node.func, ast.Name):
+                callee = module_funcs.get(node.func.id, [])
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and cls is not None
+            ):
+                target = class_methods.get((cls, node.func.attr))
+                callee = [target] if target is not None else []
+            for c in callee:
+                if c.name not in ("__init__", "setup") and id(c) not in seen:
+                    queue.append(c)
+    return compiled
+
+
+# ---------------------------------------------------------------------------
+# taint: which expressions are traced values inside a compiled function
+# ---------------------------------------------------------------------------
+
+_SEED_PARAM_NAMES = frozenset(
+    {"state", "pop", "population", "fit", "fitness", "fitnesses", "key", "keys", "mask", "aux"}
+)
+_ARRAYISH_ANNOTATIONS = frozenset(
+    {"State", "Array", "ndarray", "ArrayLike", "jax.Array", "jnp.ndarray"}
+)
+_CALLABLE_ANNOTATIONS = frozenset({"EvalFn", "Callable"})
+
+
+class _Taint:
+    """Statement-ordered taint tracking over one compiled function (nested
+    non-host defs walked inline, sharing the environment — closures trace
+    into the same program)."""
+
+    def __init__(self, fn: ast.AST):
+        self.tainted: set[str] = set()
+        self.traced_callables: set[str] = set()
+        # Per-field taint for dict literals with constant-string keys: a
+        # carrier dict mixing traced leaves with host bookkeeping ints
+        # (std_workflow's evaluate carrier) must not taint the host fields.
+        self.dict_fields: dict[str, dict[str, bool]] = {}
+        self._seed_params(fn)
+
+    def _seed_params(self, fn: ast.AST) -> None:
+        args = fn.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs) + (
+            [args.vararg] if args.vararg else []
+        ):
+            ann = _dotted(a.annotation) if a.annotation is not None else None
+            ann_tail = (ann or "").rsplit(".", 1)[-1]
+            if ann in _ARRAYISH_ANNOTATIONS or ann_tail in _ARRAYISH_ANNOTATIONS:
+                self.tainted.add(a.arg)
+            elif ann in _CALLABLE_ANNOTATIONS or ann_tail in _CALLABLE_ANNOTATIONS:
+                self.traced_callables.add(a.arg)
+            elif ann is None and (
+                a.arg in _SEED_PARAM_NAMES or _KEY_NAME.search(a.arg)
+            ):
+                self.tainted.add(a.arg)
+
+    # -- expression query ---------------------------------------------------
+    def is_traced(self, node: ast.AST | None) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False  # x.shape / x.ndim / x.dtype are static
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func) or ""
+            tail = chain.rsplit(".", 1)[-1]
+            if tail in _STATIC_CALLS:
+                return False
+            if isinstance(node.func, ast.Name) and node.func.id in self.traced_callables:
+                return True  # evaluate(pop) -> fitness array
+            everything = list(node.args) + [k.value for k in node.keywords]
+            if any(self.is_traced(a) for a in everything):
+                return True
+            return self.is_traced(node.func) if isinstance(node.func, ast.Attribute) else False
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            return any(self.is_traced(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.is_traced(v) for v in node.values if v is not None)
+        if isinstance(node, ast.Starred):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Subscript):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id in self.dict_fields
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+            ):
+                fields = self.dict_fields[node.value.id]
+                if node.slice.value in fields:
+                    return fields[node.slice.value]
+            return self.is_traced(node.value) or self.is_traced(node.slice)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_traced(node.left) or self.is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_traced(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_traced(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `"leaf" in state` / `x is None`: structural queries, static
+            # under trace even on a traced container.
+            if all(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops) and isinstance(
+                node.left, ast.Constant
+            ):
+                return False
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self.is_traced(node.left) or any(
+                self.is_traced(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self.is_traced(node.body) or self.is_traced(node.orelse)
+        if isinstance(node, ast.JoinedStr):
+            return any(
+                self.is_traced(v.value)
+                for v in node.values
+                if isinstance(v, ast.FormattedValue)
+            )
+        if isinstance(node, ast.Slice):
+            return any(self.is_traced(p) for p in (node.lower, node.upper, node.step))
+        return False
+
+    # -- statement-ordered propagation --------------------------------------
+    def assign(self, target: ast.AST, traced: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.tainted.add if traced else self.tainted.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e, traced)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, traced)
+
+    def _record_dict_literal(self, name: str, value: ast.Dict) -> bool:
+        fields: dict[str, bool] = {}
+        for k, v in zip(value.keys, value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                return False  # dynamic keys: fall back to whole-name taint
+            fields[k.value] = self.is_traced(v)
+        self.dict_fields[name] = fields
+        return True
+
+    def visit_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, ast.Assign):
+            if (
+                len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Dict)
+                and self._record_dict_literal(stmt.targets[0].id, stmt.value)
+            ):
+                fields = self.dict_fields[stmt.targets[0].id]
+                self.assign(stmt.targets[0], any(fields.values()))
+                return
+            if (
+                len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Subscript)
+                and isinstance(stmt.targets[0].value, ast.Name)
+                and stmt.targets[0].value.id in self.dict_fields
+                and isinstance(stmt.targets[0].slice, ast.Constant)
+                and isinstance(stmt.targets[0].slice.value, str)
+            ):
+                self.dict_fields[stmt.targets[0].value.id][
+                    stmt.targets[0].slice.value
+                ] = self.is_traced(stmt.value)
+                return
+            traced = self.is_traced(stmt.value)
+            for t in stmt.targets:
+                self.assign(t, traced)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self.assign(stmt.target, self.is_traced(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            if self.is_traced(stmt.value):
+                self.assign(stmt.target, True)
+        elif isinstance(stmt, ast.For):
+            self.assign(stmt.target, self.is_traced(stmt.iter))
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self.assign(
+                        item.optional_vars, self.is_traced(item.context_expr)
+                    )
+
+
+def _compiled_statements(
+    fn: ast.AST, host_names: frozenset[str], taint: _Taint
+) -> Iterator[ast.AST]:
+    """Statement-ordered walk of a compiled function: propagates taint as it
+    goes and yields every node; nested defs walked inline unless they are
+    host callbacks (their params seeded like the parent's)."""
+
+    def walk(node: ast.AST) -> Iterator[ast.AST]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in host_names:
+                return  # host callback: exempt
+            inner = _Taint(node)
+            inner.tainted |= taint.tainted
+            inner.traced_callables |= taint.traced_callables
+            inner.dict_fields.update(taint.dict_fields)
+            # The nested function traces into the same program; its findings
+            # use the shared (approximate) environment.
+            yield from _compiled_statements(node, host_names, inner)
+            return
+        if isinstance(node, ast.ClassDef):
+            return
+        if isinstance(node, ast.stmt):
+            taint.visit_stmt(node)
+        yield node
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child)
+
+    for child in ast.iter_child_nodes(fn):
+        yield from walk(child)
+
+
+# ---------------------------------------------------------------------------
+# GL000 — bare asserts (PR 1's assert lint, folded in)
+# ---------------------------------------------------------------------------
+
+
+class BareAssertRule(Rule):
+    code = "GL000"
+    title = "bare assert in library code"
+    hint = (
+        "asserts vanish under `python -O`; raise ValueError/TypeError with "
+        "the offending values instead (see parallel/sharded_problem.py for "
+        "the idiom)"
+    )
+
+    def check(self, mod: Module) -> list[Finding]:
+        return [
+            self.finding(
+                mod,
+                node,
+                "bare `assert` in library code — validation must survive "
+                "`python -O`",
+            )
+            for node in ast.walk(mod.tree)
+            if isinstance(node, ast.Assert)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# GL001 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+
+class KeyReuseRule(Rule):
+    code = "GL001"
+    title = "PRNG key reuse"
+    hint = (
+        "split before reuse: `key, subkey = jax.random.split(key)` and give "
+        "every consumer its own subkey; a state must carry a fresh key "
+        "forward (`state.replace(key=new_key)`)"
+    )
+
+    def check(self, mod: Module) -> list[Finding]:
+        findings: list[Finding] = []
+        for fn, _cls, _enc in _iter_functions(mod.tree):
+            findings.extend(self._check_function(mod, fn))
+        return findings
+
+    # Consumption model: any call that receives a key-like expression uses it
+    # up, except the key-transparent derivation calls (fold_in etc.) and the
+    # store sites (State(...)/.replace(...)) — storing a FRESH key forward is
+    # the contract, storing a CONSUMED key is the bug.
+    def _check_function(self, mod: Module, fn: ast.AST) -> list[Finding]:
+        findings: list[Finding] = []
+        consumed: dict[str, int] = {}  # key id -> line of consuming use
+        flagged: set[tuple[int, str]] = set()
+
+        def flag(node: ast.AST, key_id: str, message: str) -> None:
+            if (node.lineno, key_id) in flagged:
+                return
+            flagged.add((node.lineno, key_id))
+            findings.append(self.finding(mod, node, message))
+
+        def consume(node: ast.AST, key_id: str) -> None:
+            if key_id in consumed:
+                flag(
+                    node,
+                    key_id,
+                    f"PRNG key `{key_id}` reused — already consumed on line "
+                    f"{consumed[key_id]}; every use needs a fresh split",
+                )
+            else:
+                consumed[key_id] = node.lineno
+
+        def clear_root(name: str) -> None:
+            consumed.pop(name, None)
+            for k in [k for k in consumed if k.startswith(name + ".")]:
+                consumed.pop(k)
+
+        def handle_store(call: ast.Call) -> None:
+            # State(key=...) / state.replace(key=...): storing a consumed key
+            # back into a state leaf is deferred reuse.
+            for kw in call.keywords:
+                key_id = _key_expr_id(kw.value) if kw.value is not None else None
+                if key_id and key_id in consumed:
+                    flag(
+                        call,
+                        key_id,
+                        f"consumed PRNG key `{key_id}` (used on line "
+                        f"{consumed[key_id]}) stored back into the state — the "
+                        "next step will draw the same randomness again",
+                    )
+
+        def handle_call(call: ast.Call) -> None:
+            chain = _dotted(call.func) or ""
+            tail = chain.rsplit(".", 1)[-1]
+            if tail in _KEY_TRANSPARENT or _EXC_NAME.search(tail):
+                return  # derivation/formatting/exception message: not a draw
+            if tail == "replace" or tail == "State":
+                handle_store(call)
+                return
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                key_id = _key_expr_id(arg)
+                if key_id is not None:
+                    consume(arg, key_id)
+
+        def visit_expr(node: ast.AST) -> None:
+            # Innermost calls first: `split(key)` inside an assignment must
+            # consume before the assignment target rebinds.
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+                    continue
+                visit_expr(child)
+            if isinstance(node, ast.Call):
+                handle_call(node)
+
+        def visit_block(stmts: list[ast.stmt]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(stmt, ast.Assign):
+                    visit_expr(stmt.value)
+                    for t in stmt.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                clear_root(n.id)
+                elif isinstance(stmt, ast.AnnAssign):
+                    if stmt.value is not None:
+                        visit_expr(stmt.value)
+                    if isinstance(stmt.target, ast.Name):
+                        clear_root(stmt.target.id)
+                elif isinstance(stmt, ast.AugAssign):
+                    visit_expr(stmt.value)
+                elif isinstance(stmt, ast.If):
+                    visit_expr(stmt.test)
+                    before = dict(consumed)
+                    visit_block(stmt.body)
+                    after_body = dict(consumed)
+                    consumed.clear()
+                    consumed.update(before)
+                    visit_block(stmt.orelse)
+                    # Union: consumed on either branch is consumed after —
+                    # except a branch that terminates (return/raise/...)
+                    # never reaches the fall-through code, so its
+                    # consumptions do not carry over.
+                    if not _terminates(stmt.body):
+                        for k, v in after_body.items():
+                            consumed.setdefault(k, v)
+                    if _terminates(stmt.orelse):
+                        consumed.clear()
+                        consumed.update(
+                            after_body if not _terminates(stmt.body) else before
+                        )
+                elif isinstance(stmt, (ast.For, ast.While)):
+                    if isinstance(stmt, ast.For):
+                        visit_expr(stmt.iter)
+                    else:
+                        visit_expr(stmt.test)
+                    # Two passes over the body: a key consumed in iteration 1
+                    # and not re-split is reused in iteration 2.  The loop
+                    # target rebinds fresh each iteration, so it (and any
+                    # dotted key rooted at it) clears before every pass.
+                    for _pass in range(2):
+                        if isinstance(stmt, ast.For):
+                            for n in ast.walk(stmt.target):
+                                if isinstance(n, ast.Name):
+                                    clear_root(n.id)
+                        visit_block(stmt.body)
+                    visit_block(stmt.orelse)
+                elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                    visit_expr(stmt.value)
+                    self._check_return(mod, stmt, consumed, findings, flagged)
+                elif isinstance(stmt, ast.Raise):
+                    pass  # error messages mention keys without drawing from them
+                elif isinstance(stmt, ast.Try):
+                    visit_block(stmt.body)
+                    for h in stmt.handlers:
+                        visit_block(h.body)
+                    visit_block(stmt.orelse)
+                    visit_block(stmt.finalbody)
+                elif isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        visit_expr(item.context_expr)
+                    visit_block(stmt.body)
+                else:
+                    for child in ast.iter_child_nodes(stmt):
+                        if isinstance(child, ast.expr):
+                            visit_expr(child)
+
+        visit_block([s for s in ast.iter_child_nodes(fn) if isinstance(s, ast.stmt)])
+        return findings
+
+    def _check_return(
+        self,
+        mod: Module,
+        stmt: ast.Return,
+        consumed: dict[str, int],
+        findings: list[Finding],
+        flagged: set[tuple[int, str]],
+    ) -> None:
+        """Returning a state whose stored key was consumed but never replaced
+        (`return state.replace(fit=...)` after `jax.random.foo(state.key)`)
+        hands the caller a state that will re-draw the same randomness."""
+        value = stmt.value
+        for key_id, line in list(consumed.items()):
+            # rpartition: the LAST component is the key attribute (a deep id
+            # like `self.state.key` replaces via `key=`, not `state.key=`).
+            root, _, attr = key_id.rpartition(".")
+            if not attr or not root:
+                continue
+            root_name = root.split(".", 1)[0]
+            returns_root = any(
+                isinstance(n, ast.Name) and n.id == root_name
+                for n in ast.walk(value)
+            )
+            if not returns_root:
+                continue
+            # Either update idiom carries a fresh key forward:
+            # `state.replace(key=...)` or a rebuilt `State(key=...)`.
+            replaces_key = any(
+                isinstance(n, ast.Call)
+                and (
+                    (isinstance(n.func, ast.Attribute) and n.func.attr == "replace")
+                    or (_dotted(n.func) or "").rsplit(".", 1)[-1] == "State"
+                )
+                and any(kw.arg == attr for kw in n.keywords)
+                for n in ast.walk(value)
+            )
+            if not replaces_key and (stmt.lineno, key_id) not in flagged:
+                flagged.add((stmt.lineno, key_id))
+                findings.append(
+                    self.finding(
+                        mod,
+                        stmt,
+                        f"`{key_id}` was consumed on line {line} but the "
+                        f"returned state does not replace `{attr}` — the next "
+                        "call will re-draw identical randomness",
+                    )
+                )
+
+
+# ---------------------------------------------------------------------------
+# GL002-GL005 — compiled-scope rules (share one taint walk)
+# ---------------------------------------------------------------------------
+
+
+class _CompiledScopeRule(Rule):
+    """Base for rules that only fire inside jit-traced scope.
+
+    The call-graph closure, host-callback analysis, raise/assert spans, and
+    the statement-ordered taint walk are shared: the first compiled-scope
+    rule to run performs ONE walk dispatching to every compiled-scope rule's
+    ``check_node`` and caches the per-rule findings on the Module."""
+
+    def check(self, mod: Module) -> list[Finding]:
+        return list(_compiled_scope_findings(mod).get(self.code, []))
+
+    def check_node(self, mod: Module, node: ast.AST, taint: _Taint) -> list[Finding]:
+        raise NotImplementedError
+
+
+def _compiled_scope_findings(mod: Module) -> dict[str, list[Finding]]:
+    cached = getattr(mod, "_compiled_scope_findings", None)
+    if cached is not None:
+        return cached
+    rules = [r for r in RULES if isinstance(r, _CompiledScopeRule)]
+    findings: dict[str, list[Finding]] = {r.code: [] for r in rules}
+    for fn in compiled_functions(mod):
+        host = _host_callback_names(fn)
+        taint = _Taint(fn)
+        # Code under `raise`/`assert` runs at most once, at trace time — an
+        # f-string or float() in an error message is not a per-step hazard.
+        error_spans = [
+            (n.lineno, n.end_lineno or n.lineno)
+            for n in _body_walk(fn, into_nested=True)
+            if isinstance(n, (ast.Raise, ast.Assert))
+        ]
+        for node in _compiled_statements(fn, host, taint):
+            for rule in rules:
+                for f in rule.check_node(mod, node, taint):
+                    if not any(lo <= f.line <= hi for lo, hi in error_spans):
+                        findings[rule.code].append(f)
+    mod._compiled_scope_findings = findings
+    return findings
+
+
+class HostSyncRule(_CompiledScopeRule):
+    code = "GL002"
+    title = "host sync inside compiled path"
+    hint = (
+        "a device->host transfer blocks the TPU pipeline inside a jitted "
+        "step; keep the value on-device (jnp ops) or move the host logic "
+        "into io_callback/monitor accessors"
+    )
+
+    def check_node(self, mod: Module, node: ast.AST, taint: _Taint) -> list[Finding]:
+        if not isinstance(node, ast.Call):
+            return []
+        out: list[Finding] = []
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in ("item", "tolist") and not node.args:
+            receiver = func.value
+            rooted_at_self = (
+                isinstance(receiver, ast.Name) and receiver.id == "self"
+            ) or (
+                isinstance(receiver, ast.Attribute)
+                and isinstance(receiver.value, ast.Name)
+                and receiver.value.id == "self"
+                and not taint.is_traced(receiver)
+            )
+            if not rooted_at_self:
+                out.append(
+                    self.finding(
+                        mod,
+                        node,
+                        f"`.{func.attr}()` inside a compiled step forces a "
+                        "blocking device->host sync per call",
+                    )
+                )
+        chain = _dotted(func) or ""
+        if chain in ("np.asarray", "np.array", "numpy.asarray", "numpy.array", "onp.asarray", "onp.array"):
+            out.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"`{chain}` inside a compiled step materializes on host "
+                    "(ConcretizationError on traced values, silent constant "
+                    "otherwise) — use jnp",
+                )
+            )
+        if (
+            isinstance(func, ast.Name)
+            and func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+            and taint.is_traced(node.args[0])
+        ):
+            out.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"`{func.id}()` on a traced value inside a compiled step "
+                    "— host sync (or trace-time ConcretizationError)",
+                )
+            )
+        return out
+
+
+class TracedBranchRule(_CompiledScopeRule):
+    code = "GL003"
+    title = "Python control flow on traced value"
+    hint = (
+        "Python `if`/`while` on a traced array re-traces per branch or "
+        "crashes; use jnp.where for element selection, jax.lax.cond for "
+        "branches, jax.lax.while_loop for loops"
+    )
+
+    def check_node(self, mod: Module, node: ast.AST, taint: _Taint) -> list[Finding]:
+        if isinstance(node, (ast.If, ast.While)) and taint.is_traced(node.test):
+            kw = "if" if isinstance(node, ast.If) else "while"
+            return [
+                self.finding(
+                    mod,
+                    node,
+                    f"Python `{kw}` on a traced value inside a compiled step "
+                    "— needs jax.lax.cond/while_loop/jnp.where",
+                )
+            ]
+        return []
+
+
+class RecompileHazardRule(_CompiledScopeRule):
+    code = "GL004"
+    title = "recompile hazard"
+    hint = (
+        "anything that varies call-to-call in Python (list contents, shapes "
+        "formatted into strings, unrolled loops over arrays) changes the "
+        "trace and recompiles; hoist constants to __init__, use lax.scan/"
+        "fori_loop, and key caches by static config only"
+    )
+
+    def check_node(self, mod: Module, node: ast.AST, taint: _Taint) -> list[Finding]:
+        out: list[Finding] = []
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func) or ""
+            if chain.rsplit(".", 1)[-1] in ("array", "asarray") and (
+                chain.startswith("jnp.") or chain.startswith("jax.numpy.")
+            ):
+                if node.args:
+                    arg = node.args[0]
+                    # Tracers in the list trace into the program exactly like
+                    # jnp.stack — the hazard is non-constant HOST values,
+                    # which bake into the trace and recompile when they vary.
+                    host_elt = lambda e: not isinstance(e, ast.Constant) and not taint.is_traced(e)
+                    literal_nonconst = isinstance(arg, (ast.List, ast.Tuple)) and any(
+                        host_elt(e) for e in arg.elts
+                    )
+                    comp_nonconst = isinstance(
+                        arg, (ast.ListComp, ast.GeneratorExp)
+                    ) and host_elt(arg.elt)
+                    if literal_nonconst or comp_nonconst:
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"`{chain}` built from a Python list inside a "
+                                "compiled step — list contents become trace "
+                                "constants (recompile when they change); use "
+                                "jnp.stack on arrays or hoist to __init__",
+                            )
+                        )
+        elif isinstance(node, ast.For):
+            if taint.is_traced(node.iter):
+                out.append(
+                    self.finding(
+                        mod,
+                        node,
+                        "Python `for` over a traced array inside a compiled "
+                        "step — unrolls the trace (and recompiles when the "
+                        "length changes); use jax.lax.scan/fori_loop",
+                    )
+                )
+            elif (
+                isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and any(taint.is_traced(a) for a in node.iter.args)
+            ):
+                out.append(
+                    self.finding(
+                        mod,
+                        node,
+                        "`range()` over a traced bound inside a compiled step "
+                        "— use jax.lax.fori_loop",
+                    )
+                )
+        elif isinstance(node, ast.JoinedStr):
+            traced = taint.is_traced(node)
+            shape_derived = any(
+                isinstance(n, ast.Attribute) and n.attr == "shape"
+                for v in node.values
+                if isinstance(v, ast.FormattedValue)
+                for n in ast.walk(v.value)
+            )
+            if traced or shape_derived:
+                what = "a traced value" if traced else "an array shape"
+                out.append(
+                    self.finding(
+                        mod,
+                        node,
+                        f"f-string built from {what} inside a compiled step — "
+                        "shape/value-derived strings (e.g. dict cache keys) "
+                        "silently fork the compile cache",
+                    )
+                )
+        return out
+
+
+class ImpureStepRule(_CompiledScopeRule):
+    code = "GL005"
+    title = "impure compiled method"
+    hint = (
+        "components are static under jit — a `self.*` write only happens at "
+        "trace time and is frozen (or silently stale) afterwards; evolving "
+        "values belong in the State (`state.replace(...)`)"
+    )
+
+    def check_node(self, mod: Module, node: ast.AST, taint: _Taint) -> list[Finding]:
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        out: list[Finding] = []
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                if (
+                    isinstance(e, ast.Attribute)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == "self"
+                ):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"assignment to `self.{e.attr}` inside a compiled "
+                            "step-family method — mutation only happens at "
+                            "trace time, not per generation",
+                        )
+                    )
+        return out
+
+
+RULES: list[Rule] = [
+    BareAssertRule(),
+    KeyReuseRule(),
+    HostSyncRule(),
+    TracedBranchRule(),
+    RecompileHazardRule(),
+    ImpureStepRule(),
+]
+RULES_BY_CODE = {r.code: r for r in RULES}

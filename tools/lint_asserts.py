@@ -1,57 +1,44 @@
 #!/usr/bin/env python
-"""Ratchet lint: no NEW bare ``assert`` statements in library code.
+"""Thin backwards-compatible shim: the bare-assert ratchet is now graftlint
+rule **GL000** (``tools/graftlint/rules.py``), still ratcheting through this
+file's original baseline (``tools/assert_baseline.json``) so nothing breaks.
 
-``assert`` vanishes under ``python -O``, so it must never guard user input —
-validation belongs to explicit ``ValueError``/``TypeError`` raises carrying
-the offending values (see ``parallel/sharded_problem.py`` for the idiom).
-The seed codebase predates this rule and carries a stock of legacy asserts
-(mostly ``__init__`` hyperparameter checks); converting them all at once
-would churn every algorithm file, so this lint *ratchets* instead:
+Usage (unchanged)::
 
-* every file's assert count may only go DOWN relative to the recorded
-  baseline (``tools/assert_baseline.json``);
-* files not in the baseline must have ZERO asserts — new code never adds
-  bare asserts for validation (genuine internal invariants in new code
-  should raise, or be written as checks that survive ``-O``).
-
-Usage::
-
-    python tools/lint_asserts.py                 # check (exit 1 on failure)
+    python tools/lint_asserts.py                     # check (exit 1 on failure)
     python tools/lint_asserts.py --update-baseline   # after REMOVING asserts
 
-``--update-baseline`` refuses to record increases, so the baseline can only
-ratchet toward zero.  Wired into CI via ``tests/test_tooling.py`` (tier-1)
-and ``./run_tests.sh --lint``.
+The full suite — GL000 plus the JAX-purity rules GL001-GL005 — runs via
+``python -m tools.graftlint`` (see docs/guide/static-analysis.md).
 """
 
 from __future__ import annotations
 
-import ast
-import json
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-LIBRARY_ROOT = REPO / "evox_tpu"
-BASELINE_PATH = Path(__file__).resolve().parent / "assert_baseline.json"
+sys.path.insert(0, str(REPO))
 
-
-def count_asserts(path: Path) -> int:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    return sum(isinstance(node, ast.Assert) for node in ast.walk(tree))
+from tools.graftlint.engine import (  # noqa: E402
+    ASSERT_BASELINE_PATH as BASELINE_PATH,
+    LIBRARY_ROOT,
+    group_counts,
+    scan_paths,
+)
+from tools.graftlint.rules import RULES_BY_CODE  # noqa: E402
 
 
 def scan(root: Path = LIBRARY_ROOT) -> dict[str, int]:
-    """Map of repo-relative file path -> assert count, non-zero files only."""
-    counts = {}
-    for path in sorted(root.rglob("*.py")):
-        n = count_asserts(path)
-        if n:
-            counts[str(path.relative_to(REPO))] = n
-    return counts
+    """Map of repo-relative file path -> assert count, non-zero files only
+    (pragma-suppressed asserts excluded, like every graftlint rule)."""
+    findings = scan_paths([root], [RULES_BY_CODE["GL000"]])
+    return dict(sorted(group_counts(findings).get("GL000", {}).items()))
 
 
 def load_baseline() -> dict[str, int]:
+    import json
+
     if not BASELINE_PATH.exists():
         return {}
     return json.loads(BASELINE_PATH.read_text())
@@ -73,37 +60,12 @@ def check(counts: dict[str, int], baseline: dict[str, int]) -> list[str]:
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    counts = scan()
+    from tools.graftlint.cli import main as graftlint_main
+
+    args = ["--select", "GL000"]
     if "--update-baseline" in argv:
-        baseline = load_baseline()
-        grew = {
-            rel: (baseline.get(rel, 0), n)
-            for rel, n in counts.items()
-            if n > baseline.get(rel, 0) and BASELINE_PATH.exists()
-        }
-        if grew:
-            print("refusing to ratchet UP; remove these asserts instead:")
-            for rel, (old, new) in sorted(grew.items()):
-                print(f"  {rel}: {old} -> {new}")
-            return 1
-        BASELINE_PATH.write_text(json.dumps(counts, indent=2, sort_keys=True) + "\n")
-        print(f"baseline updated: {sum(counts.values())} assert(s) across {len(counts)} file(s)")
-        return 0
-    problems = check(counts, load_baseline())
-    if problems:
-        print("bare-assert ratchet violations:")
-        for p in problems:
-            print(f"  {p}")
-        print(
-            "\nIf you REMOVED asserts elsewhere and the baseline is stale, "
-            "run: python tools/lint_asserts.py --update-baseline"
-        )
-        return 1
-    print(
-        f"assert ratchet OK ({sum(counts.values())} legacy assert(s) across "
-        f"{len(counts)} file(s), none added)"
-    )
-    return 0
+        args.append("--update-baseline")
+    return graftlint_main(args)
 
 
 if __name__ == "__main__":

@@ -1,182 +1,28 @@
-"""Regenerate BASELINE.md's measured-results table from BENCH_ALL.json.
+"""Thin backwards-compatible shim: the BASELINE.md bench-table updater moved
+into the graftlint CLI (``python -m tools.graftlint bench-table``).
 
-The round-3 advisor flagged mutually-inconsistent perf records (artifact
-files, BENCH_ALL.json and the hand-written BASELINE.md table disagreeing).
-This makes the table mechanical: the section between the BEGIN/END markers
-is replaced from the artifacts of record, so the prose never drifts from
-the data.
-
-Usage::
+Usage (unchanged)::
 
     python tools/update_baseline.py          # rewrite BASELINE.md in place
     python tools/update_baseline.py --check  # exit 1 if the table is stale
+    python tools/update_baseline.py --rebaseline
 """
 
 from __future__ import annotations
 
-import argparse
-import json
-import os
 import sys
+from pathlib import Path
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_BASELINE = os.path.join(_REPO, "BASELINE.md")
-_BENCH_ALL = os.path.join(_REPO, "BENCH_ALL.json")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-BEGIN = "<!-- BENCH_TABLE_BEGIN (generated by tools/update_baseline.py) -->"
-END = "<!-- BENCH_TABLE_END -->"
-
-# config -> short row label; order = table order.
-ROWS = [
-    ("pso_small", "PSO pop=1024 dim=100 Ackley"),
-    ("pso_small_fused", "PSO pop=1024, fused `fori_loop`"),
-    ("pso_northstar", "PSO pop=100k dim=1000 Sphere — north-star"),
-    ("pso_northstar_fused", "PSO 100k fused `fori_loop` (100 gens/program)"),
-    ("pso_northstar_bf16", "PSO 100k in bfloat16"),
-    ("pso_northstar_rbg", "PSO 100k with hardware (rbg) PRNG"),
-    ("pso_northstar_bf16_rbg", "PSO 100k, bf16 + rbg combined"),
-    ("cmaes_cec", "CMA-ES pop=64 CEC2022 f1 D=20"),
-    ("de_cec", "DE pop=10k CEC2022 f5 D=20"),
-    ("openes_cec", "OpenES pop=8192 CEC2022 f1 D=20"),
-    ("nsga2_dtlz2", "NSGA-II pop=10k DTLZ2 m=3"),
-    ("nsga2_dtlz2_fused", "NSGA-II pop=10k, fused `fori_loop`"),
-    ("rank_20k", "non_dominate_rank n=20k m=3 (packed, rankings/sec)"),
-    ("nsga2_dtlz2_50k", "NSGA-II pop=50k DTLZ2 m=3 (packed rank)"),
-    ("nsga2_dtlz2_pallas", "NSGA-II pop=10k, Pallas dominance kernel"),
-    ("pso_northstar_pallas", "PSO 100k, bf16 + Pallas fused move kernel"),
-    ("rvea_dtlz2", "RVEA pop=10k DTLZ2 m=3"),
-    ("rvea_dtlz2_fused", "RVEA pop=10k, fused `fori_loop`"),
-    ("neuroevolution", "Neuroevolution OpenES pop=2048, cartpole T=200"),
-    ("vmapped_instances", "8× vmapped PSO instances pop=1024"),
-    ("distributed_8dev", "Distributed PSO (shard_map over local mesh)"),
-]
-
-
-def build_table() -> str:
-    with open(_BENCH_ALL) as f:
-        data = json.load(f)
-    lines = [
-        "| Config | gen/s (TPU) | runs (min..max) | vs baseline* |",
-        "|---|---|---|---|",
-    ]
-    # Only configs excluded from --all by design may fall back to their
-    # standalone artifact file; anything else missing from BENCH_ALL.json
-    # is simply absent (a stale per-config artifact must not masquerade as
-    # part of the sweep of record).  The set is bench.py's, not a copy.
-    sys.path.insert(0, _REPO)
-    from bench import EXPLICIT_ONLY as explicit_only
-    for key, label in ROWS:
-        e = data.get(key)
-        if e is None:
-            if key not in explicit_only:
-                continue
-            art = os.path.join(_REPO, "bench_artifacts", f"{key}.tpu.json")
-            if not os.path.exists(art):
-                continue
-            with open(art) as f:
-                e = json.load(f)
-                e.setdefault("platform", "tpu")
-        if e.get("platform") != "tpu" or not e.get("value"):
-            err = e.get("error", "no TPU measurement")
-            lines.append(f"| {label} | — | | {err} |")
-            continue
-        runs = e.get("runs", {})
-        spread = (
-            f"{runs['n_ok']} ({runs['min']}..{runs['max']})" if runs else "1"
-        )
-        vsb = e.get("vs_baseline", "")
-        lines.append(f"| {label} | **{e['value']}** | {spread} | {vsb} |")
-    lines.append(
-        "\n\\* vs the anchored baseline in `BENCH_HISTORY.json`: the r2/r3 "
-        "first-run value until a multi-run sweep re-anchors it via "
-        "`tools/update_baseline.py --rebaseline` (displaced anchors stay "
-        "auditable in each entry's `baseline_history`)."
-    )
-    return "\n".join(lines)
-
-
-def rebaseline_history() -> int:
-    """Re-anchor BENCH_HISTORY.json to the current sweep's TPU medians.
-
-    Round-2/3 baselines are single-run numbers; once a multi-run sweep of
-    record exists, drift detection should anchor to its medians (VERDICT
-    r4 item 8).  Guards against the failure modes of naive re-anchoring:
-
-    * **single-run entries never re-anchor** (``runs`` required) — a lone
-      noisy number must not replace a statistic;
-    * the displaced record (value + run conditions) is **appended to the
-      entry's ``baseline_history`` lineage**, so repeated re-anchoring
-      cannot ratchet away a slow cumulative drift — every prior anchor
-      stays auditable in the file.
-
-    Only metrics with a fresh TPU measurement in BENCH_ALL.json are
-    touched.  Returns the number of re-anchored rows.
-    """
-    history_path = os.path.join(_REPO, "BENCH_HISTORY.json")
-    if not (os.path.exists(history_path) and os.path.exists(_BENCH_ALL)):
-        return 0
-    with open(history_path) as f:
-        history = json.load(f)
-    with open(_BENCH_ALL) as f:
-        data = json.load(f)
-    sys.path.insert(0, _REPO)
-    from bench import make_history_record
-
-    n = 0
-    for entry in data.values():
-        metric = entry.get("metric")
-        if not metric or entry.get("platform") != "tpu" or not entry.get("value"):
-            continue
-        if not entry.get("runs"):
-            continue  # single-run: not a statistic, never an anchor
-        old = history.get(metric, {})
-        if old.get("baseline") == entry["value"]:
-            continue
-        record = make_history_record(entry, "tpu")
-        if old.get("baseline") is not None:
-            lineage = old.pop("baseline_history", [])
-            lineage.append({k: v for k, v in old.items()})
-            record["baseline_history"] = lineage
-        history[metric] = record
-        n += 1
-    if n:
-        with open(history_path, "w") as f:
-            json.dump(history, f, indent=1, sort_keys=True)
-    return n
-
-
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--check", action="store_true")
-    ap.add_argument(
-        "--rebaseline",
-        action="store_true",
-        help="also re-anchor BENCH_HISTORY.json baselines to this sweep's "
-        "TPU medians (old values kept as previous_baseline)",
-    )
-    args = ap.parse_args()
-    if args.rebaseline and not args.check:
-        n = rebaseline_history()
-        print(f"re-anchored {n} BENCH_HISTORY.json baselines")
-    with open(_BASELINE) as f:
-        text = f.read()
-    if BEGIN not in text or END not in text:
-        print(f"BASELINE.md lacks the {BEGIN!r} markers", file=sys.stderr)
-        return 1
-    head, rest = text.split(BEGIN, 1)
-    _, tail = rest.split(END, 1)
-    new = head + BEGIN + "\n" + build_table() + "\n" + END + tail
-    if args.check:
-        if new != text:
-            print("BASELINE.md table is stale; run tools/update_baseline.py")
-            return 1
-        print("BASELINE.md table matches BENCH_ALL.json")
-        return 0
-    with open(_BASELINE, "w") as f:
-        f.write(new)
-    print("BASELINE.md table regenerated from BENCH_ALL.json")
-    return 0
-
+from tools.graftlint.bench_table import (  # noqa: E402,F401  (re-exported API)
+    BEGIN,
+    END,
+    ROWS,
+    build_table,
+    main,
+    rebaseline_history,
+)
 
 if __name__ == "__main__":
     sys.exit(main())
