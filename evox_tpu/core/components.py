@@ -172,6 +172,15 @@ class Monitor(_Component):
         del mask
         return state
 
+    def record_shard_quarantine(self, state: State, shard_mask: jax.Array) -> State:
+        """Hook: per-shard boolean mask of mesh shards whose entire row
+        block was quarantined this evaluation
+        (``StdWorkflow(quarantine_granularity="shard")`` on distributed
+        runs).  Runs inside the jitted step; ``EvalMonitor`` counts the
+        events into its in-state ``num_shard_quarantines`` metric."""
+        del shard_mask
+        return state
+
     def record_restart(self, state: State) -> State:
         """Hook: an automatic restart fired on the run this state belongs to
         (``ResilientRunner`` health/restart layer — see

@@ -10,11 +10,26 @@ the mesh natively.
 
 __all__ = [
     "ShardedProblem",
+    "find_sharded",
     "init_multi_host",
+    "iter_problem_chain",
     "make_pop_mesh",
+    "pad_population",
+    "population_mask",
     "replicate",
     "shard_population",
+    "shard_row_ids",
+    "unpad_fitness",
 ]
 
-from .mesh import init_multi_host, make_pop_mesh, replicate, shard_population
-from .sharded_problem import ShardedProblem
+from .mesh import (
+    init_multi_host,
+    make_pop_mesh,
+    pad_population,
+    population_mask,
+    replicate,
+    shard_population,
+    shard_row_ids,
+    unpad_fitness,
+)
+from .sharded_problem import ShardedProblem, find_sharded, iter_problem_chain

@@ -14,11 +14,16 @@ the full lifecycle:
 * :func:`shard_population` / :func:`replicate` — placement of the two kinds
   of workflow data: the population axis is sharded, algorithm state is
   replicated (the reference's replicated-state contract).
+* :func:`pad_population` / :func:`population_mask` / :func:`unpad_fitness` —
+  divisibility shims: a pop size that does not divide the mesh axis is
+  padded (repeating the last row — valid domain values, so any problem can
+  evaluate them) and the padding is masked back out of the fitness.
 """
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -27,6 +32,10 @@ __all__ = [
     "make_pop_mesh",
     "shard_population",
     "replicate",
+    "pad_population",
+    "population_mask",
+    "shard_row_ids",
+    "unpad_fitness",
 ]
 
 
@@ -68,3 +77,71 @@ def replicate(state, mesh: Mesh):
     the identical algorithm; only evaluation is sharded)."""
     sharding = NamedSharding(mesh, P())
     return jax.tree.map(lambda x: jax.device_put(x, sharding), state)
+
+
+def padded_size(pop_size: int, n_shards: int) -> int:
+    """Smallest multiple of ``n_shards`` that fits ``pop_size`` rows."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    return -(-pop_size // n_shards) * n_shards
+
+
+def pad_population(pop, n_shards: int):
+    """Pad a population pytree's leading axis up to a multiple of
+    ``n_shards`` so it can shard evenly over the mesh axis.
+
+    Padding rows repeat the LAST real row — valid domain values, so any
+    problem evaluates them without special-casing — and are masked back out
+    of the fitness by the caller (:func:`unpad_fitness`, or
+    ``ShardedProblem(pad=True)`` which does both ends automatically).
+
+    Returns ``(padded_pop, mask)`` where ``mask`` is a boolean
+    ``(padded_size,)`` vector that is ``True`` for real rows.  A pop size
+    that already divides returns the input unchanged (with an all-``True``
+    mask), so the helper is safe to call unconditionally.
+    """
+    leaves = jax.tree.leaves(pop)
+    if not leaves:
+        raise ValueError("pad_population needs a non-empty population pytree")
+    pop_size = leaves[0].shape[0]
+    target = padded_size(pop_size, n_shards)
+    mask = jnp.arange(target) < pop_size
+    if target == pop_size:
+        return pop, mask
+    n_pad = target - pop_size
+
+    def pad_leaf(x):
+        if x.shape[0] != pop_size:
+            raise ValueError(
+                f"population leaves disagree on the leading axis: expected "
+                f"{pop_size}, found {x.shape[0]} (shape {x.shape})"
+            )
+        filler = jnp.broadcast_to(x[-1:], (n_pad,) + x.shape[1:])
+        return jnp.concatenate([x, filler], axis=0)
+
+    return jax.tree.map(pad_leaf, pop), mask
+
+
+def shard_row_ids(n_rows: int, n_shards: int) -> jax.Array:
+    """The mesh shard owning each population row under ``ShardedProblem``'s
+    layout: contiguous ceil-sized blocks, so ragged/padded tails (the
+    ``pad_population`` case, where the last shard owns fewer real rows) map
+    exactly like the sharded evaluation distributes them.  The ONE
+    definition of the row→shard invariant — shard-granular quarantine and
+    dead-shard fault injection both key off it, so a layout change breaks
+    every consumer together."""
+    return jnp.arange(n_rows) // (padded_size(n_rows, n_shards) // n_shards)
+
+
+def population_mask(pop_size: int, n_shards: int) -> jax.Array:
+    """The validity mask :func:`pad_population` would attach for this
+    ``(pop_size, n_shards)`` pair — ``True`` for real rows, ``False`` for
+    padding — without building the padded population."""
+    return jnp.arange(padded_size(pop_size, n_shards)) < pop_size
+
+
+def unpad_fitness(fit: jax.Array, pop_size: int) -> jax.Array:
+    """Drop the padded tail rows of a fitness array evaluated on a
+    :func:`pad_population` output (works for ``(n,)`` single-objective and
+    ``(n, m)`` multi-objective fitness alike)."""
+    return fit[:pop_size]

@@ -7,19 +7,69 @@ a standalone composition: wrap ANY problem so its ``evaluate`` runs under
 all-gathered — usable with custom workflows, the HPO wrapper, or directly.
 
 Contract (same as the reference's distributed mode): the wrapped problem is
-evaluated shard-locally; if it keeps a PRNG key in its state, each shard
-folds in its mesh position so stochastic evaluations decorrelate across
-shards while the replicated state advances identically everywhere.
+evaluated shard-locally; if it keeps a PRNG key in its state, stochastic
+evaluations decorrelate across individuals while the replicated state
+advances identically everywhere.
+
+**Topology invariance.**  Per-individual PRNG streams are derived by folding
+the individual's **global slot index** (its row in the full population) into
+the problem key — NOT the shard's ``axis_index``.  Folding the shard index
+(the reference's ``fork_rng`` translation, and this wrapper's original
+behavior) ties the random draw of every individual to *which shard happened
+to evaluate it*: the same seed produces different fitness on an 8-way vs a
+4-way mesh, and a checkpoint taken on one topology cannot resume
+bit-identically on another.  Global-slot folding makes the evaluation a pure
+function of ``(key, slot, individual)``, so any mesh size — including a
+single device — yields the same stream per individual (regression-tested
+across 1/2/4/8-device meshes in ``tests/test_elastic.py``); it is the
+load-bearing invariant of the resilience layer's elastic re-mesh resume
+(``resilience/elastic.py``).
+
+The flip side: on the per-individual path the inner ``evaluate`` receives
+one-row populations (under ``vmap``), so keyed problems whose fitness
+depends on the whole batch must opt out with
+``per_individual_keys=False`` — restoring whole-shard batches and the
+old per-shard fold, at the documented cost of topology-dependent
+randomness.
 """
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core import Problem, State
+from .mesh import pad_population, unpad_fitness
 
-__all__ = ["ShardedProblem"]
+__all__ = ["ShardedProblem", "find_sharded", "iter_problem_chain"]
+
+
+def iter_problem_chain(problem):
+    """Yield ``problem`` and every problem it wraps (wrappers keep their
+    inner problem under ``.problem`` — ``FaultyProblem``, transforms, and
+    this module's own wrapper all follow the convention), cycle-safe.
+
+    The single chain walk shared by every layer that needs to see through
+    wrapper composition (workflow shard discovery, elastic topology,
+    fault-injection shard mapping) — one definition, so a future wrapper
+    that breaks the convention fails every consumer the same way."""
+    seen: set[int] = set()
+    p = problem
+    while p is not None and id(p) not in seen:
+        seen.add(id(p))
+        yield p
+        p = getattr(p, "problem", None)
+
+
+def find_sharded(problem) -> "ShardedProblem | None":
+    """The :class:`ShardedProblem` a problem evaluates through (itself or
+    anywhere down its wrapper chain); ``None`` when evaluation is
+    unsharded."""
+    for p in iter_problem_chain(problem):
+        if isinstance(p, ShardedProblem):
+            return p
+    return None
 
 # ``shard_map`` moved to the top-level namespace after jax 0.4.x, and its
 # replication-check kwarg was renamed check_rep -> check_vma in a separate
@@ -42,16 +92,46 @@ _CHECK_KW = (
 class ShardedProblem(Problem):
     """Wraps a Problem so evaluation is population-sharded over a mesh."""
 
-    def __init__(self, problem: Problem, mesh: Mesh, axis_name: str = "pop"):
+    def __init__(
+        self,
+        problem: Problem,
+        mesh: Mesh,
+        axis_name: str = "pop",
+        pad: bool = False,
+        per_individual_keys: bool = True,
+    ):
         """
         :param problem: the inner problem; its ``evaluate`` must be pure.
         :param mesh: device mesh with ``axis_name`` as a mesh axis.
         :param axis_name: mesh axis to shard the population's leading axis
-            over; the population size must be divisible by its size.
+            over; the population size must be divisible by its size unless
+            ``pad`` is set.
+        :param pad: pad a non-divisible population up to the next multiple
+            of the mesh axis (repeating the last row — valid domain values)
+            and mask the padding back out of the returned fitness, instead
+            of raising the divisibility ``ValueError``.  The padded rows
+            cost real evaluation work, so pop sizes that divide natively
+            stay the fast path.
+        :param per_individual_keys: how a *stochastic* inner problem (one
+            whose state carries a top-level ``key``) is decorrelated.
+            ``True`` (default): evaluate each individual separately under
+            ``vmap`` with ``fold_in(key, global_slot)`` — topology-invariant
+            (see module docstring), **but the inner ``evaluate`` then sees
+            one-row populations**, so evaluations that depend on the whole
+            batch (batch-relative fitness, ranking, novelty against the
+            population) are not supported on this path, and host callbacks
+            inside it fire once per individual.  ``False``: evaluate whole
+            shards with a per-shard ``fold_in(key, axis_index)`` — batch
+            semantics preserved, but the PRNG stream then depends on the
+            mesh size (the pre-elastic behavior): the same seed draws
+            different noise on different topologies, and re-meshed
+            checkpoint resume of the run is NOT bit-identical.
         """
         self.problem = problem
         self.mesh = mesh
         self.axis_name = axis_name
+        self.pad = bool(pad)
+        self.per_individual_keys = bool(per_individual_keys)
 
     def setup(self, key: jax.Array) -> State:
         return self.problem.setup(key)
@@ -63,22 +143,52 @@ class ShardedProblem(Problem):
         # in_spec below is a pytree prefix, sharding every leaf's axis 0.
         pop_size = jax.tree.leaves(pop)[0].shape[0]
         if pop_size % n_shards != 0:
-            # Not an assert: user-input validation must survive `python -O`,
-            # and the message carries the numbers needed to fix the config.
-            raise ValueError(
-                f"population size {pop_size} must divide over the "
-                f"{n_shards}-way '{self.axis_name}' mesh axis "
-                f"(mesh shape: {dict(self.mesh.shape)}); pad the population "
-                f"or choose a pop_size that is a multiple of {n_shards}"
-            )
+            if not self.pad:
+                # Not an assert: user-input validation must survive
+                # `python -O`, and the message carries the numbers needed to
+                # fix the config.
+                raise ValueError(
+                    f"population size {pop_size} must divide over the "
+                    f"{n_shards}-way '{self.axis_name}' mesh axis "
+                    f"(mesh shape: {dict(self.mesh.shape)}); pad the "
+                    f"population or choose a pop_size that is a multiple of "
+                    f"{n_shards}"
+                )
+            pop, _ = pad_population(pop, n_shards)
+        padded = jax.tree.leaves(pop)[0].shape[0]
+        local_n = padded // n_shards
         axis = self.axis_name
 
         def local_eval(pop_shard):
-            local_state = state
-            if "key" in state:
+            if "key" in state and self.per_individual_keys:
+                # Per-individual decorrelation folded on the GLOBAL slot
+                # index: topology-invariant by construction (see module
+                # docstring) — the ONLY sanctioned use of axis_index-derived
+                # values feeding fold_in (graftlint GL006 guards the rest of
+                # the parallel layer against shard-index folding).
+                start = jax.lax.axis_index(axis) * local_n
+
+                def eval_one(slot, row):
+                    local_state = state.replace(
+                        key=jax.random.fold_in(state.key, slot)  # graftlint: disable=GL006
+                    )
+                    one = jax.tree.map(lambda x: x[None], row)
+                    row_fit, _ = self.problem.evaluate(local_state, one)
+                    return row_fit[0]
+
+                fit = jax.vmap(eval_one)(start + jnp.arange(local_n), pop_shard)
+            elif "key" in state:
+                # Whole-shard batch with a per-shard fold: batch semantics
+                # preserved at the cost of topology-DEPENDENT randomness
+                # (the documented per_individual_keys=False trade-off) —
+                # intentional, so the GL006 suppression is load-bearing.
                 idx = jax.lax.axis_index(axis)
-                local_state = state.replace(key=jax.random.fold_in(state.key, idx))
-            fit, _ = self.problem.evaluate(local_state, pop_shard)
+                local_state = state.replace(
+                    key=jax.random.fold_in(state.key, idx)  # graftlint: disable=GL006
+                )
+                fit, _ = self.problem.evaluate(local_state, pop_shard)
+            else:
+                fit, _ = self.problem.evaluate(state, pop_shard)
             return jax.lax.all_gather(fit, axis, axis=0, tiled=True)
 
         fit = _shard_map(
@@ -88,6 +198,7 @@ class ShardedProblem(Problem):
             out_specs=P(),
             **{_CHECK_KW: False},
         )(pop)
+        fit = unpad_fitness(fit, pop_size)
         if "key" in state:
             state = state.replace(key=jax.random.fold_in(state.key, 0x5EED))
         return fit, state

@@ -29,14 +29,31 @@ This subsystem adds the missing layer:
   ``RunStats`` and in every checkpoint manifest.
 * :class:`FaultyProblem` — a deterministic fault-injection wrapper (NaN/Inf
   rows, in-state corruption, stagnation plateaus, host-side exceptions,
-  artificial delays, by evaluation schedule) so every recovery path above is
-  testable on CPU.
+  artificial delays, dead/straggler shard schedules, an eval deadline with
+  penalty fallback — all by evaluation schedule) so every recovery path
+  above is testable on CPU.
+* Elastic topology (``elastic.py``) — checkpoint manifests record the mesh
+  topology they were written under (:class:`MeshTopology`), and the runner's
+  resume **re-meshes**: a run checkpointed on an N-device ``pop`` mesh
+  continues bit-identically on M devices (:func:`check_topology` gates,
+  :func:`remesh_state` repartitions), because checkpointed state is global
+  and per-individual PRNG streams fold the global slot index
+  (``parallel/sharded_problem.py``).
 
 Non-finite fitness quarantine lives in the workflow layer itself
 (``StdWorkflow(quarantine_nonfinite=True)``, the default) so NaN/±Inf never
 silently propagate through ranking — see ``workflows/std_workflow.py``.
 """
 
+from .elastic import (
+    MeshTopology,
+    check_topology,
+    current_topology,
+    remesh_state,
+    topology_differs,
+    workflow_mesh,
+    workflow_topology,
+)
 from .faults import FaultyProblem, InjectedBackendError, InjectedFatalError
 from .health import HealthProbe, HealthReport
 from .restart import (
@@ -60,6 +77,13 @@ from .runner import (
 )
 
 __all__ = [
+    "MeshTopology",
+    "check_topology",
+    "current_topology",
+    "remesh_state",
+    "topology_differs",
+    "workflow_mesh",
+    "workflow_topology",
     "ResilientRunner",
     "RetryPolicy",
     "RunStats",

@@ -1,0 +1,311 @@
+"""Elastic-topology resilience: re-meshable checkpoints for distributed runs.
+
+The reference's distributed mode is a fixed-world ``torchrun`` + NCCL
+all-gather: the world size is baked in at launch, and a rank dying — or the
+job being rescheduled onto a different slice shape — loses the run.  PR 1's
+:class:`~evox_tpu.resilience.ResilientRunner` hardened *single-topology*
+runs; this module makes the topology itself elastic:
+
+* :class:`MeshTopology` — a serializable record of the device world a
+  checkpoint was written under (mesh axis names/sizes, device kind,
+  platform, global device count, process count).  Every checkpoint manifest
+  written by the runner (and, in its environment-level form, by
+  :func:`~evox_tpu.utils.save_state` itself) carries one, so resume logic
+  can see a topology change *before* deserializing gigabytes of state.
+* :func:`check_topology` — the compatibility gate: a recorded topology that
+  differs from the current one raises a structured
+  :class:`~evox_tpu.utils.CheckpointError` (naming both worlds and the fix)
+  when re-meshing is disabled, and validates divisibility when it is
+  enabled.
+* :func:`remesh_state` — repartitions a restored state pytree for a new
+  mesh: leaves with a population-sized leading axis are sharded over the
+  population axis, everything else is replicated (the replicated-state
+  contract of the parallel layer).
+
+**Why resume across topologies is bit-identical.**  All checkpointed state
+is *global* (full populations, replicated algorithm state — the gather
+happens before any checkpoint), and per-individual PRNG decorrelation in
+:class:`~evox_tpu.parallel.ShardedProblem` folds the **global slot index**
+rather than the shard index, so no value in the trajectory depends on which
+device computed it.  A run checkpointed on an 8-device ``pop`` mesh
+therefore resumes on 4 (or 2, or 1) devices with exactly the trajectory the
+uninterrupted 8-device run would have produced
+(``tests/test_elastic.py::test_elastic_resume_bit_identical``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.checkpoint import CheckpointError
+
+__all__ = [
+    "MeshTopology",
+    "current_topology",
+    "workflow_topology",
+    "workflow_mesh",
+    "check_topology",
+    "topology_differs",
+    "remesh_state",
+]
+
+TOPOLOGY_KEY = "topology"
+
+
+@dataclass(frozen=True)
+class MeshTopology:
+    """The device world a run executes (or was checkpointed) under.
+
+    ``axis_names``/``axis_sizes`` are empty for meshless (single-program)
+    runs — the environment fields still record where the checkpoint was
+    written, which :func:`check_topology` treats as informational rather
+    than binding (a single-device state loads anywhere)."""
+
+    axis_names: tuple[str, ...]
+    axis_sizes: tuple[int, ...]
+    device_kind: str
+    platform: str
+    num_devices: int
+    num_processes: int
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def from_mesh(cls, mesh: Mesh) -> "MeshTopology":
+        dev = mesh.devices.flat[0]
+        return cls(
+            axis_names=tuple(str(n) for n in mesh.axis_names),
+            axis_sizes=tuple(int(mesh.shape[n]) for n in mesh.axis_names),
+            device_kind=str(getattr(dev, "device_kind", "unknown")),
+            platform=str(getattr(dev, "platform", "unknown")),
+            num_devices=int(mesh.devices.size),
+            num_processes=int(jax.process_count()),
+        )
+
+    @classmethod
+    def from_manifest(cls, entry: Mapping[str, Any]) -> "MeshTopology":
+        return cls(
+            axis_names=tuple(entry.get("axis_names", ())),
+            axis_sizes=tuple(int(s) for s in entry.get("axis_sizes", ())),
+            device_kind=str(entry.get("device_kind", "unknown")),
+            platform=str(entry.get("platform", "unknown")),
+            num_devices=int(entry.get("num_devices", 0)),
+            num_processes=int(entry.get("num_processes", 1)),
+        )
+
+    # -- queries -------------------------------------------------------------
+    @property
+    def meshed(self) -> bool:
+        """Whether this world binds state to a mesh (vs a plain device)."""
+        return bool(self.axis_names)
+
+    @property
+    def mesh_size(self) -> int:
+        """Total shard count over all mesh axes (1 for meshless worlds)."""
+        n = 1
+        for s in self.axis_sizes:
+            n *= s
+        return n
+
+    def describe(self) -> str:
+        if self.meshed:
+            axes = ", ".join(
+                f"{n}={s}" for n, s in zip(self.axis_names, self.axis_sizes)
+            )
+            return (
+                f"{self.num_devices}-device {self.platform} mesh ({axes}; "
+                f"{self.num_processes} process(es))"
+            )
+        return (
+            f"meshless {self.platform} world ({self.num_devices} device(s), "
+            f"{self.num_processes} process(es))"
+        )
+
+    # -- manifest round-trip -------------------------------------------------
+    def to_manifest(self) -> dict[str, Any]:
+        return {
+            "axis_names": list(self.axis_names),
+            "axis_sizes": list(self.axis_sizes),
+            "device_kind": self.device_kind,
+            "platform": self.platform,
+            "num_devices": self.num_devices,
+            "num_processes": self.num_processes,
+        }
+
+
+def current_topology() -> MeshTopology:
+    """The meshless environment-level topology of this process — what
+    :func:`~evox_tpu.utils.save_state` stamps on every checkpoint so even
+    non-runner checkpoints record where they were written."""
+    dev = jax.devices()[0]
+    return MeshTopology(
+        axis_names=(),
+        axis_sizes=(),
+        device_kind=str(getattr(dev, "device_kind", "unknown")),
+        platform=str(getattr(dev, "platform", "unknown")),
+        num_devices=int(jax.device_count()),
+        num_processes=int(jax.process_count()),
+    )
+
+
+def workflow_mesh(workflow: Any) -> tuple[Mesh, str] | None:
+    """The ``(mesh, population_axis)`` a workflow evaluates over, if any:
+    ``StdWorkflow``'s own ``mesh``/``pop_axis``, else the mesh of a
+    ``ShardedProblem`` it composes (unwrapping fault-injection / transform
+    layers via the shared :func:`~evox_tpu.parallel.iter_problem_chain`
+    walk)."""
+    mesh = getattr(workflow, "mesh", None)
+    if isinstance(mesh, Mesh):
+        axis = getattr(workflow, "pop_axis", None) or mesh.axis_names[0]
+        return mesh, str(axis)
+    from ..parallel import find_sharded
+
+    sharded = find_sharded(getattr(workflow, "problem", None))
+    if sharded is not None:
+        return sharded.mesh, str(sharded.axis_name)
+    return None
+
+
+def workflow_topology(workflow: Any) -> MeshTopology:
+    """The topology a workflow's run binds to: its mesh when it evaluates
+    distributed (directly or through any wrapper holding a
+    ``ShardedProblem``), else the meshless environment topology."""
+    meshed = workflow_mesh(workflow)
+    if meshed is not None:
+        return MeshTopology.from_mesh(meshed[0])
+    return current_topology()
+
+
+def topology_differs(
+    recorded: MeshTopology | None, current: MeshTopology | None
+) -> bool:
+    """The ONE mesh-compatibility predicate: do these two worlds bind state
+    to different meshes?  Meshless on either side is never a difference
+    (checkpointed state is global — see :func:`check_topology`)."""
+    return (
+        recorded is not None
+        and current is not None
+        and recorded.meshed
+        and current.meshed
+        and (
+            recorded.axis_names != current.axis_names
+            or recorded.axis_sizes != current.axis_sizes
+        )
+    )
+
+
+def check_topology(
+    recorded: Mapping[str, Any] | MeshTopology | None,
+    current: MeshTopology | None,
+    *,
+    remesh: bool = True,
+    pop_size: int | None = None,
+    pop_axis: str | None = None,
+    context: str = "checkpoint",
+) -> MeshTopology | None:
+    """Gate a resume across a topology change.
+
+    :param recorded: the checkpoint manifest's ``topology`` entry (dict or
+        :class:`MeshTopology`); ``None`` for pre-topology checkpoints (no
+        gate — they load as before).
+    :param current: the topology the resuming run will execute under.
+    :param remesh: whether cross-topology resume is allowed.  ``False``
+        turns any mesh mismatch into a structured
+        :class:`~evox_tpu.utils.CheckpointError` naming both worlds —
+        instead of the shape blowup (or silent trajectory fork) a blind
+        load would produce.
+    :param pop_size: when known, the population size that must divide the
+        current mesh's population axis — a re-mesh onto a mesh the
+        population cannot shard over fails here, with the fix in the
+        message, not deep inside ``shard_map``.
+    :param pop_axis: name of the population axis of the current mesh (for
+        multi-axis meshes, where only that axis's size governs
+        divisibility); defaults to the first axis.
+    :param context: noun used in error messages (checkpoint path etc.).
+    :returns: the recorded topology (parsed), or ``None`` when the manifest
+        predates topology recording.
+    :raises CheckpointError: incompatible topology per the rules above.
+    """
+    if recorded is None:
+        return None
+    if not isinstance(recorded, MeshTopology):
+        recorded = MeshTopology.from_manifest(recorded)
+    # A meshless world on either side is benign: checkpointed state is
+    # always global (populations gathered before the write), so it is only
+    # *bound* to a topology when both the writer and the reader mesh it —
+    # device-count changes alone never invalidate a load.
+    mismatch = topology_differs(recorded, current)
+    if mismatch and not remesh:
+        raise CheckpointError(
+            f"{context} was written on a {recorded.describe()} but this run "
+            f"executes on a {current.describe()}, and re-meshing is "
+            f"disabled — resume on the original topology, or enable "
+            f"re-meshing (ResilientRunner(remesh=True) / "
+            f"load_state(..., remesh=True)) to repartition the state"
+        )
+    if mismatch and pop_size is not None:
+        # Only the POPULATION axis governs divisibility (a multi-axis mesh
+        # may shard models/data on its other axes).
+        if pop_axis is not None and pop_axis in current.axis_names:
+            n_shards = current.axis_sizes[
+                current.axis_names.index(pop_axis)
+            ]
+        else:
+            n_shards = current.axis_sizes[0]
+        if pop_size % n_shards != 0:
+            raise CheckpointError(
+                f"{context} re-mesh from a {recorded.describe()} onto a "
+                f"{current.describe()} is impossible for population size "
+                f"{pop_size}: it does not divide the {n_shards}-way "
+                f"population axis — resume on a mesh whose population axis "
+                f"divides {pop_size}, or enable population padding "
+                f"(ShardedProblem(pad=True))"
+            )
+    return recorded
+
+
+def remesh_state(
+    state: Any,
+    mesh: Mesh,
+    axis_name: str | None = None,
+    pop_size: int | None = None,
+) -> Any:
+    """Repartition a (restored) state pytree for ``mesh``: leaves whose
+    leading axis equals ``pop_size`` are sharded over ``axis_name``,
+    everything else is replicated — the parallel layer's placement contract
+    (``parallel/mesh.py``), applied wholesale to a checkpoint that was
+    written under a different topology.
+
+    ``axis_name`` defaults to the mesh's first axis (whatever it is named),
+    and ``pop_size`` to the leading dimension of ``state.algorithm.pop``
+    when the state carries one; with no discoverable population the whole
+    tree is replicated (correct, if not bandwidth-optimal — XLA re-shards
+    at the next ``shard_map`` entry)."""
+    if axis_name is None:
+        axis_name = str(mesh.axis_names[0])
+    if pop_size is None:
+        algo = state.get("algorithm") if hasattr(state, "get") else None
+        pop = algo.get("pop") if hasattr(algo, "get") else None
+        pop_size = getattr(pop, "shape", (None,))[0] if pop is not None else None
+    # device_put refuses uneven shardings, so a population that does not
+    # divide the axis (legal under ShardedProblem(pad=True), which pads
+    # inside the step) is replicated instead — correct placement, just one
+    # resharding away from optimal.
+    if pop_size is not None and pop_size % mesh.shape[axis_name] != 0:
+        pop_size = None
+    sharded = NamedSharding(mesh, P(axis_name))
+    replicated = NamedSharding(mesh, P())
+
+    def place(leaf):
+        if (
+            pop_size is not None
+            and getattr(leaf, "ndim", 0) >= 1
+            and leaf.shape[0] == pop_size
+        ):
+            return jax.device_put(leaf, sharded)
+        return jax.device_put(leaf, replicated)
+
+    return jax.tree_util.tree_map(place, state)

@@ -24,6 +24,27 @@ replays deterministically):
   every evaluation in ``[plateau_from, plateau_until)``: the best fitness
   cannot improve during the window, driving the probe's stagnation
   detector.
+* **dead shards** — mesh-position-keyed NaN rows
+  (``dead_shards={shard: (eval indices)}``): every fitness row belonging to
+  the scheduled shard's contiguous row block goes NaN, modeling one device
+  of the mesh returning garbage while the all-gather still "succeeds" — the
+  exact failure the workflow's shard-granular quarantine
+  (``StdWorkflow(quarantine_granularity="shard")``) and the health probe's
+  dead-shard verdict exist for.  Wrap the ``ShardedProblem`` (fault OUTSIDE
+  the shard_map) so the schedule state advances with the replicated program
+  and rows are addressed globally.
+* **straggler shards** — mesh-position-keyed host delays
+  (``straggler_shards={shard: (eval indices)}``): the host callback sleeps
+  ``straggler_delay`` seconds, which stalls the whole step exactly the way
+  one slow device stalls a real all-gather.  Attempt-counted per
+  ``(shard, eval)`` like the other host faults.
+* **eval deadline** — with ``eval_deadline`` set, the host-fault callback
+  (delays, stragglers, injected errors) runs under a wall-clock deadline:
+  if it does not finish in time, the evaluation is *abandoned* — every
+  fitness row of that evaluation becomes ``deadline_penalty`` (NaN by
+  default, flowing straight into the workflow's quarantine) and the run
+  continues, instead of wedging the program until the supervisor's watchdog
+  shoots it.  The penalty-fallback contract for host-callback problems.
 * **host-side exceptions** — an ``io_callback`` raises
   :class:`InjectedBackendError` (message carries ``UNAVAILABLE``, the
   BASELINE.md outage signature); XLA wraps it into the same
@@ -47,7 +68,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Sequence
+from typing import Mapping, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -102,6 +123,13 @@ class FaultyProblem(Problem):
         delay_generations: Sequence[int] = (),
         delay_seconds: float = 1.0,
         delay_times: int = 1,
+        dead_shards: Mapping[int, Sequence[int]] | None = None,
+        straggler_shards: Mapping[int, Sequence[int]] | None = None,
+        straggler_delay: float = 1.0,
+        straggler_times: int = 1,
+        shards: int | None = None,
+        eval_deadline: float | None = None,
+        deadline_penalty: float = float("nan"),
     ):
         """
         :param nan_generations: evaluation indices whose fitness gets NaN
@@ -134,6 +162,28 @@ class FaultyProblem(Problem):
         :param delay_generations: evaluation indices whose host callback
             sleeps ``delay_seconds`` for the first ``delay_times`` attempts
             each (watchdog fodder).
+        :param dead_shards: ``{shard_index: evaluation indices}`` — every
+            fitness row in the scheduled shard's contiguous row block goes
+            NaN (inside jit), modeling one mesh device returning garbage
+            through a "successful" all-gather.  Wrap this around the
+            ``ShardedProblem`` so rows are addressed globally.
+        :param straggler_shards: ``{shard_index: evaluation indices}`` —
+            the host callback sleeps ``straggler_delay`` seconds for the
+            first ``straggler_times`` attempts of each ``(shard, eval)``
+            pair, stalling the step the way one slow device stalls a real
+            all-gather.
+        :param shards: shard count for the row-block mapping of
+            ``dead_shards``; defaults to the mesh axis size of a
+            ``ShardedProblem`` found on the wrapped problem chain.
+        :param eval_deadline: wall-clock seconds the host-fault callback
+            may take; past it the evaluation is abandoned — all fitness
+            rows become ``deadline_penalty`` and the run continues (the
+            penalty fallback for host-callback problems).  ``None``
+            (default) leaves host faults unguarded: delays stall the
+            program until the supervisor's watchdog intervenes.
+        :param deadline_penalty: fitness value substituted for a deadlined
+            evaluation (default NaN, so the workflow quarantine penalizes
+            and counts it).
         """
         self.problem = problem
         self.nan_generations = tuple(int(g) for g in nan_generations)
@@ -157,13 +207,83 @@ class FaultyProblem(Problem):
         self.delay_generations = frozenset(int(g) for g in delay_generations)
         self.delay_seconds = float(delay_seconds)
         self.delay_times = int(delay_times)
+        self.dead_shards = tuple(
+            (int(s), tuple(int(g) for g in gens))
+            for s, gens in sorted((dead_shards or {}).items())
+        )
+        self.straggler_shards = {
+            int(s): frozenset(int(g) for g in gens)
+            for s, gens in (straggler_shards or {}).items()
+        }
+        self.straggler_delay = float(straggler_delay)
+        self.straggler_times = int(straggler_times)
+        self.shards = None if shards is None else int(shards)
+        if self.dead_shards and self._n_shards() is None:
+            raise ValueError(
+                "dead_shards needs the shard count to map shards to row "
+                "blocks: wrap a ShardedProblem (auto-detected) or pass "
+                "shards=N explicitly"
+            )
+        self.eval_deadline = (
+            None if eval_deadline is None else float(eval_deadline)
+        )
+        self.deadline_penalty = float(deadline_penalty)
+        # Set by StdWorkflow when this wrapper ends up sharing a program
+        # with a shard_map it cannot see from its own chain (the
+        # enable_distributed auto-wrap puts the ShardedProblem ABOVE us):
+        # ordered callbacks must then be avoided (see _callback_kwargs).
+        self.in_sharded_program = False
         self._lock = threading.Lock()
         self._attempts: dict[tuple[str, int], int] = {}
         self._has_host_faults = bool(
             self.error_generations
             or self.fatal_generations
             or self.delay_generations
+            or self.straggler_shards
         )
+
+    def _mesh_in_chain(self) -> int | None:
+        """Mesh axis size of a ShardedProblem on the wrapped chain, if any
+        (the shared ``parallel.find_sharded`` walk)."""
+        from ..parallel import find_sharded
+
+        sharded = find_sharded(self.problem)
+        if sharded is None:
+            return None
+        return int(sharded.mesh.shape[sharded.axis_name])
+
+    def _n_shards(self) -> int | None:
+        """Shard count for row-block mapping: explicit ``shards`` wins, else
+        the mesh axis size of a ShardedProblem on the wrapped chain."""
+        if self.shards is not None:
+            return self.shards
+        return self._mesh_in_chain()
+
+    def _callback_kwargs(self) -> dict:
+        """io_callback flavor for the host-fault side channel.
+
+        Unsharded programs use ``ordered=True`` pinned to one device —
+        exactly-once, in program order, like a real backend fault.  Programs
+        containing a ``shard_map`` must use UNORDERED callbacks instead: an
+        ordered callback threads a token through the entry computation, and
+        jax 0.4.x XLA's SPMD sharding-propagation options are sized without
+        the token parameter — the compiler hard-aborts (Check failed:
+        sharding_propagation.cc).  Same contract as the monitor side channel
+        (``workflows/eval_monitor.py``); fault semantics are unaffected —
+        attempt counters key on the evaluation index carried in the payload,
+        never on arrival order.  The shard_map may sit BELOW this wrapper
+        (``_mesh_in_chain``) or ABOVE it (``in_sharded_program``, set by the
+        workflow's enable_distributed auto-wrap); in the latter case the
+        callback traces inside the shard_map body and fires once per shard,
+        so attempt counts scale by the shard count — wrap the
+        ``ShardedProblem`` yourself (fault outside) for exactly-once
+        semantics."""
+        if self._mesh_in_chain() is not None or self.in_sharded_program:
+            return {"ordered": False}
+        return {
+            "ordered": True,
+            "sharding": SingleDeviceSharding(jax.local_devices()[0]),
+        }
 
     # -- host side ---------------------------------------------------------
     def _bump(self, kind: str, gen: int) -> int:
@@ -206,6 +326,37 @@ class FaultyProblem(Problem):
         if g in self.delay_generations:
             if self._bump("delay", g) <= self.delay_times:
                 time.sleep(self.delay_seconds)
+        for shard, gens in self.straggler_shards.items():
+            if g in gens:
+                if self._bump(f"straggler{shard}", g) <= self.straggler_times:
+                    # One slow shard stalls the whole step, exactly like a
+                    # straggler device stalls the all-gather barrier.
+                    time.sleep(self.straggler_delay)
+
+    def _guarded_hook(self, gen) -> np.bool_:
+        """``_host_hook`` under the eval deadline: run it in an abandoned-on-
+        timeout daemon worker and report whether the deadline tripped.  A
+        worker that finishes in time re-raises its exception (error faults
+        keep their retry semantics); one that does not is left to die with
+        its sleep while the evaluation falls back to the penalty."""
+        result: dict = {}
+
+        def target() -> None:
+            try:
+                self._host_hook(gen)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                result["error"] = e
+
+        worker = threading.Thread(
+            target=target, name="evox-tpu-eval-deadline", daemon=True
+        )
+        worker.start()
+        worker.join(self.eval_deadline)
+        if worker.is_alive():
+            return np.bool_(True)
+        if "error" in result:
+            raise result["error"]
+        return np.bool_(False)
 
     # -- component protocol ------------------------------------------------
     def setup(self, key: jax.Array) -> State:
@@ -235,16 +386,22 @@ class FaultyProblem(Problem):
 
     def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
         gen = state.fault_generation
+        timed_out = None
         if self._has_host_faults:
             # Ordered + pinned to one device: fires exactly once per
             # evaluation, in program order, like a real backend fault would.
-            io_callback(
-                self._host_hook,
-                None,
-                gen,
-                ordered=True,
-                sharding=SingleDeviceSharding(jax.local_devices()[0]),
-            )
+            if self.eval_deadline is None:
+                io_callback(self._host_hook, None, gen, **self._callback_kwargs())
+            else:
+                # Deadline-guarded: the callback reports a timeout instead
+                # of stalling forever; the fitness falls back to the penalty
+                # below and the run continues.
+                timed_out = io_callback(
+                    self._guarded_hook,
+                    jax.ShapeDtypeStruct((), jnp.bool_),
+                    gen,
+                    **self._callback_kwargs(),
+                )
         fit, inner = self.problem.evaluate(state.inner, pop)
         if self.nan_generations:
             fit = self._inject_rows(
@@ -253,6 +410,25 @@ class FaultyProblem(Problem):
         if self.inf_generations:
             fit = self._inject_rows(
                 fit, gen, self.inf_generations, self.inf_rows, jnp.inf
+            )
+        if self.dead_shards:
+            # Mesh-position-keyed NaN rows: the scheduled shard's whole
+            # contiguous row block dies — the row→shard mapping is the
+            # parallel layer's single definition (ragged tails included).
+            from ..parallel import shard_row_ids
+
+            row_shard = shard_row_ids(fit.shape[0], self._n_shards())
+            for shard, gens in self.dead_shards:
+                scheduled = jnp.any(gen == jnp.asarray(gens, jnp.int32))
+                mask = jnp.logical_and(scheduled, row_shard == shard)
+                mask = mask if fit.ndim == 1 else mask[:, None]
+                fit = jnp.where(mask, jnp.asarray(jnp.nan, fit.dtype), fit)
+        if timed_out is not None:
+            # Deadline fallback: the whole evaluation is abandoned — every
+            # row takes the penalty (NaN by default, so the workflow's
+            # quarantine penalizes and counts it).
+            fit = jnp.where(
+                timed_out, jnp.asarray(self.deadline_penalty, fit.dtype), fit
             )
         if self.plateau_from is not None:
             in_plateau = gen >= self.plateau_from
@@ -275,8 +451,7 @@ class FaultyProblem(Problem):
                 self._corrupt_flag,
                 jax.ShapeDtypeStruct((), jnp.bool_),
                 gen,
-                ordered=True,
-                sharding=SingleDeviceSharding(jax.local_devices()[0]),
+                **self._callback_kwargs(),
             )
             corruption = jnp.where(
                 corrupted, jnp.float32(jnp.nan), jnp.float32(0.0)

@@ -69,6 +69,7 @@ def scan_state(
     nonfinite_skip: Sequence[str] = (),
     diversity: bool = False,
     step_size: bool = False,
+    shards: int | None = None,
 ) -> dict[str, Any]:
     """Pure ``state -> {metric: scalar}`` health scan — jittable; all
     branching is on the *structure* of ``state`` (static under jit).
@@ -83,7 +84,15 @@ def scan_state(
     * ``diversity`` — largest per-dimension std of ``algorithm.pop``;
     * ``step_size_min`` / ``step_size_max`` — extrema of ``algorithm.sigma``;
     * ``best_fitness`` — monitor top-k best (minimizing frame) when
-      available, else ``min(algorithm.fit)``.
+      available, else ``min(algorithm.fit)``;
+    * ``shard_nonfinite`` / ``shard_diversity`` — with ``shards=N`` on a
+      distributed run, the non-finite count of ``algorithm.fit`` and the
+      largest per-dimension population spread aggregated **per shard**
+      (contiguous row blocks of the population axis, matching
+      ``ShardedProblem``'s layout).  One corrupted shard then shows up as
+      one hot row instead of diluting into whole-population averages —
+      the signal behind the probe's dead-shard verdict.  Emitted only when
+      the population axis divides ``N``.
     """
     out: dict[str, Any] = {}
     if check_nonfinite:
@@ -111,6 +120,58 @@ def scan_state(
         # Largest per-dimension spread: below a floor means EVERY dimension
         # collapsed — the population sits in a vanishing box.
         out["diversity"] = jnp.max(jnp.std(pop, axis=0))
+    fit = _subtree(algo, "fit")
+    if (
+        shards
+        and shards > 1
+        and fit is not None
+        and getattr(fit, "ndim", 0) in (1, 2)
+        and jnp.issubdtype(fit.dtype, jnp.floating)
+    ):
+        # Per-shard non-finite fitness rows: a whole row of NaN/Inf on one
+        # shard (its count == its row budget) is the dead-shard signature.
+        # Aggregation uses the parallel layer's row→shard mapping (segment
+        # ops, not a reshape) so ragged populations — the
+        # ShardedProblem(pad=True) case, where the last shard owns fewer
+        # real rows — keep their shard metrics instead of silently losing
+        # them.
+        from ..parallel import shard_row_ids
+
+        ids = shard_row_ids(fit.shape[0], shards)
+        row_bad = ~jnp.isfinite(fit)
+        if fit.ndim == 2:
+            row_bad = jnp.any(row_bad, axis=-1)
+        out["shard_nonfinite"] = jax.ops.segment_sum(
+            row_bad.astype(jnp.int32), ids, num_segments=shards
+        )
+        out["shard_rows"] = jax.ops.segment_sum(
+            jnp.ones_like(ids, dtype=jnp.int32), ids, num_segments=shards
+        )
+    if (
+        diversity  # same gate as the whole-population spread: the verdict
+        and shards  # needs a floor, so don't compute (and ship) unusable data
+        and shards > 1
+        and pop is not None
+        and getattr(pop, "ndim", 0) == 2
+        and jnp.issubdtype(pop.dtype, jnp.floating)
+    ):
+        from ..parallel import shard_row_ids
+
+        ids = shard_row_ids(pop.shape[0], shards)
+        n_s = jax.ops.segment_sum(
+            jnp.ones((pop.shape[0],), pop.dtype), ids, num_segments=shards
+        )
+        denom = jnp.maximum(n_s, 1.0)[:, None]
+        mean = jax.ops.segment_sum(pop, ids, num_segments=shards) / denom
+        # Centered (two-pass) variance: the E[x²]-E[x]² shortcut cancels
+        # catastrophically in float32 exactly when the spread is tiny —
+        # the regime the collapse floor exists to detect.
+        centered = pop - mean[ids]
+        var = jax.ops.segment_sum(centered**2, ids, num_segments=shards) / denom
+        spread = jnp.sqrt(var).max(axis=-1)
+        # A shard owning zero rows (ragged tail) has no spread to collapse:
+        # report +inf so the floor never fires on it.
+        out["shard_diversity"] = jnp.where(n_s > 0, spread, jnp.inf)
     sigma = _subtree(algo, "sigma")
     if (
         step_size
@@ -174,6 +235,13 @@ class HealthReport:
     best_fitness: float | None = None
     stagnation_improvement: float | None = None
     stagnating: bool = False
+    # Per-shard aggregation (``HealthProbe(shards=N)`` on distributed runs;
+    # ``None`` when the probe is shard-blind or the state has no population
+    # axis that divides N).
+    shard_nonfinite: list[int] | None = None
+    dead_shards: list[int] = field(default_factory=list)
+    shard_diversity: list[float] | None = None
+    collapsed_shards: list[int] = field(default_factory=list)
 
 
 class HealthProbe:
@@ -210,6 +278,7 @@ class HealthProbe:
         step_size_range: tuple[float, float] | None = (1e-12, 1e6),
         stagnation_window: int = 0,
         stagnation_tol: float = 0.0,
+        shards: int | None = None,
     ):
         """
         :param check_nonfinite: scan every floating leaf of the state pytree
@@ -220,6 +289,20 @@ class HealthProbe:
         :param diversity_floor: flag diversity collapse when the *largest*
             per-dimension std of ``state.algorithm.pop`` drops below this;
             ``None`` disables the detector.
+        :param shards: shard count of the distributed run this probe watches
+            (``mesh.shape["pop"]``).  Adds per-shard aggregation: non-finite
+            fitness counts and population diversity per contiguous row block
+            (``ShardedProblem``'s layout), a **dead-shard** verdict when an
+            entire shard's fitness is non-finite, and — with
+            ``diversity_floor`` set — a **collapsed-shard** verdict when one
+            shard's spread falls under the floor while the whole-population
+            spread still looks healthy.  Note the quarantine interplay: with
+            ``StdWorkflow(quarantine_nonfinite=True)`` (the default) the
+            penalty substitution happens *before* the fitness reaches the
+            algorithm state, so dead shards are detected there (shard-granular
+            quarantine + ``EvalMonitor.num_shard_quarantines``) rather than
+            by this probe; the probe's dead-shard verdict covers quarantine-off
+            runs and custom workflows.  ``None`` (default) disables.
         :param step_size_range: ``(lo, hi)`` bounds on the ``sigma`` leaf of
             the algorithm state (checked against ``min(sigma)``/``max(sigma)``
             for per-dimension step sizes); ``None`` disables.
@@ -246,12 +329,15 @@ class HealthProbe:
                 f"step_size_range must be (lo, hi) with lo <= hi, got "
                 f"{step_size_range}"
             )
+        if shards is not None and shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
         self.check_nonfinite = check_nonfinite
         self.nonfinite_skip = tuple(nonfinite_skip)
         self.diversity_floor = diversity_floor
         self.step_size_range = step_size_range
         self.stagnation_window = int(stagnation_window)
         self.stagnation_tol = float(stagnation_tol)
+        self.shards = None if shards is None else int(shards)
         self._window: list[float] = []
         # One compiled scan per state structure (jit re-traces on structure
         # change, e.g. after an IPOP-style population regrow).
@@ -282,6 +368,7 @@ class HealthProbe:
             nonfinite_skip=self.nonfinite_skip,
             diversity=self.diversity_floor is not None,
             step_size=self.step_size_range is not None,
+            shards=self.shards,
         )
 
     # -- the host-side verdict ----------------------------------------------
@@ -314,6 +401,40 @@ class HealthProbe:
                 f"population diversity collapsed: max per-dimension spread "
                 f"{diversity:.3e} < floor {self.diversity_floor:.3e}"
             )
+
+        shard_nonfinite = raw.get("shard_nonfinite")
+        dead_shards: list[int] = []
+        if shard_nonfinite is not None:
+            shard_nonfinite = [int(n) for n in shard_nonfinite]
+            shard_rows = [int(r) for r in raw["shard_rows"]]
+            # A shard is dead when EVERY row it owns is non-finite; shards
+            # owning zero rows (ragged tails) have nothing to be dead about.
+            dead_shards = [
+                s
+                for s, (n, rows) in enumerate(zip(shard_nonfinite, shard_rows))
+                if rows > 0 and n == rows
+            ]
+            if dead_shards:
+                reasons.append(
+                    f"dead shard(s) {dead_shards}: every fitness row of the "
+                    f"shard is non-finite"
+                )
+        shard_diversity = raw.get("shard_diversity")
+        collapsed_shards: list[int] = []
+        if shard_diversity is not None:
+            shard_diversity = [float(d) for d in shard_diversity]
+            if self.diversity_floor is not None:
+                collapsed_shards = [
+                    s
+                    for s, d in enumerate(shard_diversity)
+                    if d < self.diversity_floor
+                ]
+            if collapsed_shards:
+                reasons.append(
+                    f"collapsed shard(s) {collapsed_shards}: per-shard "
+                    f"population spread under the "
+                    f"{self.diversity_floor:.3e} floor"
+                )
 
         ss_min = raw.get("step_size_min")
         ss_min = None if ss_min is None else float(ss_min)
@@ -364,4 +485,8 @@ class HealthProbe:
             best_fitness=best,
             stagnation_improvement=improvement,
             stagnating=stagnating,
+            shard_nonfinite=shard_nonfinite,
+            dead_shards=dead_shards,
+            shard_diversity=shard_diversity,
+            collapsed_shards=collapsed_shards,
         )

@@ -51,6 +51,13 @@ from ..utils.checkpoint import (
     read_manifest,
     save_state,
 )
+from .elastic import (
+    check_topology,
+    remesh_state,
+    topology_differs,
+    workflow_mesh,
+    workflow_topology,
+)
 from .health import HealthProbe, HealthReport
 from .restart import RestartContext, RestartEvent, RestartPolicy
 
@@ -241,6 +248,7 @@ class ResilientRunner:
         health: HealthProbe | None = None,
         restart: RestartPolicy | None = None,
         max_restarts: int = 5,
+        remesh: bool = True,
     ):
         """
         :param workflow: any ``Workflow`` whose ``init_step``/``step`` are
@@ -292,6 +300,15 @@ class ResilientRunner:
             further unhealthy verdicts warn but the run continues (an
             unhealthy run that finishes is still better than an aborted
             one).
+        :param remesh: allow resuming a checkpoint written under a
+            *different* mesh topology (elastic resume: a run checkpointed
+            on an 8-device ``pop`` mesh continues on 4 — or 2, or 1 —
+            after a pod reschedule).  The state is repartitioned for the
+            current mesh and the trajectory stays bit-identical, because
+            checkpointed state is global and per-individual PRNG streams
+            fold the global slot index (``resilience/elastic.py``).
+            ``False`` makes a topology change a loud, structured
+            :class:`~evox_tpu.utils.CheckpointError` instead.
         """
         if checkpoint_every < 1:
             raise ValueError(
@@ -321,6 +338,7 @@ class ResilientRunner:
         self.health = health
         self.restart = restart
         self.max_restarts = int(max_restarts)
+        self.remesh = bool(remesh)
         self.stats = RunStats()
         self._forced_cpu = False
         # Restart policies may swap ``workflow.algorithm`` (population
@@ -363,10 +381,14 @@ class ResilientRunner:
     def _ckpt_path(self, generation: int) -> Path:
         return self.checkpoint_dir / f"ckpt_{generation:08d}.npz"
 
-    def _manifest_extras(self, probed: bool) -> dict | None:
-        """Health/restart context riding in the checkpoint manifest so a
-        resumed run replays probe decisions and restart lineage exactly:
+    def _manifest_extras(self, probed: bool) -> dict:
+        """Topology + health/restart context riding in the checkpoint
+        manifest so a resumed run replays decisions exactly:
 
+        * ``topology`` — the mesh-aware world this run executes under
+          (overrides ``save_state``'s environment-level record), so resume
+          can detect a topology change and re-mesh (``remesh=True``) or
+          refuse loudly before touching the state;
         * ``restarts`` — the :class:`RestartEvent` lineage so far;
         * ``health_window`` — the probe's stagnation window *as of this
           write* (pre-probe for ordinary boundary checkpoints);
@@ -374,13 +396,16 @@ class ResilientRunner:
           before the write (post-restart checkpoints), i.e. whether a
           resume must re-probe it.
         """
-        if self.health is None:
-            return None
-        return {
-            "restarts": [e.to_manifest() for e in self.stats.restarts],
-            "health_window": list(self.health.window),
-            "health_probed": bool(probed),
+        extras: dict = {
+            "topology": workflow_topology(self.workflow).to_manifest()
         }
+        if self.health is not None:
+            extras.update(
+                restarts=[e.to_manifest() for e in self.stats.restarts],
+                health_window=list(self.health.window),
+                health_probed=bool(probed),
+            )
+        return extras
 
     def _write_checkpoint(
         self, state: State, generation: int, *, probed: bool = False
@@ -402,6 +427,21 @@ class ResilientRunner:
                 except OSError:  # pragma: no cover - racing cleaners
                     pass
 
+    def _pop_size_hint(self) -> int | None:
+        """Population size for re-mesh divisibility checks, when the
+        algorithm declares one (the standard single-objective/MO algorithm
+        constructors all do).  ``None`` when the workflow evaluates through
+        a padding ``ShardedProblem`` — padding makes any mesh size valid,
+        so the divisibility gate must not fire."""
+        from ..parallel import find_sharded
+
+        sharded = find_sharded(getattr(self.workflow, "problem", None))
+        if sharded is not None and sharded.pad:
+            return None
+        algo = self._base_algorithm or getattr(self.workflow, "algorithm", None)
+        size = getattr(algo, "pop_size", None)
+        return int(size) if isinstance(size, (int,)) else None
+
     def resume(self, template: State) -> tuple[State, int] | None:
         """Load the newest checkpoint that validates against ``template``.
 
@@ -414,10 +454,21 @@ class ResilientRunner:
         replays the lineage (rebuilding the validation template when a
         restart changed state shapes — population regrows) and restores the
         window, so the continued run reaches bit-identical decisions.
+
+        **Elastic resume.**  Manifests also record the mesh topology the
+        checkpoint was written under.  When it differs from the current
+        workflow's mesh, ``remesh=True`` (the default) repartitions the
+        restored state over the new mesh and continues bit-identically;
+        ``remesh=False`` raises a structured
+        :class:`~evox_tpu.utils.CheckpointError` — a topology change is an
+        operator decision, never something to silently paper over by
+        starting fresh.
         """
         if not self.checkpoint_dir.is_dir():
             return None
         self._resumed_probed = False
+        current_topo = workflow_topology(self.workflow)
+        meshed = workflow_mesh(self.workflow)
         for gen, path in reversed(_numbered_checkpoints(self.checkpoint_dir)):
             try:
                 manifest = read_manifest(path)
@@ -426,6 +477,26 @@ class ResilientRunner:
                         f"manifest generation {manifest['generation']} does "
                         f"not match filename generation {gen}"
                     )
+            except (CheckpointError, ValueError) as e:
+                self._event(
+                    f"skipping unusable checkpoint {path.name}: {e}", warn=True
+                )
+                continue
+            # Topology gate OUTSIDE the skip-this-candidate handler: a mesh
+            # mismatch with remesh disabled is an operator error that must
+            # fail the resume loudly — silently skipping the checkpoint
+            # would restart the run from scratch, losing exactly the work
+            # elastic checkpoints exist to preserve.
+            recorded_topo = check_topology(
+                (manifest or {}).get("topology"),
+                current_topo,
+                remesh=self.remesh,
+                pop_size=self._pop_size_hint(),
+                pop_axis=meshed[1] if meshed is not None else None,
+                context=f"checkpoint {path.name}",
+            )
+            topology_changed = topology_differs(recorded_topo, current_topo)
+            try:
                 try:
                     lineage = [
                         RestartEvent.from_manifest(d)
@@ -462,6 +533,19 @@ class ResilientRunner:
                     f"skipping unusable checkpoint {path.name}: {e}", warn=True
                 )
                 continue
+            if topology_changed and meshed is not None:
+                # Elastic re-mesh: the restored arrays are global, so all
+                # that changes is their partitioning — shard the population
+                # leaves over the new mesh, replicate the rest, and the
+                # trajectory continues bit-identically (global-slot PRNG
+                # folding makes evaluation topology-invariant).
+                mesh, axis = meshed
+                state = remesh_state(state, mesh, axis)
+                self._event(
+                    f"re-meshed {path.name}: written on a "
+                    f"{recorded_topo.describe()}, resuming on a "
+                    f"{current_topo.describe()}"
+                )
             if lineage:
                 self.stats.restarts = lineage
                 self._event(

@@ -108,6 +108,12 @@ def save_state(
         "jax_version": jax.__version__,
         "n_leaves": len(out),
         "written_at": time.time(),
+        # Where this checkpoint was written: device kind/count, process
+        # count, and (when the caller rides a mesh-aware entry in via
+        # ``metadata`` — the resilience runner does) the mesh axes.  Resume
+        # logic uses it to gate or re-mesh cross-topology loads
+        # (``resilience/elastic.py``) without deserializing the state.
+        "topology": _environment_topology(),
     }
     if metadata:
         manifest.update(metadata)
@@ -138,6 +144,14 @@ def _library_version() -> str:
         return evox_tpu.__version__
     except Exception:  # pragma: no cover - import cycle / stripped install
         return "unknown"
+
+
+def _environment_topology() -> dict[str, Any]:
+    """Manifest form of the process's device world (lazy import: the
+    elastic module imports :class:`CheckpointError` from here)."""
+    from ..resilience.elastic import current_topology
+
+    return current_topology().to_manifest()
 
 
 def _resolve(path: Union[str, Path]) -> Path:
@@ -190,7 +204,12 @@ def _match_weak_type(value: "jax.Array", like_leaf: Any) -> "jax.Array":
 
 
 def load_state(
-    path: Union[str, Path], like: Any, allow_missing: bool = False
+    path: Union[str, Path],
+    like: Any,
+    allow_missing: bool = False,
+    *,
+    mesh: Any | None = None,
+    remesh: bool = True,
 ) -> Any:
     """Load a checkpoint written by :func:`save_state` into the structure of
     ``like`` (a template state with the same shape — e.g. a freshly
@@ -215,6 +234,14 @@ def load_state(
         (e.g. a monitor adding a counter).  With ``allow_missing=True`` a
         leaf absent from the checkpoint keeps the template's value (with a
         warning) instead of raising.
+    :param mesh: the ``jax.sharding.Mesh`` the loaded state will run under.
+        When given, the checkpoint's recorded topology manifest is checked
+        against it *before* any leaf is restored: a mesh mismatch with
+        ``remesh=False`` raises a structured :class:`CheckpointError` naming
+        both topologies — never a shape blowup deep inside jax — and with
+        ``remesh=True`` (the default) the restored state is repartitioned
+        for ``mesh`` (``resilience/elastic.py``).
+    :param remesh: allow loading across a topology change (see ``mesh``).
     """
     path = _resolve(path)
     try:
@@ -224,7 +251,22 @@ def load_state(
     except Exception as e:
         raise CheckpointError(f"checkpoint {path} is unreadable: {e!r}") from e
     with data:  # close the archive fd even on a mismatch raise below
-        return _restore_leaves(path, data, like, allow_missing)
+        if mesh is not None and MANIFEST_KEY in data:
+            from ..resilience.elastic import MeshTopology, check_topology
+
+            manifest = json.loads(str(data[MANIFEST_KEY]))
+            check_topology(
+                manifest.get("topology"),
+                MeshTopology.from_mesh(mesh),
+                remesh=remesh,
+                context=f"checkpoint {path}",
+            )
+        state = _restore_leaves(path, data, like, allow_missing)
+    if mesh is not None:
+        from ..resilience.elastic import remesh_state
+
+        state = remesh_state(state, mesh)
+    return state
 
 
 def _restore_leaves(

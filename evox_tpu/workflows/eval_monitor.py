@@ -124,6 +124,10 @@ class EvalMonitor(Monitor):
             # non-finite and was quarantined by the workflow
             # (``StdWorkflow(quarantine_nonfinite=True)``).
             num_nonfinite=jnp.int32(0),
+            # Cumulative count of shard-quarantine events: one per (mesh
+            # shard, evaluation) whose whole row block was penalized
+            # (``StdWorkflow(quarantine_granularity="shard")``).
+            num_shard_quarantines=jnp.int32(0),
             # Automatic restarts applied to this run by a supervising
             # ``ResilientRunner`` health/restart policy.
             num_restarts=jnp.int32(0),
@@ -218,6 +222,20 @@ class EvalMonitor(Monitor):
         return state.replace(
             num_nonfinite=state.num_nonfinite
             + jnp.sum(mask, dtype=jnp.int32)
+        )
+
+    def record_shard_quarantine(self, state: State, shard_mask: jax.Array) -> State:
+        """Count shard-quarantine events (whole mesh shards penalized by the
+        workflow's shard-granular non-finite quarantine) into the cumulative
+        ``num_shard_quarantines`` metric.  ``shard_mask`` is the per-shard
+        boolean mask for this evaluation — each ``True`` entry is one
+        event."""
+        if "num_shard_quarantines" not in state:
+            # Pre-metric checkpoints / custom setups may lack the counter.
+            return state
+        return state.replace(
+            num_shard_quarantines=state.num_shard_quarantines
+            + jnp.sum(shard_mask, dtype=jnp.int32)
         )
 
     def record_restart(self, state: State) -> State:
@@ -337,6 +355,13 @@ class EvalMonitor(Monitor):
         fitness (requires ``StdWorkflow(quarantine_nonfinite=True)``, the
         default)."""
         return state.num_nonfinite
+
+    def get_num_shard_quarantines(self, state: State) -> jax.Array:
+        """Cumulative count of shard-quarantine events — one per (mesh
+        shard, evaluation) whose entire row block was penalized (requires
+        ``StdWorkflow(quarantine_granularity="shard")`` on a distributed
+        run; 0 otherwise)."""
+        return state.num_shard_quarantines
 
     def get_num_restarts(self, state: State) -> jax.Array:
         """Cumulative count of automatic restarts applied to this run by a

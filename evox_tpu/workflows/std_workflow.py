@@ -17,8 +17,10 @@ TPU-native counterpart of the reference ``StdWorkflow``
   ``all_gather`` that rides ICI within a slice / DCN across slices.  Algorithm
   state stays replicated, exactly like the reference's contract (§2.8 of the
   survey); the reference's RNG-forking guard (``std_workflow.py:149-154``)
-  becomes per-shard ``fold_in`` of the device index on the problem key, with
-  per-shard state updates discarded — the same semantics ``fork_rng`` gives.
+  becomes per-individual ``fold_in`` of the **global slot index** on the
+  problem key, with per-shard state updates discarded — the ``fork_rng``
+  semantics, made topology-invariant so elastic re-mesh resume stays
+  bit-identical (``parallel/sharded_problem.py``).
 """
 
 from __future__ import annotations
@@ -61,6 +63,7 @@ class StdWorkflow(Workflow):
         pop_axis: str = "pop",
         quarantine_nonfinite: bool = True,
         nonfinite_penalty: float = 1e30,
+        quarantine_granularity: str = "individual",
     ):
         """
         :param opt_direction: ``"min"`` or ``"max"``; for ``"max"`` fitness is
@@ -83,6 +86,18 @@ class StdWorkflow(Workflow):
             non-finite values (sign follows ``opt_direction`` so the
             quarantined individual is always the *worst*; clamped to the
             fitness dtype's finite range).
+        :param quarantine_granularity: ``"individual"`` (default) penalizes
+            exactly the non-finite rows.  ``"shard"`` — distributed runs
+            only — escalates to the whole mesh shard: any non-finite row
+            condemns every row evaluated by the same shard, because a
+            corrupted device poisons *all* its rows and the finite-looking
+            ones are the dangerous output (a silently-wrong survivor beats
+            a NaN at selection and steers the search; see EvoX's
+            distributed contract, SURVEY §2.8).  Shard events are reported
+            to ``Monitor.record_shard_quarantine`` — ``EvalMonitor`` counts
+            them in ``num_shard_quarantines`` — so one bad shard degrades
+            the run *visibly* instead of silently skewing the gathered
+            fitness.
         """
         if opt_direction not in ("min", "max"):
             raise ValueError(
@@ -102,22 +117,33 @@ class StdWorkflow(Workflow):
         self.enable_distributed = enable_distributed
         if enable_distributed and mesh is None:
             mesh = Mesh(jax.devices(), (pop_axis,))
-        self.mesh = mesh
+        # Only a distributed workflow is mesh-BOUND: storing a mesh that
+        # evaluation never uses would make the elastic layer
+        # (resilience/elastic.py::workflow_mesh) record mesh-bound topology
+        # manifests for unsharded runs, spuriously gating their resume.
+        self.mesh = mesh if enable_distributed else None
         self.pop_axis = pop_axis
+        from ..parallel import ShardedProblem, find_sharded
+
         if enable_distributed:
             n_shards = mesh.shape[pop_axis]
             pop_size = getattr(algorithm, "pop_size", None)
-            if pop_size is not None and pop_size % n_shards != 0:
+            # The chain walk (not a bare isinstance) keeps fault-injection /
+            # transform wrappers AROUND an existing ShardedProblem from
+            # being double-sharded into a nested shard_map.
+            existing = find_sharded(self.problem)
+            pads = existing is not None and existing.pad
+            if pop_size is not None and pop_size % n_shards != 0 and not pads:
                 raise ValueError(
                     f"Distributed evaluation shards the population over the "
                     f"'{pop_axis}' mesh axis; pop_size={pop_size} must be "
-                    f"divisible by the {n_shards} devices on that axis."
+                    f"divisible by the {n_shards} devices on that axis "
+                    f"(or wrap the problem in ShardedProblem(pad=True) to "
+                    f"pad and mask instead)."
                 )
             # One implementation of the sharded-eval logic: wrap the problem
             # (see ``parallel/sharded_problem.py`` for the shard_map body).
-            from ..parallel import ShardedProblem
-
-            if not isinstance(self.problem, ShardedProblem):
+            if existing is None:
                 self.problem = ShardedProblem(self.problem, mesh, pop_axis)
         # Sharded programs must use UNORDERED monitor callbacks: an ordered
         # io_callback threads a token through the entry computation, and on
@@ -126,10 +152,44 @@ class StdWorkflow(Workflow):
         # sharding_propagation.cc) instead of erroring.  The monitor's
         # history accessors re-sort by the (generation, instance) tags every
         # payload carries, so accessor semantics are unchanged.
-        from ..parallel import ShardedProblem as _SP
-
-        if isinstance(self.problem, _SP) and getattr(self.monitor, "ordered", False):
+        sharded = find_sharded(self.problem)
+        if sharded is not None and getattr(self.monitor, "ordered", False):
             self.monitor.set_config(ordered=False)
+        # The ordered-callback hazard also applies to fault-injection
+        # wrappers that ended up INSIDE the auto-wrapped ShardedProblem
+        # (they cannot see the shard_map from their own chain) — and, when
+        # the user composed the sharded problem themselves, to wrappers
+        # above it, which already self-detect.  Assign BOTH ways so a
+        # problem instance reused in a later unsharded workflow gets its
+        # exactly-once ordered semantics back (same single-owner contract
+        # as EvalMonitor: one problem instance serves one workflow at a
+        # time).
+        from ..parallel import iter_problem_chain
+
+        for p in iter_problem_chain(self.problem):
+            if hasattr(p, "in_sharded_program"):
+                p.in_sharded_program = sharded is not None
+        if quarantine_granularity not in ("individual", "shard"):
+            raise ValueError(
+                f"quarantine_granularity must be 'individual' or 'shard', "
+                f"got {quarantine_granularity!r}"
+            )
+        self.quarantine_granularity = quarantine_granularity
+        # Shard count for shard-granular quarantine: from the sharded
+        # problem the evaluation actually runs through (covers the
+        # enable_distributed path, a user-wrapped ShardedProblem, and any
+        # wrapper chain around one).
+        self._n_shards = (
+            int(sharded.mesh.shape[sharded.axis_name])
+            if sharded is not None
+            else None
+        )
+        if quarantine_granularity == "shard" and self._n_shards is None:
+            raise ValueError(
+                "quarantine_granularity='shard' needs a sharded evaluation: "
+                "pass enable_distributed=True (or wrap the problem in "
+                "ShardedProblem) so rows map to mesh shards"
+            )
 
     # -- state -------------------------------------------------------------
     def setup(self, key: jax.Array, instance_id: jax.Array | None = None) -> State:
@@ -225,17 +285,41 @@ class StdWorkflow(Workflow):
         metrics depend on the fitness dtype."""
         if not self.quarantine_nonfinite:
             return fit, mon
+        shard_mode = self.quarantine_granularity == "shard"
         if not jnp.issubdtype(fit.dtype, jnp.floating):
             n_rows = fit.shape[0]
             mon = self.monitor.record_nonfinite(
                 mon, jnp.zeros((n_rows,), dtype=bool)
             )
+            if shard_mode:
+                mon = self.monitor.record_shard_quarantine(
+                    mon, jnp.zeros((self._n_shards,), dtype=bool)
+                )
             return fit, mon
         # Clamp the penalty into the dtype's finite range: 1e30 would itself
         # round to inf in float16/bfloat16 fitness, defeating the quarantine.
         penalty = min(self.nonfinite_penalty, float(jnp.finfo(fit.dtype).max))
         bad = ~jnp.isfinite(fit)
         row_bad = bad if fit.ndim == 1 else jnp.any(bad, axis=-1)
+        if shard_mode:
+            # Escalate to the shard: any bad row condemns every row the same
+            # shard evaluated — its finite-looking rows are the output of
+            # the same broken device and must not survive selection.  The
+            # row→shard mapping is the parallel layer's single definition
+            # (contiguous ceil blocks, ragged tails included).
+            from ..parallel import shard_row_ids
+
+            shard_ids = shard_row_ids(row_bad.shape[0], self._n_shards)
+            shard_bad = (
+                jax.ops.segment_max(
+                    row_bad.astype(jnp.int32),
+                    shard_ids,
+                    num_segments=self._n_shards,
+                )
+                > 0
+            )
+            mon = self.monitor.record_shard_quarantine(mon, shard_bad)
+            row_bad = shard_bad[shard_ids]
         mon = self.monitor.record_nonfinite(mon, row_bad)
         # Demote the WHOLE individual, not just its non-finite components:
         # a multi-objective row like (NaN, 0.001) patched elementwise would
@@ -284,7 +368,7 @@ class StdWorkflow(Workflow):
             out["best_fitness"] = raw["best_fitness"]
         mon = state.monitor if "monitor" in state else None
         if mon is not None:
-            for key in ("num_nonfinite", "num_restarts"):
+            for key in ("num_nonfinite", "num_shard_quarantines", "num_restarts"):
                 if key in mon:
                     out[key] = mon[key]
         return out

@@ -8,6 +8,13 @@
 #                               retry/backoff, watchdog, kill-and-resume,
 #                               NaN/Inf quarantine, state corruption,
 #                               health/restart — all CPU, under two minutes)
+#   ./run_tests.sh --elastic    elastic-topology lane (8-virtual-device CPU
+#                               mesh): topology-invariant sharded PRNG
+#                               streams, re-meshed checkpoint resume
+#                               (8 -> 4 -> 2 devices, bit-identical),
+#                               population padding, shard-granular
+#                               quarantine, dead/straggler-shard chaos
+#                               schedules, per-shard health verdicts
 #   ./run_tests.sh --health     health/restart lane: run-health diagnostics +
 #                               restart-policy suite, then the CPU
 #                               microbenchmark asserting the between-chunk
@@ -36,6 +43,11 @@ fi
 if [ "$1" = "--lint-fix-hints" ]; then
   shift
   exec python -m tools.graftlint --lint-fix-hints "$@"
+fi
+if [ "$1" = "--elastic" ]; then
+  shift
+  exec "${CPU_ENV[@]}" python -m pytest \
+    tests/test_elastic.py tests/test_parallel_and_checkpoint.py -q "$@"
 fi
 if [ "$1" = "--health" ]; then
   shift

@@ -1,0 +1,36 @@
+"""GL006 true positives: mesh-position-dependent PRNG folding — the
+topology-dependence bug class that breaks elastic (re-meshed) resume."""
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_direct(key, axis):
+    # The original ShardedProblem bug: per-shard decorrelation keyed on the
+    # shard's position — an 8-way and a 4-way mesh draw different streams.
+    return jax.random.fold_in(key, jax.lax.axis_index(axis))  # GL006
+
+
+def fold_via_name(state, axis):
+    idx = jax.lax.axis_index(axis)
+    local = state.replace(key=jax.random.fold_in(state.key, idx))  # GL006
+    return local
+
+
+def fold_via_arithmetic(key, axis, local_n):
+    # Deriving through arithmetic does not launder the dependence: the
+    # offset is still a function of which shard runs the program.
+    offset = jax.lax.axis_index(axis) * local_n + 1
+    return jax.random.fold_in(key, offset)  # GL006
+
+
+def fold_through_vmap(state, axis, local_n, pop_shard):
+    # The per-individual idiom with the WRONG slots: shard-local positions
+    # flow through the vmapped helper's parameter into the fold.
+    start = jax.lax.axis_index(axis) * local_n
+
+    def eval_one(slot, row):
+        k = jax.random.fold_in(state.key, slot)  # GL006
+        return jnp.sum(row) + jax.random.uniform(k, ())
+
+    return jax.vmap(eval_one)(start + jnp.arange(local_n), pop_shard)

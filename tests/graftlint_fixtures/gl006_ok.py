@@ -1,0 +1,32 @@
+"""GL006 negatives: legitimate fold_in and axis_index use that must stay
+clean — position used for slicing/collectives, folds fed topology-invariant
+values."""
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_constant_salt(state):
+    # Constant salts are topology-invariant (the outer key advance idiom).
+    return state.replace(key=jax.random.fold_in(state.key, 0x5EED))
+
+
+def fold_restart_index(key, restart_index):
+    # Restart lineage salts come from the supervisor, not the mesh.
+    return jax.random.fold_in(key, restart_index)
+
+
+def axis_index_for_slicing(xs, axis, local_n):
+    # Position used to address data, never to derive randomness.
+    start = jax.lax.axis_index(axis) * local_n
+    return jax.lax.dynamic_slice_in_dim(xs, start, local_n)
+
+
+def fold_global_slots(state, slots, pop_shard):
+    # The sanctioned pattern's shape: slots arrive as data (global indices),
+    # with no axis_index derivation in scope.
+    def eval_one(slot, row):
+        k = jax.random.fold_in(state.key, slot)
+        return jnp.sum(row) + jax.random.uniform(k, ())
+
+    return jax.vmap(eval_one)(slots, pop_shard)

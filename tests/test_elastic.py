@@ -1,0 +1,602 @@
+"""Elastic-topology resilience tests: re-meshable checkpoints, topology-
+invariant sharded PRNG streams, shard-level fault injection, and per-shard
+quarantine — the distributed-path failure modes a fixed-world ``torchrun``
+deployment cannot survive.
+
+Everything runs on the 8-virtual-device CPU platform conftest configures
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``); when that flag
+could not be applied (e.g. a real-accelerator environment with fewer
+devices), the whole lane skips cleanly rather than asserting on meshes it
+cannot build.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.algorithms import PSO
+from evox_tpu.core import Problem, State
+from evox_tpu.parallel import (
+    ShardedProblem,
+    make_pop_mesh,
+    pad_population,
+    population_mask,
+    unpad_fitness,
+)
+from evox_tpu.problems.numerical import Sphere
+from evox_tpu.resilience import (
+    FaultyProblem,
+    HealthProbe,
+    MeshTopology,
+    ResilientRunner,
+    check_topology,
+    workflow_topology,
+)
+from evox_tpu.utils import CheckpointError, load_state, read_manifest, save_state
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8,
+    reason="elastic lane needs 8 simulated devices "
+    "(XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+)
+
+DIM = 4
+LB = -5.0 * jnp.ones(DIM)
+UB = 5.0 * jnp.ones(DIM)
+POP = 16
+
+
+class NoisySphere(Problem):
+    """Stochastic problem keyed by ``state.key`` — the shape whose per-shard
+    decorrelation used to be topology-DEPENDENT (axis_index folding)."""
+
+    def setup(self, key: jax.Array) -> State:
+        return State(key=key)
+
+    def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
+        next_key, draw_key = jax.random.split(state.key)
+        noise = jax.random.normal(draw_key, (pop.shape[0],))
+        fit = jnp.sum(pop**2, axis=-1) + 0.1 * noise
+        return fit, state.replace(key=next_key)
+
+
+# ---------------------------------------------------------------------------
+# topology-invariant sharded PRNG streams (the GL006 bug class, fixed)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_stochastic_eval_is_topology_invariant(key):
+    """Regression for the axis_index-folding bug: the same seed must produce
+    bit-identical stochastic fitness on 1/2/4/8-way meshes (global-slot
+    folding makes evaluation a pure function of (key, slot, individual))."""
+    pop = jax.random.uniform(key, (POP, DIM)) * 4 - 2
+    results = []
+    for n_dev in (1, 2, 4, 8):
+        sharded = ShardedProblem(NoisySphere(), make_pop_mesh(n_dev))
+        state = sharded.setup(jax.random.key(7))
+        fit, _ = jax.jit(sharded.evaluate)(state, pop)
+        results.append(np.asarray(fit))
+    for n_dev, fit in zip((2, 4, 8), results[1:]):
+        np.testing.assert_array_equal(
+            results[0], fit, err_msg=f"{n_dev}-way mesh diverged from 1-way"
+        )
+
+
+def test_per_individual_keys_opt_out_keeps_batch_semantics(key):
+    """Keyed problems whose fitness depends on the whole batch (batch-
+    relative normalization, ranking, ...) opt out of per-individual
+    evaluation: whole shards reach the inner evaluate, at the documented
+    cost of topology-dependent randomness."""
+
+    class BatchNormed(Problem):
+        def setup(self, k):
+            return State(key=k)
+
+        def evaluate(self, state, pop):
+            raw = jnp.sum(pop**2, axis=-1)
+            return raw - jnp.mean(raw), state  # zero-mean per BATCH
+
+    pop = jax.random.uniform(key, (POP, DIM)) * 4 - 2
+    sharded = ShardedProblem(
+        BatchNormed(), make_pop_mesh(4), per_individual_keys=False
+    )
+    fit, _ = jax.jit(sharded.evaluate)(sharded.setup(jax.random.key(0)), pop)
+    # Each 4-row shard is zero-mean — batch semantics survived sharding
+    # (the per-individual default would collapse every row to 0).
+    np.testing.assert_allclose(
+        np.asarray(fit).reshape(4, -1).mean(axis=1), np.zeros(4), atol=1e-6
+    )
+    assert len(np.unique(np.asarray(fit))) > 1
+
+
+def test_sharded_stochastic_rows_are_decorrelated(key):
+    """Global-slot folding must still DECORRELATE individuals: two identical
+    rows in different slots draw different noise."""
+    row = jnp.ones((1, DIM))
+    pop = jnp.concatenate([row] * POP)
+    sharded = ShardedProblem(NoisySphere(), make_pop_mesh(8))
+    fit, _ = jax.jit(sharded.evaluate)(sharded.setup(jax.random.key(3)), pop)
+    assert len(np.unique(np.asarray(fit))) == POP
+
+
+# ---------------------------------------------------------------------------
+# population padding (divisibility shim)
+# ---------------------------------------------------------------------------
+
+
+def test_pad_population_and_mask():
+    pop = jnp.arange(10.0 * DIM).reshape(10, DIM)
+    padded, mask = pad_population(pop, 8)
+    assert padded.shape == (16, DIM)
+    np.testing.assert_array_equal(np.asarray(mask), np.arange(16) < 10)
+    np.testing.assert_array_equal(np.asarray(padded[:10]), np.asarray(pop))
+    # Padding repeats the last real row: valid domain values.
+    np.testing.assert_array_equal(
+        np.asarray(padded[10:]), np.tile(np.asarray(pop[-1]), (6, 1))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(population_mask(10, 8)), np.asarray(mask)
+    )
+    # Already-divisible populations pass through untouched.
+    same, full_mask = pad_population(pop[:8], 8)
+    assert same.shape == (8, DIM) and bool(jnp.all(full_mask))
+    np.testing.assert_array_equal(
+        np.asarray(unpad_fitness(jnp.arange(16.0), 10)), np.arange(10.0)
+    )
+
+
+def test_sharded_problem_pad_option(key):
+    """pad=True evaluates a non-divisible population (masking the padding
+    out of the fitness) and matches the 1-way mesh bit-for-bit; the
+    no-padding default keeps the original ValueError."""
+    pop = jax.random.uniform(key, (10, DIM)) * 4 - 2
+    one_way = ShardedProblem(NoisySphere(), make_pop_mesh(1))
+    fit_ref, _ = jax.jit(one_way.evaluate)(one_way.setup(jax.random.key(7)), pop)
+    padded8 = ShardedProblem(NoisySphere(), make_pop_mesh(8), pad=True)
+    fit_pad, _ = jax.jit(padded8.evaluate)(padded8.setup(jax.random.key(7)), pop)
+    assert fit_pad.shape == (10,)
+    np.testing.assert_array_equal(np.asarray(fit_ref), np.asarray(fit_pad))
+    strict = ShardedProblem(NoisySphere(), make_pop_mesh(8))
+    with pytest.raises(ValueError, match="10 must divide.*8-way"):
+        strict.evaluate(strict.setup(jax.random.key(7)), pop)
+
+
+def test_distributed_workflow_accepts_padding_wrapper(key):
+    """A pad-enabled ShardedProblem makes non-divisible pop sizes legal all
+    the way through the standard distributed path (the divisibility
+    ValueError only guards the no-padding configuration)."""
+    mesh = make_pop_mesh(8)
+    wf = StdWorkflow(
+        PSO(10, LB, UB),  # 10 % 8 != 0: only legal because pad=True
+        ShardedProblem(Sphere(), mesh, pad=True),
+        enable_distributed=True,
+        mesh=mesh,
+    )
+    state = jax.jit(wf.init_step)(wf.init(key))
+    assert state.algorithm.fit.shape == (10,)
+    assert np.all(np.isfinite(np.asarray(state.algorithm.fit)))
+    with pytest.raises(ValueError, match="divisible by the 8 devices"):
+        StdWorkflow(
+            PSO(10, LB, UB), Sphere(), enable_distributed=True, mesh=mesh
+        )
+
+
+def test_elastic_resume_with_padding_onto_non_dividing_mesh(tmp_path):
+    """Re-meshing a pad-enabled run onto a mesh its pop size does not divide
+    must succeed (padding absorbs the remainder) — the divisibility gate
+    only binds no-padding runs."""
+
+    def build(n_dev):
+        mesh = make_pop_mesh(n_dev)
+        return StdWorkflow(
+            PSO(12, LB, UB),  # 12 divides 4 but NOT 8
+            ShardedProblem(NoisySphere(), mesh, pad=True),
+            monitor=EvalMonitor(full_fit_history=False),
+            enable_distributed=True,
+            mesh=mesh,
+        )
+
+    wf4 = build(4)
+    r4 = ResilientRunner(wf4, tmp_path, checkpoint_every=1)
+    r4.run(wf4.init(jax.random.key(0)), n_steps=2, fresh=True)
+    wf8 = build(8)
+    r8 = ResilientRunner(wf8, tmp_path, checkpoint_every=1)
+    state = r8.run(wf8.init(jax.random.key(0)), n_steps=4)
+    assert r8.stats.resumed_from_generation == 2
+    assert np.all(np.isfinite(np.asarray(state.algorithm.fit)))
+
+
+# ---------------------------------------------------------------------------
+# elastic (re-meshed) checkpoint resume
+# ---------------------------------------------------------------------------
+
+
+def _build_distributed(n_dev):
+    mon = EvalMonitor(full_fit_history=False)
+    wf = StdWorkflow(
+        PSO(POP, LB, UB),
+        NoisySphere(),
+        monitor=mon,
+        enable_distributed=True,
+        mesh=make_pop_mesh(n_dev),
+    )
+    return mon, wf
+
+
+def test_elastic_resume_bit_identical(tmp_path):
+    """The acceptance scenario: 10 generations sharded on an 8-device mesh;
+    checkpoint; resume on 4 and then 2 devices — final best fitness and the
+    PRNG-dependent trajectory bit-identical to the uninterrupted 8-device
+    run."""
+    ckpt = tmp_path / "elastic"
+    # Uninterrupted 8-device reference.
+    _, wf_ref = _build_distributed(8)
+    runner = ResilientRunner(wf_ref, tmp_path / "ref", checkpoint_every=1)
+    s_ref = runner.run(wf_ref.init(jax.random.key(0)), n_steps=10, fresh=True)
+
+    # Interrupted lineage: 8 devices for 4 generations...
+    _, wf8 = _build_distributed(8)
+    r8 = ResilientRunner(wf8, ckpt, checkpoint_every=1)
+    r8.run(wf8.init(jax.random.key(0)), n_steps=4, fresh=True)
+    # ...killed; rescheduled onto 4 devices up to generation 7...
+    _, wf4 = _build_distributed(4)
+    r4 = ResilientRunner(wf4, ckpt, checkpoint_every=1)
+    r4.run(wf4.init(jax.random.key(0)), n_steps=7)
+    assert r4.stats.resumed_from_generation == 4
+    # ...killed again; finishes on 2 devices.
+    _, wf2 = _build_distributed(2)
+    r2 = ResilientRunner(wf2, ckpt, checkpoint_every=1)
+    s_el = r2.run(wf2.init(jax.random.key(0)), n_steps=10)
+    assert r2.stats.resumed_from_generation == 7
+
+    for field in ("fit", "pop"):
+        np.testing.assert_array_equal(
+            np.asarray(s_ref.algorithm[field]),
+            np.asarray(s_el.algorithm[field]),
+            err_msg=f"algorithm.{field} diverged across re-meshes",
+        )
+    np.testing.assert_array_equal(
+        np.asarray(s_ref.monitor.topk_fitness),
+        np.asarray(s_el.monitor.topk_fitness),
+    )
+
+
+def test_runner_manifest_records_mesh_topology(tmp_path):
+    _, wf = _build_distributed(8)
+    runner = ResilientRunner(wf, tmp_path, checkpoint_every=2)
+    runner.run(wf.init(jax.random.key(1)), n_steps=2, fresh=True)
+    man = read_manifest(tmp_path / "ckpt_00000002.npz")
+    topo = man["topology"]
+    assert topo["axis_names"] == ["pop"]
+    assert topo["axis_sizes"] == [8]
+    assert topo["num_devices"] == 8
+    assert topo["platform"] and topo["device_kind"]
+    assert MeshTopology.from_manifest(topo).meshed
+
+
+def test_runner_remesh_disabled_raises_structured_error(tmp_path):
+    _, wf8 = _build_distributed(8)
+    r8 = ResilientRunner(wf8, tmp_path, checkpoint_every=2)
+    r8.run(wf8.init(jax.random.key(0)), n_steps=2, fresh=True)
+    _, wf4 = _build_distributed(4)
+    r4 = ResilientRunner(wf4, tmp_path, checkpoint_every=2, remesh=False)
+    with pytest.raises(CheckpointError, match="re-meshing is disabled"):
+        r4.run(wf4.init(jax.random.key(0)), n_steps=4)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hygiene: topology manifest fields + load_state gate
+# ---------------------------------------------------------------------------
+
+
+def test_save_state_records_environment_topology(tmp_path, key):
+    path = save_state(tmp_path / "s.npz", State(a=jnp.zeros(3)))
+    topo = read_manifest(path)["topology"]
+    assert topo["num_devices"] == jax.device_count()
+    assert topo["num_processes"] == jax.process_count()
+    assert topo["axis_names"] == []  # meshless writer: not mesh-bound
+    assert not MeshTopology.from_manifest(topo).meshed
+
+
+def test_load_state_topology_gate(tmp_path):
+    """A mesh-bound checkpoint loaded under a different mesh: remesh=False
+    raises the structured error BEFORE any leaf restore; remesh=True loads
+    and repartitions."""
+    _, wf = _build_distributed(8)
+    runner = ResilientRunner(wf, tmp_path, checkpoint_every=2)
+    state = runner.run(wf.init(jax.random.key(2)), n_steps=2, fresh=True)
+    path = tmp_path / "ckpt_00000002.npz"
+    template = wf.init(jax.random.key(2))
+    mesh4 = make_pop_mesh(4)
+    with pytest.raises(CheckpointError, match="re-meshing is disabled"):
+        load_state(path, template, mesh=mesh4, remesh=False)
+    restored = load_state(path, template, mesh=mesh4)
+    np.testing.assert_array_equal(
+        np.asarray(restored.algorithm.pop), np.asarray(state.algorithm.pop)
+    )
+    # Population leaves land sharded over the new mesh, state replicated.
+    assert not restored.algorithm.pop.sharding.is_fully_replicated
+    assert restored.monitor.generation.sharding.is_fully_replicated
+    # Same mesh as written: no gate even with remesh=False.
+    same = load_state(path, template, mesh=make_pop_mesh(8), remesh=False)
+    np.testing.assert_array_equal(
+        np.asarray(same.algorithm.pop), np.asarray(state.algorithm.pop)
+    )
+
+
+def test_load_state_respects_custom_axis_name(tmp_path, key):
+    """load_state(mesh=...) must repartition over the mesh's OWN first axis,
+    not assume it is called 'pop'."""
+    from jax.sharding import Mesh
+
+    state = State(algorithm=State(pop=jnp.ones((POP, DIM)), fit=jnp.zeros(POP)))
+    path = save_state(tmp_path / "s.npz", state)
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("devices",))
+    restored = load_state(path, state, mesh=mesh)
+    assert not restored.algorithm.pop.sharding.is_fully_replicated
+    np.testing.assert_array_equal(
+        np.asarray(restored.algorithm.pop), np.ones((POP, DIM))
+    )
+
+
+def test_check_topology_divisibility_gate():
+    eight = MeshTopology.from_mesh(make_pop_mesh(8))
+    three = MeshTopology.from_mesh(make_pop_mesh(3))
+    # 16 does not divide a 3-way mesh: the error names the fix.
+    with pytest.raises(CheckpointError, match="does not divide the 3-way"):
+        check_topology(eight, three, remesh=True, pop_size=16)
+    # Divisible (or meshless) worlds pass.
+    assert check_topology(eight, three, remesh=True, pop_size=12) == eight
+    assert check_topology(None, three) is None
+
+
+def test_check_topology_multi_axis_uses_population_axis():
+    """On a multi-axis mesh only the POPULATION axis governs divisibility —
+    12 individuals shard fine over a (pop=4, model=2) mesh even though 12
+    does not divide the 8 total devices."""
+    from jax.sharding import Mesh
+
+    eight = MeshTopology.from_mesh(make_pop_mesh(8))
+    two_axis = MeshTopology.from_mesh(
+        Mesh(np.asarray(jax.devices()[:8]).reshape(4, 2), ("pop", "model"))
+    )
+    assert (
+        check_topology(eight, two_axis, remesh=True, pop_size=12, pop_axis="pop")
+        == eight
+    )
+    with pytest.raises(CheckpointError, match="does not divide the 4-way"):
+        check_topology(eight, two_axis, remesh=True, pop_size=10, pop_axis="pop")
+
+
+def test_workflow_topology_walks_wrapper_chains():
+    mesh = make_pop_mesh(8)
+    wf = StdWorkflow(
+        PSO(POP, LB, UB),
+        FaultyProblem(ShardedProblem(Sphere(), mesh), dead_shards={0: (1,)}),
+    )
+    topo = workflow_topology(wf)
+    assert topo.meshed and topo.axis_sizes == (8,)
+
+
+# ---------------------------------------------------------------------------
+# shard-granular quarantine + chaos schedules
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_dead_shard_quarantined_and_counted(tmp_path):
+    """The acceptance chaos scenario: one all-NaN shard for 3 generations —
+    the run completes, ``num_shard_quarantines`` counts the events, and the
+    final best fitness is finite and within tolerance of the fault-free
+    run."""
+    mesh = make_pop_mesh(8)
+
+    def run(dead):
+        mon = EvalMonitor(full_fit_history=False)
+        # Same schedule structure for the comparator (empty generation list)
+        # so both programs compile identically.
+        prob = FaultyProblem(
+            ShardedProblem(Sphere(), mesh), dead_shards={2: dead}
+        )
+        wf = StdWorkflow(
+            PSO(POP, LB, UB), prob, monitor=mon,
+            quarantine_granularity="shard",
+        )
+        state = wf.init(jax.random.key(5))
+        state = jax.jit(wf.init_step)(state)
+        step = jax.jit(wf.step)
+        for _ in range(11):
+            state = step(state)
+        jax.block_until_ready(state)
+        return mon, state
+
+    mon_clean, s_clean = run(())
+    mon_chaos, s_chaos = run((3, 4, 5))
+
+    assert int(mon_clean.get_num_shard_quarantines(s_clean.monitor)) == 0
+    assert int(mon_chaos.get_num_shard_quarantines(s_chaos.monitor)) == 3
+    # 3 events x 2 rows per shard individuals penalized.
+    assert int(mon_chaos.get_num_nonfinite(s_chaos.monitor)) == 6
+    clean = float(mon_clean.get_best_fitness(s_clean.monitor))
+    chaos = float(mon_chaos.get_best_fitness(s_chaos.monitor))
+    assert np.isfinite(chaos)
+    # Losing 1/8 of the evaluations for 3 generations degrades the search
+    # but must not derail it: same order of magnitude as the clean run.
+    assert chaos <= max(10.0 * clean, clean + 1.0)
+
+
+def test_shard_quarantine_requires_sharded_evaluation():
+    with pytest.raises(ValueError, match="needs a sharded evaluation"):
+        StdWorkflow(
+            PSO(POP, LB, UB), Sphere(), quarantine_granularity="shard"
+        )
+    with pytest.raises(ValueError, match="quarantine_granularity"):
+        StdWorkflow(
+            PSO(POP, LB, UB), Sphere(), quarantine_granularity="device"
+        )
+
+
+def test_straggler_shard_with_eval_deadline(tmp_path):
+    """A straggler shard past the eval deadline abandons the evaluation:
+    every row falls back to the NaN penalty (whole-eval quarantine under
+    shard granularity) and the run keeps moving instead of stalling."""
+    mesh = make_pop_mesh(8)
+    mon = EvalMonitor(full_fit_history=False)
+    prob = FaultyProblem(
+        ShardedProblem(Sphere(), mesh),
+        straggler_shards={2: (1,)},
+        straggler_delay=30.0,  # would stall half a minute unguarded
+        eval_deadline=0.25,
+    )
+    wf = StdWorkflow(
+        PSO(POP, LB, UB), prob, monitor=mon, quarantine_granularity="shard"
+    )
+    state = wf.init(jax.random.key(0))
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    for _ in range(3):
+        state = step(state)
+    jax.block_until_ready(state)
+    # Eval 1 deadlined -> all 8 shards quarantined that generation, none
+    # after (the straggler is attempt-counted and the schedule passed).
+    assert int(mon.get_num_shard_quarantines(state.monitor)) == 8
+    assert prob.attempts("straggler2", 1) == 1
+    assert np.isfinite(float(mon.get_best_fitness(state.monitor)))
+
+
+def test_straggler_without_deadline_stalls_program():
+    """Control for the deadline test: unguarded stragglers really do stall
+    dispatch for the scheduled delay (the watchdog-territory behavior)."""
+    import time
+
+    mesh = make_pop_mesh(8)
+    prob = FaultyProblem(
+        ShardedProblem(Sphere(), mesh),
+        straggler_shards={1: (0,)},
+        straggler_delay=0.6,
+    )
+    wf = StdWorkflow(PSO(POP, LB, UB), prob)
+    state = wf.init(jax.random.key(0))
+    start = time.monotonic()
+    state = jax.jit(wf.init_step)(state)
+    jax.block_until_ready(state)
+    assert time.monotonic() - start >= 0.55
+
+
+def test_faulty_problem_inside_distributed_auto_wrap_runs():
+    """enable_distributed wraps the ShardedProblem ABOVE a user-supplied
+    FaultyProblem; its host-fault callback then traces inside the shard_map
+    and must switch to unordered (ordered + shard_map hard-aborts the
+    jax-0.4.x SPMD compiler) — the workflow marks the wrapper."""
+    prob = FaultyProblem(Sphere(), delay_generations=(0,), delay_seconds=0.01)
+    wf = StdWorkflow(
+        PSO(POP, LB, UB), prob,
+        enable_distributed=True, mesh=make_pop_mesh(8),
+    )
+    assert prob.in_sharded_program
+    state = jax.jit(wf.init_step)(wf.init(jax.random.key(0)))
+    jax.block_until_ready(state)
+    # Inside the shard_map the callback fires per shard (documented):
+    # reached at least once proves the program compiled and ran.
+    assert prob.attempts("delay", 0) >= 1
+    assert np.all(np.isfinite(np.asarray(state.algorithm.fit)))
+
+
+def test_dead_shards_requires_shard_mapping():
+    with pytest.raises(ValueError, match="dead_shards needs the shard count"):
+        FaultyProblem(Sphere(), dead_shards={0: (1,)})
+    # Explicit shard count works without a mesh on the chain.
+    prob = FaultyProblem(Sphere(), dead_shards={1: (0,)}, shards=4)
+    fit, _ = jax.jit(prob.evaluate)(
+        prob.setup(jax.random.key(0)), jnp.ones((8, DIM))
+    )
+    assert np.isnan(np.asarray(fit)[2:4]).all()
+    assert np.isfinite(np.asarray(fit)[:2]).all()
+
+
+# ---------------------------------------------------------------------------
+# per-shard health aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_health_probe_per_shard_dead_shard_verdict():
+    """With quarantine off (custom-workflow territory) the probe's per-shard
+    aggregation localizes a dead shard that whole-population stats only show
+    as 'some NaNs somewhere'."""
+    mesh = make_pop_mesh(8)
+    wf = StdWorkflow(
+        PSO(POP, LB, UB),
+        FaultyProblem(ShardedProblem(Sphere(), mesh), dead_shards={5: (1,)}),
+        quarantine_nonfinite=False,
+    )
+    probe = HealthProbe(shards=8)
+    state = wf.init(jax.random.key(1))
+    state = jax.jit(wf.init_step)(state)
+    healthy_report = probe.check(state, generation=1)
+    assert healthy_report.dead_shards == []
+    state = jax.jit(wf.step)(state)  # evaluation index 1: shard 5 dies
+    report = probe.check(state, generation=2)
+    assert not report.healthy
+    assert report.dead_shards == [5]
+    assert report.shard_nonfinite is not None
+    assert report.shard_nonfinite[5] == POP // 8
+    assert sum(report.shard_nonfinite) == POP // 8
+    assert any("dead shard" in r for r in report.reasons)
+
+
+def test_health_probe_per_shard_handles_ragged_populations():
+    """Per-shard metrics must survive populations that do not divide the
+    shard count (the ShardedProblem(pad=True) case): the ragged-tail
+    row→shard mapping, not a reshape."""
+    # 10 rows over 8 shards -> ceil blocks of 2: shards 0-4 own 2 rows
+    # (shard 4 spans rows 8-9), shards 5-7 own none.
+    fit = jnp.zeros(10).at[2:4].set(jnp.nan)  # shard 1's whole block
+    state = State(algorithm=State(pop=jnp.ones((10, DIM)), fit=fit))
+    report = HealthProbe(shards=8).check(state, generation=1)
+    assert report.dead_shards == [1]
+    assert report.shard_nonfinite == [0, 2, 0, 0, 0, 0, 0, 0]
+    # Empty tail shards are neither dead nor collapsed.
+    probe = HealthProbe(shards=8, diversity_floor=1e-9)
+    rep2 = probe.check(state, generation=1)
+    assert 5 not in rep2.dead_shards and 6 not in rep2.dead_shards
+
+
+def test_unsharded_workflow_with_mesh_arg_is_not_mesh_bound(tmp_path):
+    """A mesh passed alongside enable_distributed=False must not bind the
+    run to a topology: checkpoints stay re-loadable anywhere."""
+    wf = StdWorkflow(
+        PSO(POP, LB, UB), Sphere(),
+        mesh=make_pop_mesh(8), enable_distributed=False,
+    )
+    assert wf.mesh is None
+    assert not workflow_topology(wf).meshed
+
+
+def test_reused_faulty_problem_regains_ordered_callbacks():
+    """in_sharded_program is assigned both ways: reusing a fault wrapper in
+    a later UNsharded workflow restores exactly-once ordered semantics."""
+    prob = FaultyProblem(Sphere(), delay_generations=(0,), delay_seconds=0.0)
+    StdWorkflow(PSO(POP, LB, UB), prob,
+                enable_distributed=True, mesh=make_pop_mesh(8))
+    assert prob.in_sharded_program
+    StdWorkflow(PSO(POP, LB, UB), prob)
+    assert not prob.in_sharded_program
+    assert prob._callback_kwargs()["ordered"] is True
+
+
+def test_health_probe_per_shard_diversity_collapse(key):
+    """One shard's rows collapsing to a point is invisible to the global
+    spread (the other shards keep it healthy) but trips the per-shard
+    floor."""
+    pop = jax.random.uniform(key, (POP, DIM))
+    collapsed = pop.at[4:6].set(pop[4])  # shard 2's block -> identical rows
+    state = State(algorithm=State(pop=collapsed, fit=jnp.zeros(POP)))
+    probe = HealthProbe(shards=8, diversity_floor=1e-6)
+    report = probe.check(state, generation=1)
+    assert report.collapsed_shards == [2]
+    assert not report.healthy
+    assert report.diversity is not None and report.diversity > 1e-6
+    # Shard-blind probe on the same state: healthy (the blind spot).
+    blind = HealthProbe(diversity_floor=1e-6)
+    assert blind.check(state, generation=1).healthy

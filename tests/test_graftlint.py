@@ -418,19 +418,55 @@ def test_repo_is_clean_against_committed_baselines():
 
 
 def test_new_rules_start_at_zero():
-    """GL001-GL005 carry NO baselined debt: the library is clean outside the
-    two pragma'd intentional sites, and new code must stay clean.  The
+    """GL001-GL006 carry NO baselined debt: the library is clean outside the
+    pragma'd intentional sites, and new code must stay clean.  The
     sections exist but are EMPTY — present so `--update-baseline`'s
     refuse-increases check always applies to them (an absent section is the
     first-time-seed path reserved for future rules)."""
     committed = json.loads(
         (REPO / "tools" / "graftlint" / "baseline.json").read_text()
     )
-    assert sorted(committed) == ["GL001", "GL002", "GL003", "GL004", "GL005"]
+    assert sorted(committed) == [
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
+    ]
     assert all(files == {} for files in committed.values()), (
         "GL001+ baselines must stay empty — fix or pragma new findings "
         f"instead of baselining them: {committed}"
     )
+
+
+def test_gl006_guards_parallel_layer():
+    """The regression GL006 exists for: axis_index-derived values must never
+    feed fold_in in the parallel layer (topology-dependent randomness breaks
+    elastic re-mesh resume).  The one sanctioned site — the global-slot fold
+    in ShardedProblem, which is topology-invariant by construction — must be
+    (a) visible to the raw rule, proving the rule sees through the
+    per-individual vmap idiom, and (b) pragma-suppressed with GL006 so the
+    suite stays clean."""
+    rule = RULES_BY_CODE["GL006"]
+    mod = Module(REPO / "evox_tpu" / "parallel" / "sharded_problem.py")
+    raw = rule.check(mod)
+    # Exactly the two sanctioned sites: the global-slot fold (the invariant
+    # pattern) and the per_individual_keys=False whole-shard fold (the
+    # documented topology-dependent opt-out) — both pragma'd.
+    assert len(raw) == 2, [f.format() for f in raw]
+    assert all(mod.suppressed(f) for f in raw)
+    # Suite-level: nothing unsuppressed anywhere in the library.
+    assert not scan_paths([REPO / "evox_tpu"], [rule])
+
+
+def test_gl006_flags_shard_index_fold_regression(tmp_path):
+    """Re-introducing the original bug — folding the shard's axis_index into
+    the replicated problem key — must flag."""
+    src = tmp_path / "regress.py"
+    src.write_text(
+        "import jax\n"
+        "def local_eval(state, axis):\n"
+        "    idx = jax.lax.axis_index(axis)\n"
+        "    return state.replace(key=jax.random.fold_in(state.key, idx))\n"
+    )
+    found = _findings(src, ["GL006"])
+    assert [f.rule for f in found] == ["GL006"], [f.format() for f in found]
 
 
 def test_counts_match_gl000_baseline_exactly():

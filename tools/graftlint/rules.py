@@ -23,6 +23,13 @@ violation into a machine-checked finding:
 * **GL005** — impure compiled methods: assignment to ``self.*`` inside the
   ``step`` family (components must stay static under jit; evolving values
   belong in the ``State``).
+* **GL006** — topology-dependent PRNG folding: a value derived from
+  ``jax.lax.axis_index`` feeding ``jax.random.fold_in``.  Folding the mesh
+  position into a replicated key ties every random draw to *which shard
+  evaluated it*: the same seed yields different trajectories on an 8-way vs
+  a 4-way mesh, and elastic (re-meshed) checkpoint resume silently forks.
+  Fold the **global slot index** instead (``parallel/sharded_problem.py``
+  is the pragma'd sanctioned pattern).
 
 **Compiled scope.**  GL002-GL005 only apply inside functions that trace
 under ``jax.jit``: methods/functions named ``step``/``init_step``/
@@ -63,6 +70,7 @@ STEP_FAMILY = frozenset(
         "post_eval",
         "pre_tell",
         "record_nonfinite",
+        "record_shard_quarantine",
         "record_auxiliary",
     }
 )
@@ -955,6 +963,130 @@ class ImpureStepRule(_CompiledScopeRule):
         return out
 
 
+# ---------------------------------------------------------------------------
+# GL006 — axis_index-derived PRNG folding (topology-dependent randomness)
+# ---------------------------------------------------------------------------
+
+
+class AxisIndexFoldRule(Rule):
+    code = "GL006"
+    title = "axis_index-derived PRNG folding"
+    hint = (
+        "folding the mesh position into a replicated key makes every random "
+        "draw depend on the topology — the same seed diverges between an "
+        "8-way and a 4-way mesh, and re-meshed checkpoint resume forks; "
+        "fold the GLOBAL slot index of each individual instead "
+        "(axis_index * local_n + arange(local_n), see "
+        "parallel/sharded_problem.py)"
+    )
+
+    # Wrappers through which a nested function is invoked with positionally
+    # mapped arguments (``jax.vmap(f)(xs)`` hands ``xs`` to ``f``'s params).
+    _WRAPPERS = frozenset({"vmap", "pmap", "jit", "shard_map", "checkpoint"})
+
+    def check(self, mod: Module) -> list[Finding]:
+        if "axis_index" not in mod.source:
+            return []  # cheap pre-filter: nothing to derive from
+        findings: list[Finding] = []
+        for fn, _cls, enclosing in _iter_functions(mod.tree):
+            if enclosing is not None:
+                continue  # nested defs analyzed inline with their parent
+            findings.extend(self._check_tree(mod, fn))
+        return findings
+
+    def _call_target(self, call: ast.Call) -> str | None:
+        """Name of the function a call ultimately hands its args to: a bare
+        ``f(...)`` or a wrapper application ``jax.vmap(f)(...)``."""
+        if isinstance(call.func, ast.Name):
+            return call.func.id
+        if isinstance(call.func, ast.Call):
+            inner = call.func
+            tail = (_dotted(inner.func) or "").rsplit(".", 1)[-1]
+            if tail in self._WRAPPERS and inner.args and isinstance(
+                inner.args[0], ast.Name
+            ):
+                return inner.args[0].id
+        return None
+
+    def _check_tree(self, mod: Module, fn: ast.AST) -> list[Finding]:
+        # Whole-lexical-tree fixpoint taint (statement order ignored — a
+        # deliberate over-approximation; axis_index use is rare and the
+        # pragma is the escape hatch for sanctioned sites).  Nested defs
+        # share the environment, and calling a nested function — directly
+        # or through jax.vmap — with a tainted argument taints the matching
+        # parameter, so the shard-position value is tracked through the
+        # per-individual vmap idiom.
+        nested: dict[str, ast.AST] = {
+            n.name: n
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        }
+        tainted: set[str] = set()
+
+        def derived(node: ast.AST) -> bool:
+            for n in ast.walk(node):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(n, ast.Call):
+                    tail = (_dotted(n.func) or "").rsplit(".", 1)[-1]
+                    if tail == "axis_index":
+                        return True
+                if isinstance(n, ast.Name) and n.id in tainted:
+                    return True
+            return False
+
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and derived(node.value):
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name) and n.id not in tainted:
+                                tainted.add(n.id)
+                                changed = True
+                elif (
+                    isinstance(node, (ast.AugAssign, ast.AnnAssign))
+                    and node.value is not None
+                    and derived(node.value)
+                    and isinstance(node.target, ast.Name)
+                    and node.target.id not in tainted
+                ):
+                    tainted.add(node.target.id)
+                    changed = True
+                elif isinstance(node, ast.Call):
+                    target = self._call_target(node)
+                    if target in nested:
+                        params = [a.arg for a in nested[target].args.args]
+                        for param, arg in zip(params, node.args):
+                            if derived(arg) and param not in tainted:
+                                tainted.add(param)
+                                changed = True
+
+        findings: list[Finding] = []
+        flagged: set[int] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (_dotted(node.func) or "").rsplit(".", 1)[-1] != "fold_in":
+                continue
+            operands = list(node.args) + [k.value for k in node.keywords]
+            if any(derived(a) for a in operands) and node.lineno not in flagged:
+                flagged.add(node.lineno)
+                findings.append(
+                    self.finding(
+                        mod,
+                        node,
+                        "`fold_in` fed an `axis_index`-derived value — the "
+                        "PRNG stream depends on the mesh topology, so the "
+                        "same seed diverges across mesh sizes and re-meshed "
+                        "resume forks; fold the global slot index instead",
+                    )
+                )
+        return findings
+
+
 RULES: list[Rule] = [
     BareAssertRule(),
     KeyReuseRule(),
@@ -962,5 +1094,6 @@ RULES: list[Rule] = [
     TracedBranchRule(),
     RecompileHazardRule(),
     ImpureStepRule(),
+    AxisIndexFoldRule(),
 ]
 RULES_BY_CODE = {r.code: r for r in RULES}
