@@ -188,3 +188,14 @@ class Monitor(_Component):
         host; ``EvalMonitor`` counts it into its in-state ``num_restarts``
         metric so the count survives checkpoints."""
         return state
+
+    def record_preemption(self, state: State) -> State:
+        """Hook: the run this state belongs to is being preempted — a
+        supervising ``ResilientRunner``'s
+        :class:`~evox_tpu.resilience.PreemptionGuard` tripped (SIGTERM /
+        provider maintenance event) and the state is about to be published
+        as an emergency checkpoint.  Called on the host at the tripping
+        segment boundary; ``EvalMonitor`` counts it into its in-state
+        ``num_preemptions`` metric, so how often a run has been bounced
+        across hosts survives every resume."""
+        return state

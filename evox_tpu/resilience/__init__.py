@@ -27,11 +27,29 @@ This subsystem adds the missing layer:
   around the incumbent best.  All deterministic and bit-reproducible under
   resume; fired restarts are recorded as :class:`RestartEvent` lineage in
   ``RunStats`` and in every checkpoint manifest.
+* :class:`PreemptionGuard` / :class:`Preempted` (``preemption.py``) —
+  signal-aware graceful shutdown: SIGTERM/SIGINT (how schedulers and TPU
+  preemption actually kill jobs) and provider maintenance events become a
+  flag the runner checks at segment boundaries; on trip it barriers any
+  in-flight async write, publishes an emergency checkpoint marked
+  ``preempted``, restores prior handlers, and raises :class:`Preempted` —
+  the next invocation auto-resumes bit-identically.
+* Self-verifying async checkpointing — checkpoints carry per-leaf SHA-256
+  digests (``utils/checkpoint.py``), the runner's resume scan
+  (:func:`scan_checkpoints`) quarantines byte-damaged files as
+  ``*.corrupt`` and falls back to the newest intact one, and writes run on
+  a background :class:`~evox_tpu.utils.AsyncCheckpointWriter` (at most one
+  in flight, durable atomic publish, GC strictly after the successor
+  publishes) so the device loop never blocks on disk.
 * :class:`FaultyProblem` — a deterministic fault-injection wrapper (NaN/Inf
   rows, in-state corruption, stagnation plateaus, host-side exceptions,
-  artificial delays, dead/straggler shard schedules, an eval deadline with
-  penalty fallback — all by evaluation schedule) so every recovery path
-  above is testable on CPU.
+  artificial delays, SIGTERM-to-self, dead/straggler shard schedules, an
+  eval deadline with penalty fallback — all by evaluation schedule) so
+  every recovery path above is testable on CPU.
+* :class:`FaultyStore` — the storage-side chaos twin: torn publishes, bit
+  flips, ``ENOSPC``/``EIO``, crash-between-temp-and-rename, and slow disks
+  by save schedule, so the checkpoint pipeline itself (including mid-write
+  preemption and GC ordering) is testable deterministically.
 * Elastic topology (``elastic.py``) — checkpoint manifests record the mesh
   topology they were written under (:class:`MeshTopology`), and the runner's
   resume **re-meshes**: a run checkpointed on an N-device ``pop`` mesh
@@ -54,8 +72,15 @@ from .elastic import (
     workflow_mesh,
     workflow_topology,
 )
-from .faults import FaultyProblem, InjectedBackendError, InjectedFatalError
+from .faults import (
+    FaultyProblem,
+    FaultyStore,
+    InjectedBackendError,
+    InjectedFatalError,
+    InjectedStorageError,
+)
 from .health import HealthProbe, HealthReport
+from .preemption import Preempted, PreemptionGuard
 from .restart import (
     PerturbAroundBest,
     ReinitLargerPopulation,
@@ -67,6 +92,7 @@ from .restart import (
     perturb_prng_keys,
 )
 from .runner import (
+    CheckpointSkip,
     ResilienceError,
     ResilientRunner,
     RetryPolicy,
@@ -74,6 +100,7 @@ from .runner import (
     WatchdogTimeout,
     default_retryable,
     latest_checkpoint,
+    scan_checkpoints,
 )
 
 __all__ = [
@@ -87,10 +114,14 @@ __all__ = [
     "ResilientRunner",
     "RetryPolicy",
     "RunStats",
+    "CheckpointSkip",
     "ResilienceError",
     "WatchdogTimeout",
     "default_retryable",
     "latest_checkpoint",
+    "scan_checkpoints",
+    "PreemptionGuard",
+    "Preempted",
     "HealthProbe",
     "HealthReport",
     "RestartPolicy",
@@ -102,6 +133,8 @@ __all__ = [
     "incumbent_best",
     "perturb_prng_keys",
     "FaultyProblem",
+    "FaultyStore",
     "InjectedBackendError",
     "InjectedFatalError",
+    "InjectedStorageError",
 ]

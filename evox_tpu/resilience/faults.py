@@ -55,6 +55,11 @@ replays deterministically):
   NOT paper over.
 * **artificial delays** — the host callback sleeps, driving the runner's
   watchdog path (the silent-hang signature).
+* **SIGTERM to self** — scheduled evaluations send the process a real
+  ``SIGTERM`` (``sigterm_generations``), the way a cluster scheduler or TPU
+  preemption actually kills a job.  Only meaningful under an installed
+  :class:`~evox_tpu.resilience.PreemptionGuard` — without one the default
+  handler terminates the test process.
 
 Transient faults are **attempt-counted on the host side**: a fault fires for
 its first ``*_times`` attempts of a given evaluation index and then stops,
@@ -62,10 +67,21 @@ modeling an outage that passes — which is what lets retry/resume tests
 complete.  Counters live on the wrapper instance (host memory), not in the
 jitted state: a retry that reloads the checkpoint rolls the evaluation index
 back but must still see the outage as "over".
+
+:class:`FaultyStore` is the storage-side counterpart: a
+:class:`~evox_tpu.utils.CheckpointStore` that injects torn publishes, bit
+flips, ``ENOSPC``/``EIO``, crash-between-temp-and-rename, and slow disks by
+**save schedule** (0-based count of ``save_state`` calls through the
+store), so the whole checkpoint pipeline — async writer, GC ordering,
+verify/quarantine on resume, mid-write preemption — is testable
+deterministically on any filesystem.
 """
 
 from __future__ import annotations
 
+import errno
+import os
+import signal
 import threading
 import time
 from typing import Mapping, Sequence
@@ -77,8 +93,15 @@ from jax.experimental import io_callback
 from jax.sharding import SingleDeviceSharding
 
 from ..core import Problem, State
+from ..utils.checkpoint import CheckpointStore
 
-__all__ = ["FaultyProblem", "InjectedBackendError", "InjectedFatalError"]
+__all__ = [
+    "FaultyProblem",
+    "FaultyStore",
+    "InjectedBackendError",
+    "InjectedFatalError",
+    "InjectedStorageError",
+]
 
 
 class InjectedBackendError(RuntimeError):
@@ -87,6 +110,10 @@ class InjectedBackendError(RuntimeError):
 
 class InjectedFatalError(RuntimeError):
     """Simulated unrecoverable crash (carries the NONRETRYABLE marker)."""
+
+
+class InjectedStorageError(OSError):
+    """Simulated storage failure (crash between temp write and publish)."""
 
 
 class FaultyProblem(Problem):
@@ -123,6 +150,8 @@ class FaultyProblem(Problem):
         delay_generations: Sequence[int] = (),
         delay_seconds: float = 1.0,
         delay_times: int = 1,
+        sigterm_generations: Sequence[int] = (),
+        sigterm_times: int = 1,
         dead_shards: Mapping[int, Sequence[int]] | None = None,
         straggler_shards: Mapping[int, Sequence[int]] | None = None,
         straggler_delay: float = 1.0,
@@ -162,6 +191,13 @@ class FaultyProblem(Problem):
         :param delay_generations: evaluation indices whose host callback
             sleeps ``delay_seconds`` for the first ``delay_times`` attempts
             each (watchdog fodder).
+        :param sigterm_generations: evaluation indices that send the
+            process a real ``SIGTERM`` (``os.kill`` to self) for the first
+            ``sigterm_times`` attempts each — the scheduler-kill /
+            TPU-preemption signature, for exercising
+            :class:`~evox_tpu.resilience.PreemptionGuard`'s graceful path.
+            **Install a guard first**: without one, the default handler
+            terminates the process on the spot.
         :param dead_shards: ``{shard_index: evaluation indices}`` — every
             fitness row in the scheduled shard's contiguous row block goes
             NaN (inside jit), modeling one mesh device returning garbage
@@ -207,6 +243,10 @@ class FaultyProblem(Problem):
         self.delay_generations = frozenset(int(g) for g in delay_generations)
         self.delay_seconds = float(delay_seconds)
         self.delay_times = int(delay_times)
+        self.sigterm_generations = frozenset(
+            int(g) for g in sigterm_generations
+        )
+        self.sigterm_times = int(sigterm_times)
         self.dead_shards = tuple(
             (int(s), tuple(int(g) for g in gens))
             for s, gens in sorted((dead_shards or {}).items())
@@ -239,6 +279,7 @@ class FaultyProblem(Problem):
             self.error_generations
             or self.fatal_generations
             or self.delay_generations
+            or self.sigterm_generations
             or self.straggler_shards
         )
 
@@ -323,6 +364,13 @@ class FaultyProblem(Problem):
         if g in self.error_generations:
             if self._bump("error", g) <= self.error_times:
                 raise InjectedBackendError(f"{self.error_message} [eval {g}]")
+        if g in self.sigterm_generations:
+            if self._bump("sigterm", g) <= self.sigterm_times:
+                # A real signal to the real process: exactly what a
+                # scheduler's grace-window kill delivers.  The evaluation
+                # itself continues — the PreemptionGuard's flag is checked
+                # at the next segment boundary, not mid-program.
+                os.kill(os.getpid(), signal.SIGTERM)
         if g in self.delay_generations:
             if self._bump("delay", g) <= self.delay_times:
                 time.sleep(self.delay_seconds)
@@ -461,3 +509,126 @@ class FaultyProblem(Problem):
         return fit, state.replace(
             inner=inner, fault_generation=gen + 1, corruption=corruption
         )
+
+
+class FaultyStore(CheckpointStore):
+    """Deterministic storage chaos for the checkpoint pipeline.
+
+    Wraps the :class:`~evox_tpu.utils.CheckpointStore` seam every
+    ``save_state`` call flows through and injects faults by **save index**
+    (0-based count of saves routed through this store instance), the same
+    way :class:`FaultyProblem` schedules eval faults:
+
+    * ``crash_saves`` — raise :class:`InjectedStorageError` *between* the
+      completed temp write and the atomic rename: the classic
+      kill-mid-checkpoint.  The destination is untouched (old checkpoint
+      intact) and the temp file is cleaned up by ``save_state``.
+    * ``torn_saves`` — publish a **truncated** final file (first
+      ``torn_fraction`` of the bytes) *silently*: the signature of a
+      non-atomic writer, or of a disk that acknowledged writes it lost to
+      power failure.  Only ``verify_checkpoint`` / digest checks catch it.
+    * ``flip_saves`` — publish normally, then flip a single bit in the
+      final file (offset ``flip_offset``, default mid-file): bit rot that
+      ``np.load`` reads back without complaint — the case SHA-256 leaf
+      digests exist for.
+    * ``enospc_saves`` / ``eio_saves`` — the archive write raises
+      ``OSError`` with ``ENOSPC`` ("no space left on device") / ``EIO``;
+      the checkpoint GC contract (never delete the predecessor before the
+      successor is durably published) is tested with exactly this.
+    * ``slow_saves`` — the archive write sleeps ``slow_seconds`` first
+      (a congested or throttled disk), for async-writer overlap tests.
+
+    Save indices count *attempts*: a save that faults still consumes its
+    index, so "the next retry succeeds" schedules naturally.  ``saves``
+    and ``unlinks`` expose what happened for test assertions; ``events``
+    records one ``(index, kind)`` tuple per fired fault.
+    """
+
+    def __init__(
+        self,
+        *,
+        crash_saves: Sequence[int] = (),
+        torn_saves: Sequence[int] = (),
+        torn_fraction: float = 0.5,
+        flip_saves: Sequence[int] = (),
+        flip_offset: int | None = None,
+        enospc_saves: Sequence[int] = (),
+        eio_saves: Sequence[int] = (),
+        slow_saves: Sequence[int] = (),
+        slow_seconds: float = 1.0,
+    ):
+        self.crash_saves = frozenset(int(i) for i in crash_saves)
+        self.torn_saves = frozenset(int(i) for i in torn_saves)
+        self.torn_fraction = float(torn_fraction)
+        self.flip_saves = frozenset(int(i) for i in flip_saves)
+        self.flip_offset = None if flip_offset is None else int(flip_offset)
+        self.enospc_saves = frozenset(int(i) for i in enospc_saves)
+        self.eio_saves = frozenset(int(i) for i in eio_saves)
+        self.slow_saves = frozenset(int(i) for i in slow_saves)
+        self.slow_seconds = float(slow_seconds)
+        self._lock = threading.Lock()
+        self.saves = 0  # completed open_temp calls == save attempts
+        self.unlinks: list[str] = []  # every file the caller deleted via us
+        self.renames: list[tuple[str, str]] = []  # quarantine moves via us
+        self.events: list[tuple[int, str]] = []
+        self._current = -1  # save index of the attempt in progress
+
+    def _fire(self, kind: str) -> None:
+        with self._lock:
+            self.events.append((self._current, kind))
+
+    # -- the seam ----------------------------------------------------------
+    def open_temp(self, directory, prefix):
+        with self._lock:
+            self._current = self.saves
+            self.saves += 1
+        return super().open_temp(directory, prefix)
+
+    def write_archive(self, f, arrays):
+        if self._current in self.slow_saves:
+            self._fire("slow")
+            time.sleep(self.slow_seconds)
+        if self._current in self.enospc_saves:
+            self._fire("enospc")
+            raise OSError(
+                errno.ENOSPC, "No space left on device (injected)"
+            )
+        if self._current in self.eio_saves:
+            self._fire("eio")
+            raise OSError(errno.EIO, "Input/output error (injected)")
+        super().write_archive(f, arrays)
+
+    def publish(self, tmp, final):
+        if self._current in self.crash_saves:
+            self._fire("crash")
+            raise InjectedStorageError(
+                f"injected crash between temp write and publish of {final} "
+                f"(save #{self._current})"
+            )
+        if self._current in self.torn_saves:
+            self._fire("torn")
+            # Truncate the temp in place, then publish it: the final file
+            # exists, opens, and is short — a lying-disk torn write.
+            size = os.path.getsize(tmp)
+            with open(tmp, "r+b") as tf:
+                tf.truncate(max(1, int(size * self.torn_fraction)))
+        super().publish(tmp, final)
+        if self._current in self.flip_saves:
+            self._fire("flip")
+            size = os.path.getsize(final)
+            offset = (
+                self.flip_offset if self.flip_offset is not None else size // 2
+            )
+            with open(final, "r+b") as ff:
+                ff.seek(offset)
+                byte = ff.read(1)
+                ff.seek(offset)
+                ff.write(bytes([byte[0] ^ 0x01]))
+
+    def unlink(self, path):
+        self.unlinks.append(str(path))
+        super().unlink(path)
+
+    def rename(self, src, dst):
+        self.renames.append((str(src), str(dst)))
+        super().rename(src, dst)

@@ -265,7 +265,15 @@ class RollbackToCheckpoint(RestartPolicy):
         for i in range(start, -1, -1):
             cand_gen, path = candidates[i]
             try:
-                state = load_state(path, ctx.state, allow_missing=True)
+                # Digest-verify like the runner's own resume scan: a
+                # bit-flipped rollback target must be skipped, not silently
+                # restored into the "known-good" restart state.
+                state = load_state(
+                    path,
+                    ctx.state,
+                    allow_missing=True,
+                    verify=getattr(ctx.runner, "verify_resume", True),
+                )
             except (CheckpointError, ValueError) as e:
                 ctx.runner._event(
                     f"rollback skipping unusable checkpoint {path.name}: {e}",
@@ -345,7 +353,9 @@ class ReinitLargerPopulation(RestartPolicy):
         "generation",
         "instance_id",
         "num_nonfinite",
+        "num_shard_quarantines",
         "num_restarts",
+        "num_preemptions",
     )
 
     def _new_pop_size(self, current: int) -> int:

@@ -21,14 +21,26 @@ checkpoint.
 
 The checkpoint layout under ``checkpoint_dir`` is flat::
 
-    ckpt_00000010.npz     # state after 10 completed generations
-    ckpt_00000020.npz     # manifest records generation, versions
+    ckpt_00000010.npz          # state after 10 completed generations
+    ckpt_00000020.npz          # manifest records generation, versions
+    ckpt_00000030.npz.corrupt  # quarantined: failed digest verification
 
-Resume scans newest-first and loads the first checkpoint that validates
-against the template state (torn/stale files are skipped with a warning —
-the atomic writer in ``utils/checkpoint.py`` makes torn files unlikely, but
-a resume path that trusts disk blindly would turn one bad file into a lost
-run).
+Resume scans newest-first (:func:`scan_checkpoints`): files whose *bytes*
+are damaged (torn write, bit flip — digest verification catches what zip
+CRCs do not) are **quarantined** — renamed ``*.corrupt``, never deleted, so
+post-mortems keep their evidence — and each skip is recorded as a
+structured :class:`CheckpointSkip` in ``RunStats``; the first remaining
+candidate that validates against the template state wins.  One bad file
+cannot lose the run.
+
+Checkpoint writes are **asynchronous by default**: serialization and the
+durable atomic publish happen on a background thread
+(:class:`~evox_tpu.utils.AsyncCheckpointWriter`) with at most one write in
+flight, so the device loop never blocks on disk; stale-checkpoint GC runs
+only after the successor is durably published, so the newest surviving
+checkpoint is always intact.  ``SIGTERM``/``SIGINT`` (scheduler preemption)
+is handled cooperatively via :class:`~evox_tpu.resilience.PreemptionGuard`
+— see ``preemption.py``.
 """
 
 from __future__ import annotations
@@ -46,10 +58,14 @@ import jax
 
 from ..core import State, Workflow
 from ..utils.checkpoint import (
+    AsyncCheckpointWriter,
+    CheckpointCorruptError,
     CheckpointError,
+    CheckpointStore,
     load_state,
     read_manifest,
     save_state,
+    verify_checkpoint,
 )
 from .elastic import (
     check_topology,
@@ -59,16 +75,19 @@ from .elastic import (
     workflow_topology,
 )
 from .health import HealthProbe, HealthReport
+from .preemption import Preempted, PreemptionGuard
 from .restart import RestartContext, RestartEvent, RestartPolicy
 
 __all__ = [
     "ResilientRunner",
     "RetryPolicy",
     "RunStats",
+    "CheckpointSkip",
     "ResilienceError",
     "WatchdogTimeout",
     "default_retryable",
     "latest_checkpoint",
+    "scan_checkpoints",
 ]
 
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.npz$")
@@ -160,6 +179,22 @@ class RetryPolicy:
 
 
 @dataclass
+class CheckpointSkip:
+    """Structured record of one resume candidate the scan rejected.
+
+    ``quarantined=True`` means the file's bytes were damaged (digest /
+    archive verification failed) and it was renamed ``*.corrupt`` —
+    preserved for post-mortems, excluded from every future scan.
+    ``quarantined=False`` means a well-formed checkpoint merely failed
+    validation against this run's template (different config, unusable
+    lineage) and was left in place."""
+
+    path: str
+    reason: str
+    quarantined: bool = False
+
+
+@dataclass
 class RunStats:
     """Observable record of what the supervisor did during :meth:`run`.
 
@@ -167,7 +202,11 @@ class RunStats:
     restored from the checkpoint manifest, so events fired before a kill
     stay visible.  ``last_report`` is the most recent
     :class:`~evox_tpu.resilience.HealthReport` (``None`` when the runner
-    has no health probe)."""
+    has no health probe).  ``checkpoint_block_seconds`` is the wall-clock
+    the *generation loop* spent blocked on checkpointing — submit +
+    barrier time under the async writer, full serialize-and-publish time
+    without it (the number ``tools/bench_checkpoint_overhead.py``
+    compares)."""
 
     resumed_from_generation: int | None = None
     completed_generations: int = 0
@@ -181,6 +220,13 @@ class RunStats:
     unhealthy_probes: int = 0
     restarts: list[RestartEvent] = field(default_factory=list)
     last_report: HealthReport | None = None
+    preempted: bool = False
+    preemption_reason: str | None = None
+    resumed_after_preemption: bool = False
+    checkpoint_skips: list[CheckpointSkip] = field(default_factory=list)
+    checkpoint_write_failures: int = 0
+    checkpoint_block_seconds: float = 0.0
+    chunk_sizes: list[int] = field(default_factory=list)
 
 
 def _numbered_checkpoints(
@@ -196,11 +242,94 @@ def _numbered_checkpoints(
     return sorted(out)
 
 
-def latest_checkpoint(checkpoint_dir: Union[str, Path]) -> Path | None:
+def _quarantine_target(path: Path) -> Path:
+    """First free ``<name>.corrupt[.N]`` destination: quarantine must never
+    overwrite earlier evidence (a directory whose disk is eating
+    checkpoints can corrupt the *re-written* file of the same generation)."""
+    target = path.with_name(path.name + ".corrupt")
+    n = 1
+    while target.exists():
+        target = path.with_name(f"{path.name}.corrupt.{n}")
+        n += 1
+    return target
+
+
+def scan_checkpoints(
+    checkpoint_dir: Union[str, Path],
+    *,
+    verify: bool = False,
+    quarantine: bool = False,
+    store: CheckpointStore | None = None,
+) -> tuple[list[tuple[int, Path]], list[tuple[Path, str, bool]]]:
+    """Enumerate a checkpoint directory into ``(valid, rejected)``.
+
+    ``valid`` is ``[(generation, path)]`` ascending — the candidates a
+    resume should probe newest-first.  ``rejected`` is
+    ``[(path, reason, quarantined)]`` for every numbered file excluded:
+    byte-damaged archives (:class:`~evox_tpu.utils.CheckpointCorruptError`
+    from :func:`~evox_tpu.utils.verify_checkpoint` — torn writes, bit
+    flips) and, with ``verify=True``, archives without a usable manifest.
+
+    With ``quarantine=True``, *corrupt* files are additionally renamed
+    ``<name>.corrupt`` (``.corrupt.N`` when earlier evidence already holds
+    the name) — out of every future scan's way, but never deleted
+    (evidence beats hygiene when a disk is eating checkpoints); the
+    reject's ``quarantined`` flag reports whether the rename actually
+    happened (a failed rename leaves it ``False`` and the file in place).
+    Non-corrupt rejects are never renamed: a well-formed checkpoint that
+    merely fails verification policy may still be valid for someone else.
+
+    ``verify=False`` trusts the directory listing (no file is opened) —
+    the cheap mode :func:`latest_checkpoint` uses by default.
+    ``verify=True`` reads and digests **every** candidate up front — a
+    deliberate trade: the directory (bounded by ``keep_checkpoints``
+    files under the runner) is fully triaged in one pass, so corrupt
+    files are quarantined even when a newer candidate wins.  Template
+    validation (shape/dtype against a live run's state) is *not* this
+    function's job; that happens at ``load_state`` time in
+    :meth:`ResilientRunner.resume`.  Renames route through ``store``
+    (default local), the same :class:`~evox_tpu.utils.CheckpointStore`
+    seam every other checkpoint file operation uses.
+    """
+    store = store if store is not None else CheckpointStore()
+    valid: list[tuple[int, Path]] = []
+    rejected: list[tuple[Path, str, bool]] = []
+    for gen, path in _numbered_checkpoints(checkpoint_dir):
+        if verify:
+            try:
+                verify_checkpoint(path)
+            except CheckpointCorruptError as e:
+                renamed = False
+                if quarantine:
+                    try:
+                        store.rename(path, _quarantine_target(path))
+                        renamed = True
+                    except OSError:  # pragma: no cover - racing cleaners
+                        pass
+                rejected.append((path, str(e), renamed))
+                continue
+            except CheckpointError as e:
+                rejected.append((path, str(e), False))
+                continue
+        valid.append((gen, path))
+    return valid, rejected
+
+
+def latest_checkpoint(
+    checkpoint_dir: Union[str, Path], *, verify: bool = False
+) -> Path | None:
     """Newest checkpoint file (by generation number) in ``checkpoint_dir``,
-    or ``None``.  Validity is NOT checked — resume logic probes that."""
-    numbered = _numbered_checkpoints(checkpoint_dir)
-    return numbered[-1][1] if numbered else None
+    or ``None``.
+
+    By default this is a pure directory-listing lookup: **validity is NOT
+    checked**, so the returned file may still be refused by ``load_state``
+    — resume logic must keep probing (exactly what
+    :meth:`ResilientRunner.resume` does via :func:`scan_checkpoints`).
+    Pass ``verify=True`` to skip past archives that fail digest
+    verification (nothing is renamed; see :func:`scan_checkpoints` for the
+    quarantining variant)."""
+    valid, _ = scan_checkpoints(checkpoint_dir, verify=verify)
+    return valid[-1][1] if valid else None
 
 
 class ResilientRunner:
@@ -249,6 +378,11 @@ class ResilientRunner:
         restart: RestartPolicy | None = None,
         max_restarts: int = 5,
         remesh: bool = True,
+        async_checkpoints: bool = True,
+        checkpoint_wall_interval: float | None = None,
+        preemption: Union[PreemptionGuard, bool, None] = None,
+        store: CheckpointStore | None = None,
+        verify_resume: bool = True,
     ):
         """
         :param workflow: any ``Workflow`` whose ``init_step``/``step`` are
@@ -283,7 +417,11 @@ class ResilientRunner:
         :param on_event: optional callback receiving one human-readable line
             per supervisor event (resume/retry/fallback/checkpoint) —
             defaults to ``warnings.warn`` for failures and silence for
-            routine events.
+            routine events.  With ``async_checkpoints=True`` (the
+            default), checkpoint-publish and write-failure events arrive
+            on the background writer thread, possibly interleaved with
+            main-loop events — a callback that mutates shared state must
+            be thread-safe.
         :param health: optional :class:`~evox_tpu.resilience.HealthProbe`
             run on the state at every chunk boundary (after the segment,
             before the next one) — detects degenerate searches (non-finite
@@ -309,6 +447,51 @@ class ResilientRunner:
             fold the global slot index (``resilience/elastic.py``).
             ``False`` makes a topology change a loud, structured
             :class:`~evox_tpu.utils.CheckpointError` instead.
+        :param async_checkpoints: write checkpoints on a background thread
+            (:class:`~evox_tpu.utils.AsyncCheckpointWriter`, at most one
+            write in flight) so the generation loop never blocks on
+            serialization or disk — segment N+1 computes while segment N's
+            checkpoint publishes.  Write failures (disk full, injected
+            chaos) are reported as warnings and counted in
+            ``stats.checkpoint_write_failures``; the previous checkpoint
+            stays the resume point, and GC runs only after a successful
+            durable publish so the newest surviving checkpoint is always
+            intact.  ``run()`` barriers the writer before returning (and on
+            any exit), so the final state is durably on disk by the time
+            control returns.  ``False`` restores the synchronous write on
+            the loop (``tools/bench_checkpoint_overhead.py`` measures the
+            difference).
+        :param checkpoint_wall_interval: target *seconds* between
+            checkpoints.  When set, the runner measures segment wall-clock
+            and adapts the chunk length (1 up to ``checkpoint_every``,
+            quantized to powers of two so at most log2 distinct segment
+            programs compile) toward this cadence — bounding preemption
+            loss in seconds of work rather than generations, which is the
+            quantity a scheduler's grace window is denominated in.  Note
+            the segment boundaries then depend on measured timing, so the
+            fixed-boundary guarantee behind bit-identical *comparisons*
+            between separately-chunked runs no longer applies (resume of
+            an interrupted run is still exact: it continues from a
+            checkpointed boundary).
+        :param preemption: a
+            :class:`~evox_tpu.resilience.PreemptionGuard` (or ``True`` for
+            a default one) that converts SIGTERM/SIGINT and provider
+            maintenance events into a graceful stop: at the next segment
+            boundary the runner barriers any in-flight checkpoint write,
+            publishes an emergency checkpoint whose manifest records
+            ``preempted``, restores prior signal handlers, and raises
+            :class:`~evox_tpu.resilience.Preempted` — rerunning the same
+            supervisor resumes bit-identically.  The runner installs the
+            guard for the duration of :meth:`run` if the caller has not
+            already installed it.
+        :param store: the :class:`~evox_tpu.utils.CheckpointStore` all
+            checkpoint file operations route through — inject storage
+            chaos with :class:`~evox_tpu.resilience.FaultyStore`.
+        :param verify_resume: digest-verify checkpoints during the resume
+            scan (:func:`scan_checkpoints`): byte-damaged files (torn
+            writes, bit flips) are quarantined as ``*.corrupt`` and
+            reported as structured ``stats.checkpoint_skips`` instead of
+            being silently loaded or crashing the scan.
         """
         if checkpoint_every < 1:
             raise ValueError(
@@ -335,10 +518,41 @@ class ResilientRunner:
         self.cpu_fallback = cpu_fallback
         self.keep_checkpoints = int(keep_checkpoints)
         self.on_event = on_event
+        if (
+            checkpoint_wall_interval is not None
+            and checkpoint_wall_interval <= 0
+        ):
+            raise ValueError(
+                f"checkpoint_wall_interval must be > 0 seconds, got "
+                f"{checkpoint_wall_interval}"
+            )
         self.health = health
         self.restart = restart
         self.max_restarts = int(max_restarts)
         self.remesh = bool(remesh)
+        self.store = store if store is not None else CheckpointStore()
+        self.verify_resume = bool(verify_resume)
+        self.checkpoint_wall_interval = checkpoint_wall_interval
+        # ``preemption=True`` builds a guard the runner OWNS: each run()
+        # resets it, so rerunning the same runner after a Preempted raise
+        # resumes instead of instantly re-tripping on the stale flag.  A
+        # caller-provided guard belongs to the caller (a pre-tripped flag
+        # may be intentional); the caller resets it between runs.
+        self._owns_guard = preemption is True
+        self.preemption: PreemptionGuard | None = (
+            PreemptionGuard() if preemption is True else (preemption or None)
+        )
+        self._writer: AsyncCheckpointWriter | None = (
+            AsyncCheckpointWriter(
+                store=self.store,
+                durable=True,
+                on_error=self._note_write_failure,
+            )
+            if async_checkpoints
+            else None
+        )
+        self._adaptive_chunk = 1
+        self._per_gen_ema: float | None = None
         self.stats = RunStats()
         self._forced_cpu = False
         # Restart policies may swap ``workflow.algorithm`` (population
@@ -407,25 +621,104 @@ class ResilientRunner:
             )
         return extras
 
-    def _write_checkpoint(
-        self, state: State, generation: int, *, probed: bool = False
-    ) -> None:
-        self.checkpoint_dir.mkdir(parents=True, exist_ok=True)
-        save_state(
-            self._ckpt_path(generation),
-            state,
-            generation=generation,
-            metadata=self._manifest_extras(probed),
+    def _note_write_failure(self, path, exc: BaseException) -> None:
+        """A checkpoint write failed (disk full, injected chaos, ...): the
+        run goes on — the previous checkpoint remains the resume point, and
+        because GC only fires after a successful durable publish, that
+        previous checkpoint provably still exists."""
+        name = Path(path).name
+        self.stats.checkpoint_write_failures += 1
+        self.stats.failures.append(
+            f"checkpoint {name}: {type(exc).__name__}: {exc}"
         )
-        self.stats.checkpoints_written += 1
-        self._event(f"checkpoint written at generation {generation}")
-        if self.keep_checkpoints:
-            numbered = _numbered_checkpoints(self.checkpoint_dir)
-            for _, stale in numbered[: -self.keep_checkpoints]:
-                try:
-                    stale.unlink()
-                except OSError:  # pragma: no cover - racing cleaners
-                    pass
+        self._event(
+            f"checkpoint write of {name} failed ({type(exc).__name__}: "
+            f"{exc}); continuing — the previous checkpoint remains the "
+            f"resume point",
+            warn=True,
+        )
+
+    def _gc_stale_checkpoints(self) -> None:
+        """Delete all but the newest ``keep_checkpoints`` files.  Called
+        only *after* a successful durable publish (inline on the sync
+        path, from the writer's post-publish hook on the async path), so
+        the last valid checkpoint can never be deleted ahead of its
+        successor existing on disk."""
+        if not self.keep_checkpoints:
+            return
+        numbered = _numbered_checkpoints(self.checkpoint_dir)
+        for _, stale in numbered[: -self.keep_checkpoints]:
+            try:
+                self.store.unlink(stale)
+            except OSError:  # pragma: no cover - racing cleaners
+                pass
+
+    def _barrier_writer(self) -> None:
+        """Wait out any in-flight async checkpoint write (no-op without a
+        writer / pending work)."""
+        if self._writer is not None:
+            self._writer.barrier()
+
+    def _write_checkpoint(
+        self,
+        state: State,
+        generation: int,
+        *,
+        probed: bool = False,
+        emergency: bool = False,
+        extra_metadata: dict | None = None,
+    ) -> bool:
+        """Publish ``state`` as ``ckpt_<generation>.npz``.
+
+        Async by default: the call submits to the background writer (waiting
+        only for a *previous* in-flight write) and returns; publication,
+        the success event, and GC happen on the writer thread.  Emergency
+        writes (preemption) are synchronous — the process is about to exit,
+        so "submitted" is not good enough.  Returns whether a synchronous
+        write succeeded (always True for async submissions)."""
+        self.checkpoint_dir.mkdir(parents=True, exist_ok=True)
+        path = self._ckpt_path(generation)
+        metadata = self._manifest_extras(probed)
+        if extra_metadata:
+            metadata.update(extra_metadata)
+        t0 = time.perf_counter()
+        try:
+            if self._writer is not None and not emergency:
+
+                def _published(gen: int = generation) -> None:
+                    self.stats.checkpoints_written += 1
+                    self._event(f"checkpoint written at generation {gen}")
+                    self._gc_stale_checkpoints()
+
+                self._writer.submit(
+                    path,
+                    state,
+                    generation=generation,
+                    metadata=metadata,
+                    on_published=_published,
+                )
+                return True
+            try:
+                save_state(
+                    path,
+                    state,
+                    generation=generation,
+                    metadata=metadata,
+                    store=self.store,
+                    durable=True,
+                )
+            except (OSError, RuntimeError, ValueError) as e:
+                self._note_write_failure(path, e)
+                return False
+            self.stats.checkpoints_written += 1
+            self._event(
+                f"checkpoint written at generation {generation}"
+                + (" (emergency)" if emergency else "")
+            )
+            self._gc_stale_checkpoints()
+            return True
+        finally:
+            self.stats.checkpoint_block_seconds += time.perf_counter() - t0
 
     def _pop_size_hint(self) -> int | None:
         """Population size for re-mesh divisibility checks, when the
@@ -442,12 +735,42 @@ class ResilientRunner:
         size = getattr(algo, "pop_size", None)
         return int(size) if isinstance(size, (int,)) else None
 
+    def _skip_candidate(
+        self, path: Path, reason: str, *, quarantined: bool = False
+    ) -> None:
+        """Record one rejected resume candidate: a structured
+        :class:`CheckpointSkip` in ``stats.checkpoint_skips`` plus the
+        human-readable event line."""
+        self.stats.checkpoint_skips.append(
+            CheckpointSkip(
+                path=str(path), reason=reason, quarantined=quarantined
+            )
+        )
+        if quarantined:
+            self._event(
+                f"quarantined unusable checkpoint {path.name} -> "
+                f"{path.name}.corrupt: {reason}",
+                warn=True,
+            )
+        else:
+            self._event(
+                f"skipping unusable checkpoint {path.name}: {reason}",
+                warn=True,
+            )
+
     def resume(self, template: State) -> tuple[State, int] | None:
         """Load the newest checkpoint that validates against ``template``.
 
         Returns ``(state, completed_generations)`` or ``None`` when no
-        usable checkpoint exists.  Invalid/torn/mismatched files are skipped
-        with a warning, newest-first, so one bad file cannot lose the run.
+        usable checkpoint exists.  The scan
+        (:func:`scan_checkpoints(verify=True) <scan_checkpoints>`) first
+        digest-verifies every candidate: byte-damaged files (torn writes,
+        bit flips — what an unverified loader restores silently) are
+        **quarantined** as ``*.corrupt``; candidates that are intact but
+        fail template validation are skipped in place.  Every rejection is
+        recorded as a structured :class:`CheckpointSkip` in
+        ``stats.checkpoint_skips`` — newest-first fallback means one bad
+        file (or several) cannot lose the run.
 
         Checkpoints written after a restart carry the restart lineage and
         the health probe's stagnation window in their manifest; resume
@@ -466,21 +789,28 @@ class ResilientRunner:
         """
         if not self.checkpoint_dir.is_dir():
             return None
+        self._barrier_writer()  # scan must see every submitted write
         self._resumed_probed = False
         current_topo = workflow_topology(self.workflow)
         meshed = workflow_mesh(self.workflow)
-        for gen, path in reversed(_numbered_checkpoints(self.checkpoint_dir)):
+        candidates, rejected = scan_checkpoints(
+            self.checkpoint_dir,
+            verify=self.verify_resume,
+            quarantine=self.verify_resume,
+            store=self.store,
+        )
+        for path, reason, quarantined in rejected:
+            self._skip_candidate(path, reason, quarantined=quarantined)
+        for gen, path in reversed(candidates):
             try:
                 manifest = read_manifest(path)
-                if manifest and manifest.get("generation") not in (None, gen):
+                if manifest.get("generation") not in (None, gen):
                     raise CheckpointError(
                         f"manifest generation {manifest['generation']} does "
                         f"not match filename generation {gen}"
                     )
             except (CheckpointError, ValueError) as e:
-                self._event(
-                    f"skipping unusable checkpoint {path.name}: {e}", warn=True
-                )
+                self._skip_candidate(path, str(e))
                 continue
             # Topology gate OUTSIDE the skip-this-candidate handler: a mesh
             # mismatch with remesh disabled is an operator error that must
@@ -528,10 +858,19 @@ class ResilientRunner:
                 # value for new leaves (with a warning) instead of losing
                 # the whole run to a schema bump.
                 state = load_state(path, candidate_template, allow_missing=True)
+            except CheckpointCorruptError as e:
+                # Byte damage surfacing only at restore time (verify off, or
+                # damage the digest pass cannot see): same quarantine as the
+                # scan would have applied.
+                quarantined = True
+                try:
+                    self.store.rename(path, _quarantine_target(path))
+                except OSError:  # pragma: no cover - racing cleaners
+                    quarantined = False
+                self._skip_candidate(path, str(e), quarantined=quarantined)
+                continue
             except (CheckpointError, ValueError) as e:
-                self._event(
-                    f"skipping unusable checkpoint {path.name}: {e}", warn=True
-                )
+                self._skip_candidate(path, str(e))
                 continue
             if topology_changed and meshed is not None:
                 # Elastic re-mesh: the restored arrays are global, so all
@@ -556,6 +895,13 @@ class ResilientRunner:
                 self.health.restore(manifest.get("health_window", []))
                 self._resumed_probed = bool(
                     manifest.get("health_probed", False)
+                )
+            if manifest.get("preempted"):
+                self.stats.resumed_after_preemption = True
+                self._event(
+                    f"{path.name} is an emergency checkpoint "
+                    f"({manifest.get('preemption_reason', 'preempted')}); "
+                    f"continuing the interrupted run"
                 )
             self._event(f"resumed from {path.name} (generation {gen})")
             return state, gen
@@ -694,10 +1040,11 @@ class ResilientRunner:
         """Best source of truth for a retry: the on-disk checkpoint of the
         segment's input generation (device buffers of ``state`` may belong
         to a dead backend); falls back to the in-memory state."""
+        self._barrier_writer()  # the boundary write may still be in flight
         path = self._ckpt_path(generation)
         if path.exists():
             try:
-                return load_state(path, state)
+                return load_state(path, state, verify=self.verify_resume)
             except (CheckpointError, ValueError) as e:  # pragma: no cover
                 self._event(
                     f"retry reload of {path.name} failed ({e}); "
@@ -788,6 +1135,11 @@ class ResilientRunner:
                 warn=True,
             )
             return state, done
+        # Restart policies read checkpoints from disk (rollback scans the
+        # directory for candidates): flush the boundary's in-flight async
+        # write first, so the policy sees the same directory a synchronous
+        # writer would have produced — and its decisions stay replayable.
+        self._barrier_writer()
         idx = len(self.stats.restarts)
         ctx = RestartContext(
             runner=self,
@@ -842,12 +1194,14 @@ class ResilientRunner:
             self.stats.segments_run += 1
         # Publish the post-restart state and invalidate the stale future:
         # checkpoints beyond it belong to the abandoned trajectory and must
-        # not hijack a later resume.
+        # not hijack a later resume.  Barrier so the publish (and its GC)
+        # lands before we enumerate the directory for the invalidation.
         self._write_checkpoint(new_state, new_done, probed=not needs_init)
+        self._barrier_writer()
         for gen, path in _numbered_checkpoints(self.checkpoint_dir):
             if gen > new_done:
                 try:
-                    path.unlink()
+                    self.store.unlink(path)
                 except OSError:  # pragma: no cover - racing cleaners
                     pass
         self.stats.completed_generations = new_done
@@ -856,6 +1210,77 @@ class ResilientRunner:
             # (the restart budget bounds recursion depth).
             return self._health_boundary(new_state, new_done, n_steps)
         return new_state, new_done
+
+    # -- preemption --------------------------------------------------------
+    def _handle_preemption(self, state: State, done: int, probed: bool):
+        """The guard tripped: flush in-flight writes, publish an emergency
+        checkpoint marked ``preempted`` (with the monitor's
+        ``num_preemptions`` counter bumped *in the saved state*, so the
+        count survives into the resumed run), and raise
+        :class:`~evox_tpu.resilience.Preempted`.  The caller's ``finally``
+        restores the signal handlers."""
+        reason = self.preemption.reason or "preempted"
+        # The boundary's regular checkpoint may still be in flight: barrier
+        # so the emergency write below is strictly the newest publish.
+        self._barrier_writer()
+        monitor = getattr(self.workflow, "monitor", None)
+        if monitor is not None and "monitor" in state:
+            state = state.replace(
+                monitor=monitor.record_preemption(state["monitor"])
+            )
+        ok = self._write_checkpoint(
+            state,
+            done,
+            probed=probed,
+            emergency=True,
+            extra_metadata={"preempted": True, "preemption_reason": reason},
+        )
+        self.stats.preempted = True
+        self.stats.preemption_reason = reason
+        path = self._ckpt_path(done)
+        outcome = (
+            "published"
+            if ok
+            else "FAILED — prior boundary checkpoint remains the resume point"
+        )
+        self._event(
+            f"preempted at generation {done} ({reason}); emergency "
+            f"checkpoint {outcome}",
+            warn=True,
+        )
+        raise Preempted(
+            f"run preempted at generation {done} ({reason}); rerun the same "
+            f"supervisor to resume bit-identically from "
+            f"{path.name if ok else 'the previous checkpoint'}",
+            generation=done,
+            reason=reason,
+            checkpoint=path if ok else None,
+        )
+
+    # -- wall-clock checkpoint cadence ---------------------------------------
+    def _next_chunk(self) -> int:
+        if self.checkpoint_wall_interval is None:
+            return self.checkpoint_every
+        return self._adaptive_chunk
+
+    def _adapt_chunk(self, chunk: int, seconds: float) -> None:
+        """Steer the chunk length toward ``checkpoint_wall_interval``
+        seconds per segment (EMA-smoothed per-generation wall time),
+        quantized to powers of two so at most ``log2(checkpoint_every)``
+        distinct segment programs ever compile."""
+        if self.checkpoint_wall_interval is None:
+            return
+        per_gen = max(seconds / max(chunk, 1), 1e-9)
+        self._per_gen_ema = (
+            per_gen
+            if self._per_gen_ema is None
+            else 0.5 * self._per_gen_ema + 0.5 * per_gen
+        )
+        target = self.checkpoint_wall_interval / self._per_gen_ema
+        quantized = 1
+        while quantized * 2 <= target and quantized * 2 <= self.checkpoint_every:
+            quantized *= 2
+        self._adaptive_chunk = quantized
 
     # -- the supervisor loop -----------------------------------------------
     def run(
@@ -874,10 +1299,16 @@ class ResilientRunner:
             remainder): a resumed run passes the same ``n_steps`` and the
             runner fast-forwards past the completed prefix.
         :param fresh: start from ``state`` instead of resuming; existing
-            checkpoints in the directory are DELETED first so the new run's
-            lineage cannot interleave with (or resume into) a stale one.
+            checkpoints in the directory are DELETED first (quarantined
+            ``*.corrupt`` files included) so the new run's lineage cannot
+            interleave with (or resume into) a stale one.
         :returns: the final state, identical to what an uninterrupted
-            ``workflow.run(state, n_steps)`` would have produced.
+            ``workflow.run(state, n_steps)`` would have produced.  Any
+            async checkpoint write is barriered before control returns —
+            on exit (normal or not), the newest submitted checkpoint is
+            durably on disk.
+        :raises Preempted: the :class:`PreemptionGuard` tripped; the
+            emergency checkpoint is published and rerunning resumes it.
         """
         if n_steps < 1:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
@@ -890,17 +1321,51 @@ class ResilientRunner:
         # both from the checkpoint manifest as needed.
         self._reset_base_algorithm()
         self._resumed_probed = False
+        self._adaptive_chunk = 1
+        self._per_gen_ema = None
         if self.health is not None:
             self.health.reset()
+        installed_guard = False
+        if self.preemption is not None:
+            if self._owns_guard:
+                # A fresh run through a runner-owned guard: the flag from a
+                # previous run's preemption must not re-trip this one.
+                self.preemption.reset()
+            if not self.preemption.installed:
+                self.preemption.install()
+                installed_guard = True
+        try:
+            return self._run_supervised(state, n_steps, fresh)
+        finally:
+            # The newest submitted checkpoint must be durably on disk by
+            # the time control leaves the supervisor — whether the run
+            # finished, failed, or was preempted.  This wait blocks the
+            # caller like any other checkpoint stall, so it counts into
+            # checkpoint_block_seconds (the bench's async number would
+            # otherwise understate by up to one full write per run).
+            t0 = time.perf_counter()
+            self._barrier_writer()
+            self.stats.checkpoint_block_seconds += time.perf_counter() - t0
+            if installed_guard:
+                self.preemption.uninstall()
+
+    def _run_supervised(self, state: State, n_steps: int, fresh: bool) -> State:
         done = 0
         probed = False
         if fresh and self.checkpoint_dir.is_dir():
             # Clear the old lineage: stale higher-generation files would
             # otherwise survive pruning (which keeps the N highest numbers)
-            # and hijack the next resume.
+            # and hijack the next resume.  Quarantined files go too — they
+            # are evidence of the OLD lineage's storage, not this run's.
+            self._barrier_writer()
             for _, path in _numbered_checkpoints(self.checkpoint_dir):
                 try:
-                    path.unlink()
+                    self.store.unlink(path)
+                except OSError:  # pragma: no cover - racing cleaners
+                    pass
+            for path in self.checkpoint_dir.glob("ckpt_*.npz.corrupt*"):
+                try:
+                    self.store.unlink(path)
                 except OSError:  # pragma: no cover - racing cleaners
                     pass
         if not fresh:
@@ -926,6 +1391,19 @@ class ResilientRunner:
             self._write_checkpoint(state, done)
             probed = False
         while True:
+            # Preemption is checked at every boundary, BEFORE more work is
+            # queued: the scheduler's grace window is spent publishing the
+            # emergency checkpoint, not computing a segment that would be
+            # killed midway.  A trip with no work left is ignored — a run
+            # that already computed its final generation returns its state
+            # like any completed run, instead of discarding it behind a
+            # Preempted raise.
+            if (
+                done < n_steps
+                and self.preemption is not None
+                and self.preemption.triggered
+            ):
+                self._handle_preemption(state, done, probed)
             if not probed:
                 # Every boundary is probed exactly once — ordinary
                 # checkpoints are written pre-probe, so a resume re-probes
@@ -935,7 +1413,8 @@ class ResilientRunner:
                 probed = True
             if done >= n_steps:
                 break
-            chunk = min(self.checkpoint_every, n_steps - done)
+            chunk = min(self._next_chunk(), n_steps - done)
+            seg_start = time.perf_counter()
             state = self._attempt(
                 "segment",
                 state,
@@ -943,8 +1422,10 @@ class ResilientRunner:
                 f"segment (generations {done + 1}..{done + chunk})",
                 chunk=chunk,
             )
+            self._adapt_chunk(chunk, time.perf_counter() - seg_start)
             done += chunk
             self.stats.segments_run += 1
+            self.stats.chunk_sizes.append(chunk)
             self.stats.completed_generations = done
             self._write_checkpoint(state, done)
             probed = False

@@ -19,7 +19,16 @@ from .ops import (
     randint,
     switch,
 )
-from .checkpoint import CheckpointError, load_state, read_manifest, save_state
+from .checkpoint import (
+    AsyncCheckpointWriter,
+    CheckpointCorruptError,
+    CheckpointError,
+    CheckpointStore,
+    load_state,
+    read_manifest,
+    save_state,
+    verify_checkpoint,
+)
 from .params_vector import ParamsAndVector
 from .vmap_ops import VmapInfo, host_op, register_vmap_op
 
@@ -43,7 +52,11 @@ __all__ = [
     "save_state",
     "load_state",
     "read_manifest",
+    "verify_checkpoint",
     "CheckpointError",
+    "CheckpointCorruptError",
+    "CheckpointStore",
+    "AsyncCheckpointWriter",
     "register_vmap_op",
     "host_op",
     "VmapInfo",

@@ -14,6 +14,9 @@ directory and ``os.replace``-d into place, so a crash mid-write (the
 BASELINE.md outage scenario: the TPU tunnel dying under a long-running
 sweep) can never leave a torn half-checkpoint where a valid one is
 expected — the file either has the old complete contents or the new ones.
+With ``durable=True`` the archive is additionally ``fsync``-ed (file before
+the rename, directory after), so "published" means "survives power loss" —
+the ordering guarantee the resilience layer's checkpoint GC relies on.
 Every checkpoint carries a ``__manifest__`` entry (JSON: generation number,
 library/jax versions, leaf count, wall-clock) so resume logic can pick the
 newest valid checkpoint without deserializing the whole state; read it with
@@ -25,6 +28,24 @@ stagnation window (``manifest["health_window"]``/``["health_probed"]``), so
 a resumed run replays restart decisions bit-identically; see
 ``resilience/runner.py``.
 
+Checkpoints are **self-verifying**: the manifest records a SHA-256 digest of
+every stored leaf and the archive carries a digest of the manifest itself
+(atomicity makes torn *writes* impossible, but it cannot protect the bytes
+once they are on disk — bit rot, a lying disk after power loss, or a
+truncating copy all produce an archive that ``np.load`` happily opens).
+``zipfile``'s CRC-32 does not close this gap: ``np.load`` reads members as
+streams and never reaches the end-of-stream CRC check, so a bit-flipped
+``.npz`` loads silently.  :func:`verify_checkpoint` (and
+``load_state(verify=True)``, the resilience runner's default) recomputes the
+digests and raises :class:`CheckpointCorruptError` on any mismatch.
+
+Every file-system touch goes through a :class:`CheckpointStore` — the seam
+the resilience layer's ``FaultyStore`` uses to inject torn publishes, bit
+flips, ``ENOSPC``/``EIO``, and slow disks deterministically.
+:class:`AsyncCheckpointWriter` moves serialization and publishing to a
+single background thread (at most one write in flight) so a device loop
+never blocks on disk.
+
 For sharded multi-host state, prefer ``orbax.checkpoint`` with the same
 pytree (it handles per-shard async writes); these helpers cover the
 single-host case and small HPO/monitor states.
@@ -32,20 +53,37 @@ single-host case and small HPO/monitor states.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import tempfile
+import threading
 import time
 import warnings
+import zipfile
+import zlib
 from pathlib import Path
-from typing import Any, Union
+from typing import Any, Callable, Union
 
 import jax
 import numpy as np
 
-__all__ = ["save_state", "load_state", "read_manifest", "CheckpointError"]
+__all__ = [
+    "save_state",
+    "load_state",
+    "read_manifest",
+    "verify_checkpoint",
+    "CheckpointError",
+    "CheckpointCorruptError",
+    "CheckpointStore",
+    "AsyncCheckpointWriter",
+]
 
 MANIFEST_KEY = "__manifest__"
+DIGEST_KEY = "__digest__"
+# Format 2 added per-leaf + manifest SHA-256 digests (``leaf_digests`` /
+# ``__digest__``); format-1 archives still load, but cannot be verified.
+CHECKPOINT_FORMAT = 2
 
 
 class CheckpointError(ValueError):
@@ -54,6 +92,73 @@ class CheckpointError(ValueError):
 
     Subclasses :class:`ValueError` so callers validating user-supplied
     checkpoint paths can catch it generically."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The checkpoint's *bytes* are damaged — truncated/torn archive, digest
+    mismatch from a bit flip, unreadable zip structure — as opposed to a
+    well-formed archive that merely mismatches the caller's template.
+
+    The distinction drives quarantine: resume logic renames files that raise
+    this to ``*.corrupt`` (the file is useless to everyone), while ordinary
+    :class:`CheckpointError` candidates are only skipped (they may be valid
+    for a different configuration)."""
+
+
+class CheckpointStore:
+    """The file-system operations a checkpoint write performs, as an
+    overridable seam.
+
+    ``save_state`` (and therefore :class:`AsyncCheckpointWriter` and the
+    resilience runner) route every touch — temp creation, archive write,
+    fsync, atomic publish, unlink — through one of these, so storage chaos
+    is injectable without monkeypatching:
+    ``evox_tpu.resilience.FaultyStore`` subclasses this to schedule torn
+    publishes, bit flips, ``ENOSPC``/``EIO``, and slow disks the same way
+    ``FaultyProblem`` schedules eval faults."""
+
+    def open_temp(self, directory: Union[str, Path], prefix: str) -> tuple[int, str]:
+        """Create the temp file the archive is staged in; returns
+        ``(fd, path)`` like ``tempfile.mkstemp``."""
+        return tempfile.mkstemp(dir=directory, prefix=prefix)
+
+    def write_archive(self, f: Any, arrays: dict[str, np.ndarray]) -> None:
+        """Serialize ``arrays`` into the open binary file object ``f``."""
+        np.savez(f, **arrays)
+
+    def fsync_file(self, f: Any) -> None:
+        """Flush ``f`` to stable storage (called before the publish when the
+        write is durable)."""
+        f.flush()
+        os.fsync(f.fileno())
+
+    def publish(self, tmp: Union[str, Path], final: Union[str, Path]) -> None:
+        """Atomically move the staged temp file into place."""
+        os.replace(tmp, final)
+
+    def fsync_dir(self, directory: Union[str, Path]) -> None:
+        """Flush the directory entry of a just-published file — without it
+        the rename itself can be lost to a crash even though the data
+        blocks survived."""
+        try:
+            fd = os.open(directory, os.O_RDONLY)
+        except OSError:  # pragma: no cover - exotic fs without dir opens
+            return
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def unlink(self, path: Union[str, Path]) -> None:
+        """Remove a file (temp cleanup, and the runner's checkpoint GC)."""
+        os.unlink(path)
+
+    def rename(self, src: Union[str, Path], dst: Union[str, Path]) -> None:
+        """Move a file aside (the resume scan's ``*.corrupt`` quarantine)."""
+        os.replace(src, dst)
+
+
+_DEFAULT_STORE = CheckpointStore()
 
 
 def _path_str(key_path) -> str:
@@ -68,28 +173,54 @@ def _path_str(key_path) -> str:
     return "/".join(parts)
 
 
+def _entry_digest(arr: np.ndarray) -> str:
+    """SHA-256 over an archive entry's dtype, shape, and raw bytes — the
+    value the manifest's ``leaf_digests`` records and verification
+    recomputes."""
+    arr = np.asarray(arr)
+    h = hashlib.sha256()
+    h.update(arr.dtype.str.encode())
+    h.update(str(arr.shape).encode())
+    h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
 def save_state(
     path: Union[str, Path],
     state: Any,
     *,
     generation: int | None = None,
     metadata: dict[str, Any] | None = None,
+    store: CheckpointStore | None = None,
+    durable: bool = False,
 ) -> Path:
     """Save a (nested) State / pytree of arrays to ``path`` as ``.npz``.
 
     PRNG-key arrays are stored via their raw ``uint32`` key data, so the
     random stream resumes exactly.  The write is atomic (temp file +
     ``os.replace``); a suffix-less ``path`` gains ``.npz``, mirroring
-    ``np.savez``.  Returns the final path written.
+    ``np.savez``.  The manifest records a SHA-256 digest per stored leaf and
+    the archive carries a digest of the manifest itself, so
+    :func:`verify_checkpoint` / ``load_state(verify=True)`` can detect torn
+    or bit-flipped archives later.  Returns the final path written.
 
     :param generation: optional generation number recorded in the manifest
         (used by :class:`~evox_tpu.resilience.ResilientRunner` to pick the
         resume point without loading the state).
     :param metadata: optional extra JSON-serializable manifest entries.
+    :param store: the :class:`CheckpointStore` performing the file
+        operations (fault injection / alternative backends); default local.
+    :param durable: ``fsync`` the archive before the rename and the
+        directory after it, so the publish survives power loss — the
+        resilience runner writes durably because its checkpoint GC deletes
+        predecessors on the strength of the successor's publish.  Off by
+        default: plain ``save_state`` keeps crash-atomicity (old-or-new,
+        never torn) without paying two fsyncs per call.
     """
     path = Path(path)
     if path.suffix != ".npz":
         path = path.with_name(path.name + ".npz")
+    store = store if store is not None else _DEFAULT_STORE
     leaves_with_paths, _ = jax.tree_util.tree_flatten_with_path(state)
     out = {}
     for key_path, leaf in leaves_with_paths:
@@ -102,7 +233,7 @@ def save_state(
         else:
             out[name] = np.asarray(arr)
     manifest = {
-        "format": 1,
+        "format": CHECKPOINT_FORMAT,
         "generation": None if generation is None else int(generation),
         "evox_tpu_version": _library_version(),
         "jax_version": jax.__version__,
@@ -114,23 +245,31 @@ def save_state(
         # logic uses it to gate or re-mesh cross-topology loads
         # (``resilience/elastic.py``) without deserializing the state.
         "topology": _environment_topology(),
+        "leaf_digests": {name: _entry_digest(arr) for name, arr in out.items()},
     }
     if metadata:
         manifest.update(metadata)
-    out[MANIFEST_KEY] = np.array(json.dumps(manifest))
+    manifest_json = json.dumps(manifest)
+    out[MANIFEST_KEY] = np.array(manifest_json)
+    # The manifest guards the leaves; this entry guards the manifest.
+    out[DIGEST_KEY] = np.array(
+        hashlib.sha256(manifest_json.encode()).hexdigest()
+    )
     # Atomic publish: write the full archive to a temp file in the SAME
     # directory (os.replace across filesystems is not atomic), then rename.
-    fd, tmp = tempfile.mkstemp(
-        dir=path.parent or Path("."), prefix=path.name + ".tmp."
-    )
+    fd, tmp = store.open_temp(path.parent or Path("."), path.name + ".tmp.")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(f, **out)
-        os.replace(tmp, path)
+            store.write_archive(f, out)
+            if durable:
+                store.fsync_file(f)
+        store.publish(tmp, path)
+        if durable:
+            store.fsync_dir(path.parent or Path("."))
     except BaseException:
         # Leave no temp litter on failure; the destination is untouched.
         try:
-            os.unlink(tmp)
+            store.unlink(tmp)
         except OSError:
             pass
         raise
@@ -165,23 +304,123 @@ def _resolve(path: Union[str, Path]) -> Path:
     return path
 
 
-def read_manifest(path: Union[str, Path]) -> dict[str, Any] | None:
+def read_manifest(path: Union[str, Path]) -> dict[str, Any]:
     """Read the ``__manifest__`` entry of a checkpoint written by
-    :func:`save_state`.  Returns ``None`` for pre-manifest checkpoints;
-    raises :class:`CheckpointError` if the archive itself is unreadable
-    (truncated / torn file — the signature a non-atomic writer would leave)."""
+    :func:`save_state`.
+
+    Every failure mode surfaces as a :class:`CheckpointError`, so a resume
+    probe loop needs exactly one ``except`` clause: a truncated / torn
+    archive raises :class:`CheckpointCorruptError` (a ``CheckpointError``)
+    — never a raw ``zipfile.BadZipFile`` — and an archive without a
+    manifest raises a plain :class:`CheckpointError` — never a ``KeyError``
+    (and no silent ``None``: a manifest-less ``.npz`` was not written by
+    :func:`save_state` and resume logic must not trust it).  Only a missing
+    *file* keeps raising ``FileNotFoundError``, preserving the natural
+    ``except FileNotFoundError: start_fresh()`` idiom."""
     path = _resolve(path)
     try:
         with np.load(path) as data:
             if MANIFEST_KEY not in data:
-                return None
+                raise CheckpointError(
+                    f"checkpoint {path} has no {MANIFEST_KEY} entry — not "
+                    f"written by save_state (or written by a pre-manifest "
+                    f"version)"
+                )
             return json.loads(str(data[MANIFEST_KEY]))
     except (CheckpointError, FileNotFoundError):
         # A missing file is "no checkpoint", not a corrupt one — keep the
         # natural `except FileNotFoundError: start_fresh()` idiom working.
         raise
     except Exception as e:
-        raise CheckpointError(f"checkpoint {path} is unreadable: {e!r}") from e
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is unreadable: {e!r}"
+        ) from e
+
+
+def _verify_archive(path: Path, data: Any) -> dict[str, Any]:
+    """Digest-check an open npz archive; returns the verified manifest."""
+    if MANIFEST_KEY not in data:
+        raise CheckpointError(
+            f"checkpoint {path} has no {MANIFEST_KEY} entry — not written "
+            f"by save_state; nothing to verify against"
+        )
+    try:
+        manifest_json = str(data[MANIFEST_KEY])
+        manifest = json.loads(manifest_json)
+        digests = manifest.get("leaf_digests")
+        if digests is None:
+            # Format-1 archive: structurally fine, but integrity is not
+            # provable.  Pass with a warning rather than refuse — stranding
+            # every pre-upgrade checkpoint would lose exactly the runs the
+            # digests exist to protect.
+            warnings.warn(
+                f"checkpoint {path} predates per-leaf digests (format "
+                f"{manifest.get('format')}); integrity cannot be verified"
+            )
+            return manifest
+        if DIGEST_KEY not in data:
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: manifest digest entry {DIGEST_KEY} is "
+                f"missing from a format-{manifest.get('format')} archive"
+            )
+        recorded = str(data[DIGEST_KEY])
+        actual = hashlib.sha256(manifest_json.encode()).hexdigest()
+        if recorded != actual:
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: manifest digest mismatch (recorded "
+                f"{recorded[:12]}…, recomputed {actual[:12]}…) — the "
+                f"manifest bytes are damaged"
+            )
+        names = [n for n in data.files if n not in (MANIFEST_KEY, DIGEST_KEY)]
+        if sorted(names) != sorted(digests):
+            missing = sorted(set(digests) - set(names))
+            extra = sorted(set(names) - set(digests))
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: archive entries do not match the "
+                f"manifest (missing {missing!r}, unexpected {extra!r}) — "
+                f"torn or tampered archive"
+            )
+        for name in names:
+            actual = _entry_digest(data[name])
+            if actual != digests[name]:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path}: leaf {name!r} digest mismatch "
+                    f"(recorded {digests[name][:12]}…, recomputed "
+                    f"{actual[:12]}…) — bit rot or torn write"
+                )
+    except CheckpointError:
+        raise
+    except Exception as e:
+        # zip / zlib / format errors while reading a member: byte damage.
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is unreadable: {e!r}"
+        ) from e
+    return manifest
+
+
+def verify_checkpoint(path: Union[str, Path]) -> dict[str, Any]:
+    """Integrity-check a checkpoint without a template: recompute every
+    leaf's SHA-256 against the manifest's ``leaf_digests`` and the
+    manifest's own digest against the archive's ``__digest__`` entry.
+
+    Returns the verified manifest.  Raises
+    :class:`CheckpointCorruptError` on any byte damage (truncation, bit
+    flip, digest mismatch) and plain :class:`CheckpointError` on an archive
+    :func:`save_state` did not write (no manifest).  Format-1 archives
+    (pre-digest) pass structurally with a warning.  Note ``zipfile``'s
+    CRC-32 does NOT cover this: ``np.load`` streams members without
+    reaching the end-of-stream CRC check, so a bit-flipped archive loads
+    silently without this function."""
+    path = _resolve(path)
+    try:
+        with np.load(path) as data:
+            return _verify_archive(path, data)
+    except (CheckpointError, FileNotFoundError):
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is unreadable: {e!r}"
+        ) from e
 
 
 def _match_weak_type(value: "jax.Array", like_leaf: Any) -> "jax.Array":
@@ -210,6 +449,7 @@ def load_state(
     *,
     mesh: Any | None = None,
     remesh: bool = True,
+    verify: bool = False,
 ) -> Any:
     """Load a checkpoint written by :func:`save_state` into the structure of
     ``like`` (a template state with the same shape — e.g. a freshly
@@ -242,6 +482,11 @@ def load_state(
         ``remesh=True`` (the default) the restored state is repartitioned
         for ``mesh`` (``resilience/elastic.py``).
     :param remesh: allow loading across a topology change (see ``mesh``).
+    :param verify: digest-check the whole archive (see
+        :func:`verify_checkpoint`) before restoring any leaf; a torn or
+        bit-flipped archive raises :class:`CheckpointCorruptError` instead
+        of silently restoring damaged values.  The resilience runner loads
+        with ``verify=True`` by default.
     """
     path = _resolve(path)
     try:
@@ -249,8 +494,12 @@ def load_state(
     except FileNotFoundError:
         raise  # absent, not corrupt — see read_manifest
     except Exception as e:
-        raise CheckpointError(f"checkpoint {path} is unreadable: {e!r}") from e
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is unreadable: {e!r}"
+        ) from e
     with data:  # close the archive fd even on a mismatch raise below
+        if verify:
+            _verify_archive(path, data)
         if mesh is not None and MANIFEST_KEY in data:
             from ..resilience.elastic import MeshTopology, check_topology
 
@@ -261,7 +510,17 @@ def load_state(
                 remesh=remesh,
                 context=f"checkpoint {path}",
             )
-        state = _restore_leaves(path, data, like, allow_missing)
+        try:
+            state = _restore_leaves(path, data, like, allow_missing)
+        except CheckpointError:
+            raise
+        except (zipfile.BadZipFile, zlib.error, EOFError, OSError) as e:
+            # Byte damage discovered mid-restore (a member whose zip
+            # structure is broken): classify as corruption, never leak a
+            # raw zipfile error past the CheckpointError contract.
+            raise CheckpointCorruptError(
+                f"checkpoint {path} is unreadable: {e!r}"
+            ) from e
     if mesh is not None:
         from ..resilience.elastic import remesh_state
 
@@ -341,3 +600,185 @@ def _restore_leaves(
                 f"leaves added since the checkpoint was written)"
             )
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+class AsyncCheckpointWriter:
+    """Double-buffered background checkpoint writer: serialization,
+    digesting, and the durable atomic publish all happen on a single
+    daemon thread, so the submitting (device-loop) thread never blocks on
+    disk.
+
+    **At most one write is ever in flight.**  :meth:`submit` first waits
+    for the previous write to complete, then hands the new one off and
+    returns immediately — so the caller overlaps segment N+1's compute
+    with segment N's checkpoint write, and a writer slower than the
+    compute degrades gracefully to the synchronous cadence instead of
+    queueing unbounded host copies of the state.
+
+    Handing the *live* jax state across threads is safe because
+    ``jax.Array`` is immutable; the device→host transfer
+    (``np.asarray``) happens on the writer thread, off the device loop's
+    critical path.
+
+    **Failures never propagate into the caller's control flow**: a write
+    that raises (``ENOSPC``, a torn store, a serialization bug) is
+    recorded, reported through ``on_error``, and retrievable via
+    :meth:`pop_errors`; the caller's loop keeps running and the previous
+    checkpoint remains the resume point.  ``on_published`` (when given)
+    runs on the writer thread strictly *after* the durable publish — the
+    hook the resilience runner uses for checkpoint GC, so a predecessor
+    is only ever deleted once its successor provably survives power
+    loss.
+
+    The worker thread is lazy in both directions: started on the first
+    :meth:`submit`, and **exits after ``idle_timeout`` seconds without
+    work** (restarted transparently by the next submit) — so a process
+    that builds many writers (an HPO sweep constructing one supervisor per
+    trial) does not accumulate parked threads, and a writer whose owner is
+    garbage no longer pins it alive through a thread root.
+
+    :param store: :class:`CheckpointStore` for the file operations.
+    :param durable: fsync file + directory on publish (default True —
+        an *async* writer exists for long runs, where durability is the
+        point).
+    :param on_error: ``callable(path, exception)`` invoked on the writer
+        thread for each failed write.
+    :param idle_timeout: seconds of no work after which the worker thread
+        exits (it restarts on demand).
+    """
+
+    def __init__(
+        self,
+        *,
+        store: CheckpointStore | None = None,
+        durable: bool = True,
+        on_error: Callable[[Path, BaseException], None] | None = None,
+        idle_timeout: float = 5.0,
+    ):
+        self._store = store if store is not None else _DEFAULT_STORE
+        self._durable = bool(durable)
+        self._on_error = on_error
+        self._idle_timeout = float(idle_timeout)
+        self._cv = threading.Condition()
+        self._job: tuple | None = None
+        self._busy = False
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        self._errors: list[tuple[Path, BaseException]] = []
+        self.writes_completed = 0
+
+    # -- worker ------------------------------------------------------------
+    def _ensure_thread(self) -> None:
+        """Start (or restart after an idle exit) the worker.  Callers must
+        invoke this AFTER publishing state the worker must see.  The
+        worker tombstones itself (``self._thread = None``) *under the
+        lock* at the moment it commits to exit — ``is_alive()`` alone
+        lags the exit decision by the thread's teardown, which would let
+        an ensure-after-enqueue conclude a committed-to-exit worker was
+        still serving and strand the job forever."""
+        with self._cv:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="evox-tpu-ckpt-writer", daemon=True
+                )
+                self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                deadline = time.monotonic() + self._idle_timeout
+                while self._job is None and not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        # Idle: release the thread.  Tombstone under the
+                        # lock, atomically with the no-job-pending check:
+                        # any later enqueue sees _thread None and
+                        # restarts (see _ensure_thread).
+                        self._thread = None
+                        return
+                    self._cv.wait(remaining)
+                if self._job is None:
+                    self._thread = None
+                    return  # closed and drained
+                job = self._job
+                self._job = None
+                self._busy = True
+            path, state, generation, metadata, on_published = job
+            try:
+                save_state(
+                    path,
+                    state,
+                    generation=generation,
+                    metadata=metadata,
+                    store=self._store,
+                    durable=self._durable,
+                )
+                self.writes_completed += 1
+                if on_published is not None:
+                    on_published()
+            except BaseException as e:  # noqa: BLE001 - reported, not raised
+                self._errors.append((Path(path), e))
+                if self._on_error is not None:
+                    try:
+                        self._on_error(Path(path), e)
+                    except Exception:  # pragma: no cover - broken reporter
+                        pass
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    # -- caller side -------------------------------------------------------
+    def submit(
+        self,
+        path: Union[str, Path],
+        state: Any,
+        *,
+        generation: int | None = None,
+        metadata: dict[str, Any] | None = None,
+        on_published: Callable[[], None] | None = None,
+    ) -> None:
+        """Enqueue one checkpoint write.  Blocks only while a *previous*
+        write is still in flight (the at-most-one-pending contract), then
+        returns without waiting for this write."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        with self._cv:
+            while self._job is not None or self._busy:
+                self._cv.wait()
+            self._job = (Path(path), state, generation, metadata, on_published)
+            self._cv.notify_all()
+        # AFTER the enqueue: a worker that idled out between our liveness
+        # check and the enqueue would otherwise strand the job.
+        self._ensure_thread()
+
+    def barrier(self, timeout: float | None = None) -> bool:
+        """Wait until no write is pending or in flight.  Returns ``False``
+        on timeout.  After a ``True`` return every submitted checkpoint is
+        either durably published or recorded as a failure."""
+        if not self._closed and self._job is not None:
+            self._ensure_thread()  # belt-and-braces against a stranded job
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._job is not None or self._busy:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
+
+    def pop_errors(self) -> list[tuple[Path, BaseException]]:
+        """Drain and return ``(path, exception)`` records of failed writes
+        (also reported live through ``on_error``)."""
+        out, self._errors = self._errors, []
+        return out
+
+    def close(self, timeout: float | None = None) -> bool:
+        """Barrier, then stop the worker thread.  Idempotent."""
+        ok = self.barrier(timeout)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        return ok

@@ -131,6 +131,11 @@ class EvalMonitor(Monitor):
             # Automatic restarts applied to this run by a supervising
             # ``ResilientRunner`` health/restart policy.
             num_restarts=jnp.int32(0),
+            # Graceful preemptions (SIGTERM / maintenance events) this run
+            # has survived — bumped into the emergency checkpoint's state
+            # by ``PreemptionGuard``-aware supervisors, so the count rides
+            # every resume.
+            num_preemptions=jnp.int32(0),
         )
 
     # -- host side channel --------------------------------------------------
@@ -248,6 +253,18 @@ class EvalMonitor(Monitor):
             # Pre-metric checkpoints / custom setups may lack the counter.
             return state
         return state.replace(num_restarts=state.num_restarts + 1)
+
+    def record_preemption(self, state: State) -> State:
+        """Count a graceful preemption (SIGTERM / maintenance event caught
+        by a supervising ``PreemptionGuard``) into the cumulative
+        ``num_preemptions`` metric.  Runs on the host at the tripping
+        boundary, immediately before the emergency checkpoint is written —
+        so the counter the resumed run restores already includes the
+        preemption that created its checkpoint."""
+        if "num_preemptions" not in state:
+            # Pre-metric checkpoints / custom setups may lack the counter.
+            return state
+        return state.replace(num_preemptions=state.num_preemptions + 1)
 
     def record_auxiliary(self, state: State, aux: dict[str, jax.Array]) -> State:
         if self.full_pop_history:
@@ -368,6 +385,13 @@ class EvalMonitor(Monitor):
         supervising ``ResilientRunner`` restart policy (0 for unsupervised
         runs)."""
         return state.num_restarts
+
+    def get_num_preemptions(self, state: State) -> jax.Array:
+        """Cumulative count of graceful preemptions (SIGTERM / maintenance
+        events) this run has survived under a
+        ``ResilientRunner(preemption=...)`` supervisor (0 for unsupervised
+        or never-preempted runs)."""
+        return state.num_preemptions
 
     def get_topk_fitness(self, state: State) -> jax.Array:
         """Best ``topk`` fitness values so far (original sign restored)."""

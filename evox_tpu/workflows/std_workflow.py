@@ -347,8 +347,9 @@ class StdWorkflow(Workflow):
           ``sigma`` leaf (when present);
         * ``best_fitness`` — monitor top-k best (minimizing frame) when
           available, else ``min(state.algorithm.fit)``;
-        * ``num_nonfinite`` / ``num_restarts`` — the monitor's cumulative
-          quarantine/restart counters (when the monitor tracks them).
+        * ``num_nonfinite`` / ``num_restarts`` / ``num_preemptions`` — the
+          monitor's cumulative quarantine/restart/preemption counters
+          (when the monitor tracks them).
 
         Keys are present only when the underlying state supports them, so
         the dict is stable per workflow configuration."""
@@ -368,7 +369,12 @@ class StdWorkflow(Workflow):
             out["best_fitness"] = raw["best_fitness"]
         mon = state.monitor if "monitor" in state else None
         if mon is not None:
-            for key in ("num_nonfinite", "num_shard_quarantines", "num_restarts"):
+            for key in (
+                "num_nonfinite",
+                "num_shard_quarantines",
+                "num_restarts",
+                "num_preemptions",
+            ):
                 if key in mon:
                     out[key] = mon[key]
         return out

@@ -15,6 +15,18 @@
 #                               population padding, shard-granular
 #                               quarantine, dead/straggler-shard chaos
 #                               schedules, per-shard health verdicts
+#   ./run_tests.sh --preempt    preemption & checkpoint-integrity lane:
+#                               signal-aware graceful shutdown (real
+#                               SIGTERM-to-self, bit-identical resume from
+#                               the emergency checkpoint), self-verifying
+#                               checkpoints (digest verification, *.corrupt
+#                               quarantine, multi-checkpoint fallback),
+#                               FaultyStore storage chaos (torn/bit-flip/
+#                               ENOSPC/crash-mid-write), async-writer
+#                               semantics — then the CPU microbenchmark
+#                               asserting the async writer beats the sync
+#                               one on loop-blocked time (artifact under
+#                               bench_artifacts/)
 #   ./run_tests.sh --health     health/restart lane: run-health diagnostics +
 #                               restart-policy suite, then the CPU
 #                               microbenchmark asserting the between-chunk
@@ -54,6 +66,11 @@ if [ "$1" = "--health" ]; then
   "${CPU_ENV[@]}" python -m pytest tests/test_health_restart.py -q "$@" || exit 1
   exec "${CPU_ENV[@]}" python tools/bench_health_overhead.py
 fi
+if [ "$1" = "--preempt" ]; then
+  shift
+  "${CPU_ENV[@]}" python -m pytest tests/test_preemption.py -q "$@" || exit 1
+  exec "${CPU_ENV[@]}" python tools/bench_checkpoint_overhead.py
+fi
 ARGS=()
 if [ $# -eq 0 ]; then
   ARGS=(tests/ -q -m "not slow")
@@ -62,7 +79,7 @@ elif [ "$1" = "--all" ]; then
   ARGS=(tests/ -q "$@")
 elif [ "$1" = "--faults" ]; then
   shift
-  ARGS=(tests/test_resilience.py tests/test_health_restart.py tests/test_tooling.py -q "$@")
+  ARGS=(tests/test_resilience.py tests/test_health_restart.py tests/test_preemption.py tests/test_tooling.py -q "$@")
 else
   ARGS=("$@")
 fi

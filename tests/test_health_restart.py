@@ -588,28 +588,44 @@ def test_std_workflow_health_metrics(key):
 
 def test_health_probe_overhead_is_small(tmp_path, key):
     """Sanity bound in the fast lane: probing every boundary of a short
-    run must not blow up wall-clock (the real <5% assertion over 200
-    generations lives in tools/bench_health_overhead.py, run via
-    ``./run_tests.sh --health``)."""
+    run must stay cheap.  Measured the PAIRED way — the probe times its
+    own checks from inside the run they belong to — like
+    tools/bench_health_overhead.py: the previous A/B of two
+    separately-timed runs became fsync-noise-dominated once checkpoint
+    publishes turned durable (fsync cost on CI filesystems swings by
+    hundreds of ms between runs, swamping a few-ms probe).  The strict 5%
+    budget over 200 generations remains the --health lane's job."""
     import time
 
-    def run_once(tag, probe):
-        wf = StdWorkflow(
-            PSO(64, LB, UB), FaultyProblem(Sphere()), monitor=EvalMonitor()
-        )
-        runner = ResilientRunner(
-            wf, tmp_path / tag, checkpoint_every=10, health=probe
-        )
-        runner.run(wf.init(key), 40)  # warm compile caches
-        t0 = time.perf_counter()
-        runner.run(wf.init(key), 40, fresh=True)
-        return time.perf_counter() - t0
+    class TimedProbe(HealthProbe):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.seconds = 0.0
 
-    t_plain = run_once("plain", None)
-    t_health = run_once("health", HealthProbe(stagnation_window=5))
-    # Generous fast-lane bound: the probe must stay within 50% here (CI
-    # boxes are noisy); the strict 5% budget is the --health lane's job.
-    assert t_health < t_plain * 1.5 + 0.25
+        def check(self, state, generation=0):
+            t0 = time.perf_counter()
+            try:
+                return super().check(state, generation)
+            finally:
+                self.seconds += time.perf_counter() - t0
+
+    probe = TimedProbe(stagnation_window=5)
+    wf = StdWorkflow(
+        PSO(64, LB, UB), FaultyProblem(Sphere()), monitor=EvalMonitor()
+    )
+    runner = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=10, health=probe
+    )
+    runner.run(wf.init(key), 40)  # warm compile caches
+    probe.seconds = 0.0
+    t0 = time.perf_counter()
+    runner.run(wf.init(key), 40, fresh=True)
+    total = time.perf_counter() - t0
+    assert runner.stats.health_checks >= 4  # init + every chunk boundary
+    # Generous fast-lane bound: warm probes cost milliseconds against a
+    # multi-hundred-ms run; half the wall-clock is far beyond any healthy
+    # reading.
+    assert probe.seconds < total * 0.5 + 0.25
 
 
 # -- incumbent selection under corruption ------------------------------------

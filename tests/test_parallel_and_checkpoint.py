@@ -289,8 +289,10 @@ def test_checkpoint_manifest_round_trip(tmp_path, key):
     written = save_state(tmp_path / "s.npz", state, generation=17)
     man = read_manifest(written)
     assert man["generation"] == 17
-    assert man["format"] == 1
+    assert man["format"] == 2
     assert "evox_tpu_version" in man and "jax_version" in man
+    # Format 2: every stored entry has a SHA-256 digest in the manifest.
+    assert set(man["leaf_digests"]) == {"a"}
 
 
 def test_checkpoint_atomic_write_replaces(tmp_path, key):

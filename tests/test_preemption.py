@@ -1,0 +1,613 @@
+"""Preemption-safe checkpointing: signal-aware graceful shutdown, the
+self-verifying async checkpoint store, and storage fault injection.
+
+The acceptance matrix of ISSUE 5:
+
+* a run killed by SIGTERM mid-segment resumes **bit-identically** (state,
+  PRNG streams) from the emergency checkpoint — asserted with a real
+  ``os.kill``-to-self signal, not a mock;
+* a run whose newest checkpoint is bit-flipped resumes from the previous
+  valid one, with the corrupt file quarantined as ``*.corrupt`` (renamed,
+  never deleted) and each skip reported as a structured event;
+* the async writer never loses the GC ordering: with ``ENOSPC`` injected
+  on the successor write, the previous checkpoint provably survives.
+
+Storage faults are injected deterministically through ``FaultyStore`` —
+the checkpoint pipeline's counterpart to ``FaultyProblem``'s eval faults —
+so every torn-write / bit-rot / crash-mid-write scenario runs on any
+filesystem, on CPU, in milliseconds.
+"""
+
+import os
+import signal
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.algorithms import PSO
+from evox_tpu.core import State
+from evox_tpu.problems.numerical import Sphere
+from evox_tpu.resilience import (
+    FaultyProblem,
+    FaultyStore,
+    Preempted,
+    PreemptionGuard,
+    ResilientRunner,
+    latest_checkpoint,
+    scan_checkpoints,
+)
+from evox_tpu.utils import (
+    AsyncCheckpointWriter,
+    CheckpointCorruptError,
+    CheckpointError,
+    load_state,
+    read_manifest,
+    save_state,
+    verify_checkpoint,
+)
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+DIM = 8
+LB = -10.0 * jnp.ones(DIM)
+UB = 10.0 * jnp.ones(DIM)
+
+
+def _wf(problem, **kwargs):
+    return StdWorkflow(PSO(16, LB, UB), problem, **kwargs)
+
+
+def _flat(state):
+    out = []
+    for leaf in jax.tree_util.tree_leaves(state):
+        if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key
+        ):
+            out.append(np.asarray(jax.random.key_data(leaf)))
+        else:
+            out.append(np.asarray(leaf))
+    return out
+
+
+def _assert_states_identical(a, b):
+    la, lb = _flat(a), _flat(b)
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(x, y, err_msg=f"state leaf {i}")
+
+
+def _flip_bit(path, offset=None):
+    raw = bytearray(path.read_bytes())
+    raw[(len(raw) // 2) if offset is None else offset] ^= 0x01
+    path.write_bytes(bytes(raw))
+
+
+# -- PreemptionGuard unit behavior -------------------------------------------
+
+
+def test_guard_install_restore_and_manual_trip():
+    prev = signal.getsignal(signal.SIGTERM)
+    guard = PreemptionGuard()
+    with guard:
+        assert guard.installed
+        assert signal.getsignal(signal.SIGTERM) == guard._handler
+        assert not guard.triggered
+        guard.trip("maintenance window")
+        assert guard.triggered and guard.reason == "maintenance window"
+    assert not guard.installed
+    assert signal.getsignal(signal.SIGTERM) == prev
+    guard.reset()
+    assert not guard.triggered and guard.reason is None
+
+
+def test_guard_real_sigterm_sets_flag_without_killing():
+    with PreemptionGuard() as guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        # The handler runs at the next bytecode boundary of the main thread.
+        assert guard.triggered
+        assert guard.reason == "signal SIGTERM"
+
+
+def test_guard_provider_hook_trips_and_broken_hook_disables():
+    notices = []
+    guard = PreemptionGuard(provider_hook=lambda: notices.pop() if notices else None)
+    assert not guard.triggered  # first poll: None
+    notices.append("host maintenance imminent")
+    assert guard.triggered
+    assert guard.reason == "host maintenance imminent"
+
+    def broken():
+        raise RuntimeError("metadata server down")
+
+    flaky = PreemptionGuard(provider_hook=broken)
+    with pytest.warns(UserWarning, match="provider_hook raised"):
+        assert not flaky.triggered
+    assert flaky.provider_hook is None  # disabled, polls stay cheap
+    assert not flaky.triggered
+
+
+# -- graceful shutdown through the runner ------------------------------------
+
+
+def test_sigterm_mid_segment_resumes_bit_identical(tmp_path, key):
+    """Acceptance: a real SIGTERM delivered mid-segment stops the run at
+    the next boundary with an emergency checkpoint; rerunning the same
+    supervisor resumes and finishes bit-identical (PRNG streams included)
+    to the never-preempted run."""
+    n_steps = 12
+    schedule = dict(sigterm_generations=[7], sigterm_times=1)
+
+    clean_prob = FaultyProblem(Sphere(), **dict(schedule, sigterm_times=0))
+    clean_wf = _wf(clean_prob)
+    clean = ResilientRunner(clean_wf, tmp_path / "clean", checkpoint_every=3)
+    clean_final = clean.run(clean_wf.init(key), n_steps)
+
+    prob = FaultyProblem(Sphere(), **schedule)
+    wf = _wf(prob)
+    runner = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=3, preemption=True
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with pytest.raises(Preempted) as exc_info:
+            runner.run(wf.init(key), n_steps)
+    # Eval 7 (generation 8) fired inside the 8..10 segment; the flag is
+    # honored at the next boundary.
+    assert exc_info.value.generation == 10
+    assert exc_info.value.reason == "signal SIGTERM"
+    assert runner.stats.preempted
+    assert runner.stats.preemption_reason == "signal SIGTERM"
+    # The guard was installed by run() and restored on the way out.
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+    manifest = read_manifest(exc_info.value.checkpoint)
+    assert manifest["preempted"] is True
+    assert manifest["preemption_reason"] == "signal SIGTERM"
+
+    resumed = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=3, preemption=True
+    )
+    final = resumed.run(wf.init(jax.random.key(999)), n_steps)
+    assert resumed.stats.resumed_from_generation == 10
+    assert resumed.stats.resumed_after_preemption
+    _assert_states_identical(final, clean_final)
+
+
+def test_preemption_with_caller_installed_guard(tmp_path, key):
+    """A guard installed by the caller (context manager) is honored but not
+    uninstalled by the runner — the caller's scope owns the handlers."""
+    wf = _wf(Sphere())
+    with PreemptionGuard() as guard:
+        runner = ResilientRunner(
+            wf, tmp_path / "ck", checkpoint_every=3, preemption=guard
+        )
+        guard.trip("test maintenance")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            with pytest.raises(Preempted) as exc_info:
+                runner.run(wf.init(key), 10)
+        assert guard.installed  # still the caller's
+        # Tripped before any segment: the first boundary (generation 1,
+        # right after init) is the exit point.
+        assert exc_info.value.generation == 1
+    assert not guard.installed
+
+
+def test_same_runner_reruns_after_preempted_instead_of_relooping(
+    tmp_path, key
+):
+    """Regression: a runner-owned guard (preemption=True) is reset at each
+    run(), so the documented 'rerun the same supervisor' recovery works on
+    the SAME runner object — no livelock on the stale flag."""
+    prob = FaultyProblem(Sphere(), sigterm_generations=[4], sigterm_times=1)
+    wf = _wf(prob)
+    runner = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=3, preemption=True
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with pytest.raises(Preempted):
+            runner.run(wf.init(key), 10)
+        final = runner.run(wf.init(key), 10)  # same object, signal passed
+    assert runner.stats.resumed_from_generation == 7
+    assert runner.stats.completed_generations == 10
+    assert not runner.stats.preempted
+    assert np.all(np.isfinite(np.asarray(final.algorithm.fit)))
+
+
+def test_preemption_counted_in_monitor_and_survives_resume(tmp_path, key):
+    """num_preemptions is bumped INTO the emergency checkpoint's state, so
+    the resumed run's monitor already carries it."""
+    mon = EvalMonitor(full_fit_history=False)
+    wf = _wf(Sphere(), monitor=mon)
+    guard = PreemptionGuard()
+    runner = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=3, preemption=guard
+    )
+    state0 = wf.init(key)
+    assert int(mon.get_num_preemptions(wf.init(key).monitor)) == 0
+    guard.trip("scheduler eviction")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with pytest.raises(Preempted):
+            runner.run(state0, 10)
+    resumed = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    final = resumed.run(wf.init(key), 10)
+    assert int(mon.get_num_preemptions(final.monitor)) == 1
+    assert resumed.stats.completed_generations == 10
+
+
+def test_regrow_carries_preemption_counter():
+    """An IPOP regrow rebuilds the monitor state; cumulative survival
+    counters must ride along — a restart must not erase how many
+    preemptions (or shard quarantines) the run has survived."""
+    from evox_tpu.resilience import ReinitLargerPopulation
+
+    carry = ReinitLargerPopulation._CARRY_MONITOR
+    assert "num_preemptions" in carry
+    assert "num_shard_quarantines" in carry
+    assert "num_restarts" in carry
+
+
+def test_record_preemption_tolerates_counterless_state():
+    """Monitor states restored from pre-metric checkpoints lack the
+    counter; the hook must no-op, not raise."""
+    mon = EvalMonitor()
+    state = State(generation=jnp.int32(3))
+    assert mon.record_preemption(state) is state
+
+
+def test_emergency_write_failure_still_raises_preempted(tmp_path, key):
+    """Disk full at the worst moment: the Preempted contract holds (clean
+    stop, prior checkpoint is the resume point, checkpoint=None)."""
+    wf = _wf(Sphere())
+    guard = PreemptionGuard()
+    # Boundary saves are indices 0.. ; with checkpoint_every=3 and a trip
+    # after generation 4's boundary write, the emergency save is index 2.
+    # Synchronous writes: the trip must land deterministically between the
+    # generation-4 publish event and the next boundary check.
+    store = FaultyStore(enospc_saves=[2])
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        preemption=guard,
+        store=store,
+        async_checkpoints=False,
+        on_event=lambda msg: (
+            guard.trip("late notice")
+            if "generation 4" in msg and "written" in msg
+            else None
+        ),
+    )
+    with pytest.raises(Preempted) as exc_info:
+        runner.run(wf.init(key), 10)
+    assert exc_info.value.checkpoint is None
+    assert runner.stats.checkpoint_write_failures == 1
+    # The regular generation-4 boundary checkpoint survived untouched.
+    assert (tmp_path / "ck" / "ckpt_00000004.npz").exists()
+    verify_checkpoint(tmp_path / "ck" / "ckpt_00000004.npz")
+
+
+# -- self-verifying checkpoints ----------------------------------------------
+
+
+def test_verify_checkpoint_round_trip_and_digests(tmp_path, key):
+    state = State(a=jnp.arange(512.0), k=jax.random.key(7))
+    path = save_state(tmp_path / "s.npz", state, generation=3)
+    manifest = verify_checkpoint(path)
+    assert manifest["generation"] == 3
+    assert set(manifest["leaf_digests"]) == {"a", "__key__/k"}
+    restored = load_state(path, state, verify=True)
+    np.testing.assert_array_equal(np.asarray(restored.a), np.asarray(state.a))
+
+
+def test_single_bit_flip_detected_and_refused(tmp_path, key):
+    """Acceptance: one flipped bit anywhere makes verification (and
+    load_state(verify=True)) raise CheckpointCorruptError — never a raw
+    zipfile error, never a silent load of damaged values."""
+    state = State(a=jnp.zeros(4096))  # big leaf: the flip lands in data
+    path = save_state(tmp_path / "s.npz", state)
+    _flip_bit(path)
+    with pytest.raises(CheckpointCorruptError):
+        verify_checkpoint(path)
+    with pytest.raises(CheckpointCorruptError):
+        load_state(path, state, verify=True)
+
+
+def test_read_manifest_raises_checkpoint_error_on_truncated_and_manifestless(
+    tmp_path,
+):
+    """Satellite: the resume probe loop catches ONE exception type.  A
+    truncated archive and a manifest-less .npz both surface as
+    CheckpointError (corrupt subclass for the former), never
+    zipfile.BadZipFile or KeyError."""
+    path = save_state(tmp_path / "t.npz", State(a=jnp.zeros(8)))
+    path.write_bytes(path.read_bytes()[:40])
+    with pytest.raises(CheckpointCorruptError, match="unreadable"):
+        read_manifest(path)
+    with pytest.raises(CheckpointCorruptError, match="unreadable"):
+        verify_checkpoint(path)
+
+    foreign = tmp_path / "foreign.npz"
+    np.savez(foreign, a=np.zeros(3))  # written by np.savez, no manifest
+    with pytest.raises(CheckpointError, match="no __manifest__"):
+        read_manifest(foreign)
+    with pytest.raises(CheckpointError, match="no __manifest__"):
+        verify_checkpoint(foreign)
+    # And only an absent FILE keeps the FileNotFoundError idiom.
+    with pytest.raises(FileNotFoundError):
+        read_manifest(tmp_path / "absent.npz")
+
+
+def test_scan_checkpoints_and_latest_verify(tmp_path, key):
+    """Satellite: scan_checkpoints replaces hand-rolled newest-first
+    probing — (valid, rejected) lists, optional quarantine renames."""
+    for gen in (1, 2, 3):
+        save_state(
+            tmp_path / f"ckpt_{gen:08d}.npz",
+            State(a=jnp.full(256, float(gen))),
+            generation=gen,
+        )
+    _flip_bit(tmp_path / "ckpt_00000003.npz")
+    # Unverified: the listing trusts the directory.
+    valid, rejected = scan_checkpoints(tmp_path)
+    assert [g for g, _ in valid] == [1, 2, 3] and rejected == []
+    assert latest_checkpoint(tmp_path).name == "ckpt_00000003.npz"
+    # Verified, no quarantine: the flipped file is rejected but untouched.
+    valid, rejected = scan_checkpoints(tmp_path, verify=True)
+    assert [g for g, _ in valid] == [1, 2]
+    assert len(rejected) == 1 and rejected[0][0].name == "ckpt_00000003.npz"
+    assert (tmp_path / "ckpt_00000003.npz").exists()
+    assert latest_checkpoint(tmp_path, verify=True).name == "ckpt_00000002.npz"
+    # Quarantine: renamed *.corrupt, preserved, out of future scans.
+    valid, rejected = scan_checkpoints(tmp_path, verify=True, quarantine=True)
+    assert [g for g, _ in valid] == [1, 2] and len(rejected) == 1
+    assert not (tmp_path / "ckpt_00000003.npz").exists()
+    assert (tmp_path / "ckpt_00000003.npz.corrupt").exists()
+    valid, rejected = scan_checkpoints(tmp_path, verify=True)
+    assert [g for g, _ in valid] == [1, 2] and rejected == []
+
+
+def test_resume_falls_back_two_corrupt_checkpoints(tmp_path, key):
+    """Acceptance: the newest TWO checkpoints bit-flipped — resume
+    quarantines both as *.corrupt (structured skip events) and continues
+    from the third, finishing the run."""
+    wf = _wf(Sphere())
+    runner = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=2, keep_checkpoints=0
+    )
+    runner.run(wf.init(key), 7)  # boundaries 1, 3, 5, 7
+    _flip_bit(tmp_path / "ck" / "ckpt_00000007.npz")
+    _flip_bit(tmp_path / "ck" / "ckpt_00000005.npz")
+
+    resumed = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=2, keep_checkpoints=0
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        final = resumed.run(wf.init(jax.random.key(5)), 7)
+    assert resumed.stats.resumed_from_generation == 3
+    assert resumed.stats.completed_generations == 7
+    skips = resumed.stats.checkpoint_skips
+    assert [s.quarantined for s in skips] == [True, True]
+    assert sorted(os.path.basename(s.path) for s in skips) == [
+        "ckpt_00000005.npz",
+        "ckpt_00000007.npz",
+    ]
+    # Quarantined, not deleted: the evidence files remain even after the
+    # resumed run re-wrote fresh (verifying) checkpoints at 5 and 7.
+    assert (tmp_path / "ck" / "ckpt_00000005.npz.corrupt").exists()
+    assert (tmp_path / "ck" / "ckpt_00000007.npz.corrupt").exists()
+    verify_checkpoint(tmp_path / "ck" / "ckpt_00000007.npz")
+    assert np.all(np.isfinite(np.asarray(final.algorithm.fit)))
+
+
+# -- storage fault injection --------------------------------------------------
+
+
+def test_crash_between_temp_write_and_publish(tmp_path, key):
+    """Acceptance: a kill after the temp file is fully written but before
+    os.replace leaves the destination untouched and no temp litter."""
+    state1 = State(a=jnp.ones(64))
+    state2 = State(a=jnp.full(64, 2.0))
+    store = FaultyStore(crash_saves=[1])
+    path = save_state(tmp_path / "s.npz", state1, store=store)
+    with pytest.raises(OSError, match="injected crash"):
+        save_state(tmp_path / "s.npz", state2, store=store)
+    assert store.events == [(1, "crash")]
+    restored = load_state(path, state1, verify=True)  # old contents intact
+    np.testing.assert_array_equal(np.asarray(restored.a), np.ones(64))
+    assert [p.name for p in tmp_path.iterdir()] == ["s.npz"]  # no litter
+
+
+def test_torn_publish_caught_by_verification(tmp_path, key):
+    """A silently-truncated published file (lying disk) is exactly what
+    digest verification exists for."""
+    store = FaultyStore(torn_saves=[0], torn_fraction=0.4)
+    path = save_state(tmp_path / "s.npz", State(a=jnp.zeros(512)), store=store)
+    assert path.exists()  # published — that is the insidious part
+    with pytest.raises(CheckpointCorruptError):
+        verify_checkpoint(path)
+
+
+def test_gc_never_deletes_last_valid_checkpoint_on_enospc(tmp_path, key):
+    """Acceptance: ENOSPC injected on the successor write — the previous
+    checkpoint must survive, because GC runs only after a durable publish.
+    The run itself continues (write failures are events, not aborts)."""
+    store = FaultyStore(enospc_saves=[3])  # the generation-10 boundary save
+    wf = _wf(Sphere())
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        keep_checkpoints=1,
+        store=store,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        runner.run(wf.init(key), 10)
+    assert runner.stats.completed_generations == 10
+    assert runner.stats.checkpoint_write_failures == 1
+    assert store.events == [(3, "enospc")]
+    # keep_checkpoints=1 would normally leave only generation 10; its write
+    # failed, so generation 7 — the last valid checkpoint — must survive.
+    assert sorted(os.listdir(tmp_path / "ck")) == ["ckpt_00000007.npz"]
+    verify_checkpoint(tmp_path / "ck" / "ckpt_00000007.npz")
+    # And it is genuinely resumable.
+    resumed = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    resumed.run(wf.init(key), 10)
+    assert resumed.stats.resumed_from_generation == 7
+
+
+def test_mid_write_sigterm_previous_checkpoint_wins(tmp_path, key):
+    """Composite chaos: the checkpoint write crashes (kill mid-write) AND
+    the guard trips — the emergency path reuses the durable predecessor."""
+    wf = _wf(Sphere())
+    guard = PreemptionGuard()
+    # Save index 2 is the generation-7 boundary write; it "crashes", then
+    # the guard trips, and the emergency save (index 3) succeeds.  Sync
+    # writes make the failure event (and the trip) land before the next
+    # boundary check, deterministically.
+    store = FaultyStore(crash_saves=[2])
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        preemption=guard,
+        store=store,
+        async_checkpoints=False,
+        on_event=lambda msg: (
+            guard.trip("kill during write")
+            if "ckpt_00000007" in msg and "failed" in msg
+            else None
+        ),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with pytest.raises(Preempted) as exc_info:
+            runner.run(wf.init(key), 10)
+    # The emergency write re-published generation 7 successfully.
+    assert exc_info.value.generation == 7
+    assert exc_info.value.checkpoint is not None
+    manifest = verify_checkpoint(tmp_path / "ck" / "ckpt_00000007.npz")
+    assert manifest["preempted"] is True
+    resumed = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=3)
+    resumed.run(wf.init(key), 10)
+    assert resumed.stats.resumed_from_generation == 7
+
+
+# -- async double-buffered writer ---------------------------------------------
+
+
+def test_async_writer_at_most_one_pending_and_barrier(tmp_path):
+    """submit() returns while the write proceeds in the background; a
+    second submit waits out the first (at-most-one in flight); barrier()
+    drains everything."""
+    state = State(a=jnp.zeros(1024))
+    store = FaultyStore(slow_saves=[0], slow_seconds=0.4)
+    writer = AsyncCheckpointWriter(store=store)
+    import time as _time
+
+    t0 = _time.perf_counter()
+    writer.submit(tmp_path / "ckpt_00000001.npz", state, generation=1)
+    submit1 = _time.perf_counter() - t0
+    assert submit1 < 0.3  # did not wait for the 0.4 s slow write
+    t0 = _time.perf_counter()
+    writer.submit(tmp_path / "ckpt_00000002.npz", state, generation=2)
+    submit2 = _time.perf_counter() - t0
+    assert submit2 > 0.1  # blocked on the slow predecessor first
+    assert writer.barrier(10.0)
+    assert writer.writes_completed == 2
+    for gen in (1, 2):
+        verify_checkpoint(tmp_path / f"ckpt_{gen:08d}.npz")
+    writer.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        writer.submit(tmp_path / "x.npz", state)
+
+
+def test_async_writer_reports_errors_instead_of_raising(tmp_path):
+    seen = []
+    writer = AsyncCheckpointWriter(
+        store=FaultyStore(eio_saves=[0]),
+        on_error=lambda path, exc: seen.append((path.name, exc)),
+    )
+    writer.submit(tmp_path / "ckpt_00000001.npz", State(a=jnp.zeros(4)))
+    assert writer.barrier(10.0)
+    assert len(seen) == 1 and "Input/output error" in str(seen[0][1])
+    assert [p.name for (p, _) in writer.pop_errors()] == ["ckpt_00000001.npz"]
+    assert writer.pop_errors() == []  # drained
+    writer.close()
+
+
+def test_runner_async_and_sync_runs_are_bit_identical(tmp_path, key):
+    """The writer must be pure plumbing: same trajectory either way."""
+    wf = _wf(Sphere())
+    fast = ResilientRunner(
+        wf, tmp_path / "async", checkpoint_every=3, async_checkpoints=True
+    )
+    slow = ResilientRunner(
+        wf, tmp_path / "sync", checkpoint_every=3, async_checkpoints=False
+    )
+    _assert_states_identical(
+        fast.run(wf.init(key), 8), slow.run(wf.init(key), 8)
+    )
+    assert fast.stats.checkpoints_written == slow.stats.checkpoints_written
+    # Both directories verify clean.
+    for d in ("async", "sync"):
+        valid, rejected = scan_checkpoints(tmp_path / d, verify=True)
+        assert valid and not rejected
+
+
+def test_final_checkpoint_durable_when_run_returns(tmp_path, key):
+    """run() barriers the async writer on every exit: the moment control
+    returns, the newest checkpoint is on disk and verified."""
+    wf = _wf(Sphere())
+    runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=4)
+    runner.run(wf.init(key), 9)
+    newest = latest_checkpoint(tmp_path / "ck")
+    assert newest.name == "ckpt_00000009.npz"
+    assert read_manifest(newest)["generation"] == 9
+    verify_checkpoint(newest)
+
+
+# -- wall-clock checkpoint cadence --------------------------------------------
+
+
+def test_wall_interval_grows_chunks_toward_cap(tmp_path, key):
+    """A generous wall interval lets the adaptive chunk climb (powers of
+    two) to the checkpoint_every ceiling."""
+    wf = _wf(Sphere())
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=8,
+        checkpoint_wall_interval=3600.0,  # an hour: cap immediately
+    )
+    runner.run(wf.init(key), 20)
+    assert runner.stats.completed_generations == 20
+    sizes = runner.stats.chunk_sizes
+    assert sizes[0] == 1  # first segment measures
+    assert max(sizes) == 8  # climbed to the cap
+    assert all(s in (1, 2, 4, 8) or s == sizes[-1] for s in sizes)
+    # Resumable like any other run.
+    resumed = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=8)
+    out = resumed.resume(wf.init(key))
+    assert out is not None and out[1] == 20
+
+
+def test_wall_interval_zero_budget_keeps_chunks_minimal(tmp_path, key):
+    """A wall interval far below the per-generation cost pins every chunk
+    at 1 generation — lost work bounded as tightly as possible."""
+    wf = _wf(Sphere())
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=8,
+        checkpoint_wall_interval=1e-9,
+    )
+    runner.run(wf.init(key), 5)
+    assert runner.stats.chunk_sizes == [1, 1, 1, 1]  # init + 4 segments
+    with pytest.raises(ValueError, match="checkpoint_wall_interval"):
+        ResilientRunner(wf, tmp_path / "x", checkpoint_wall_interval=0.0)

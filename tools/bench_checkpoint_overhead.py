@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""CPU microbenchmark: sync vs async checkpoint-write overhead.
+
+The async double-buffered writer exists for one reason: the generation
+loop must never block on serialization or disk.  This benchmark pins that
+claim to a number and FAILS (exit 1) if the async writer does not beat the
+synchronous one.
+
+Methodology — paired, like ``bench_health_overhead.py``: the asserted
+number is the runner's own ``stats.checkpoint_block_seconds`` — the
+wall-clock the *generation loop* spent inside ``_write_checkpoint`` —
+measured from inside the very runs being compared (sync: full
+serialize-digest-fsync-publish on the loop; async: submit plus any wait
+for the previous in-flight write).  Loop-blocked time is the quantity the
+async writer is designed to shrink; total wall-clock A/B is recorded for
+context but not asserted (on a single-core CI box the writer thread
+steals CPU from the loop, so end-to-end deltas are noise-dominated).
+
+The state is deliberately sizeable (pop 512 x dim 64 + PSO velocity and
+best buffers, ~0.5 MB serialized), so each sync write costs visible
+milliseconds, and the segment (20 generations) costs more than one write
+— the regime a real long run lives in, and the precondition for double
+buffering to hide the write entirely (when the write outlasts the
+segment, submit degrades gracefully to waiting out the predecessor).
+
+Run via::
+
+    ./run_tests.sh --preempt          # suite + this benchmark
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_checkpoint_overhead.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.algorithms import PSO  # noqa: E402
+from evox_tpu.problems.numerical import Sphere  # noqa: E402
+from evox_tpu.resilience import ResilientRunner  # noqa: E402
+from evox_tpu.workflows import EvalMonitor, StdWorkflow  # noqa: E402
+
+N_STEPS = 200
+CHECKPOINT_EVERY = 20
+POP, DIM = 512, 64
+REPEATS = 3
+# The async writer must reclaim at least this fraction of the sync path's
+# loop-blocked time.  Submits cost microseconds against multi-millisecond
+# writes, so 0.5 is a loose floor far from the observed ratio.
+MIN_WIN = 0.5
+
+LB = -10.0 * jnp.ones(DIM)
+UB = 10.0 * jnp.ones(DIM)
+
+
+def _build(workdir: str, tag: str, use_async: bool) -> tuple:
+    wf = StdWorkflow(
+        PSO(POP, LB, UB), Sphere(), monitor=EvalMonitor(full_fit_history=False)
+    )
+    runner = ResilientRunner(
+        wf,
+        os.path.join(workdir, tag),
+        checkpoint_every=CHECKPOINT_EVERY,
+        async_checkpoints=use_async,
+    )
+    return wf, runner
+
+
+def _measure(wf, runner) -> tuple[list[float], list[float], int]:
+    state0 = wf.init(jax.random.key(0))
+    runner.run(state0, N_STEPS, fresh=True)  # warm: compiles amortized
+    blocked, total = [], []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        runner.run(state0, N_STEPS, fresh=True)
+        total.append(time.perf_counter() - t0)
+        blocked.append(runner.stats.checkpoint_block_seconds)
+    return blocked, total, runner.stats.checkpoints_written
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="evox_tpu_ckpt_bench_") as wd:
+        wf_s, sync_runner = _build(wd, "sync", use_async=False)
+        wf_a, async_runner = _build(wd, "async", use_async=True)
+        # Interleave would be fairer for drift, but blocked-time is a paired
+        # in-run measurement already; run order is sync-then-async.
+        sync_blocked, sync_total, n_ckpts = _measure(wf_s, sync_runner)
+        async_blocked, async_total, n_ckpts_a = _measure(wf_a, async_runner)
+        if n_ckpts != n_ckpts_a:
+            print(
+                f"FAIL: checkpoint counts differ (sync {n_ckpts}, async "
+                f"{n_ckpts_a})",
+                file=sys.stderr,
+            )
+            return 1
+        if sync_runner.stats.checkpoint_write_failures:
+            print("FAIL: sync run had write failures", file=sys.stderr)
+            return 1
+
+    med_sync = statistics.median(sync_blocked)
+    med_async = statistics.median(async_blocked)
+    win = 1.0 - med_async / med_sync if med_sync > 0 else 0.0
+    result = {
+        "bench": "checkpoint_overhead",
+        "backend": jax.default_backend(),
+        "n_steps": N_STEPS,
+        "checkpoint_every": CHECKPOINT_EVERY,
+        "pop_size": POP,
+        "dim": DIM,
+        "repeats": REPEATS,
+        "checkpoints_per_run": n_ckpts,
+        "sync_blocked_seconds": sync_blocked,
+        "async_blocked_seconds": async_blocked,
+        "sync_total_seconds": sync_total,
+        "async_total_seconds": async_total,
+        "median_sync_blocked_s": med_sync,
+        "median_async_blocked_s": med_async,
+        "sync_blocked_per_ckpt_ms": med_sync / n_ckpts * 1e3,
+        "async_blocked_per_ckpt_ms": med_async / n_ckpts * 1e3,
+        "loop_blocked_win_fraction": win,
+        "min_win_fraction": MIN_WIN,
+        "within_budget": win >= MIN_WIN,
+        "ab_total_informational": {
+            "median_sync_total_s": statistics.median(sync_total),
+            "median_async_total_s": statistics.median(async_total),
+        },
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(
+        out_dir, f"checkpoint_overhead.{jax.default_backend()}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"checkpoint overhead: sync blocks the loop "
+        f"{med_sync * 1e3:.1f} ms/run ({med_sync / n_ckpts * 1e3:.2f} "
+        f"ms/checkpoint), async {med_async * 1e3:.1f} ms/run "
+        f"({med_async / n_ckpts * 1e3:.2f} ms/checkpoint) — "
+        f"{win * 100:.1f}% of loop-blocked time reclaimed over {n_ckpts} "
+        f"checkpoints x {N_STEPS} generations (floor {MIN_WIN * 100:.0f}%)"
+    )
+    print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+    if win < MIN_WIN:
+        print(
+            f"FAIL: async writer reclaimed only {win * 100:.1f}% of "
+            f"loop-blocked checkpoint time (floor {MIN_WIN * 100:.0f}%)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
