@@ -131,7 +131,25 @@ class Monitor(_Component):
 
     All hooks are pure ``(state, value) -> state``; the no-op base makes a
     bare ``Monitor()`` a zero-cost default.
+
+    **Fused-segment capture contract.**  Hooks run *inside* the jitted step,
+    and when the step is itself the body of a fused multi-generation
+    ``lax.scan`` (``StdWorkflow.run_segment`` / the resilient runner's
+    fused segments), a per-generation host side channel (``io_callback``)
+    would stall the device loop once per generation — defeating the fusion.
+    While tracing a fused segment the workflow therefore sets ``_capture``
+    to a list; a monitor that streams host-side data must append
+    ``(history_type, slot, data, generation, instance_id)`` tuples to it
+    instead of emitting a callback (``EvalMonitor._sink`` does), and
+    receives the batched payloads back at the segment boundary through its
+    ``ingest_sinks`` hook.  Monitors that keep everything in jitted state
+    (this base, counters-only monitors) need no change: the capture list
+    simply stays empty.
     """
+
+    # None outside fused-segment tracing; a list while a fused segment is
+    # being traced (see the class docstring).
+    _capture: list | None = None
 
     def set_config(self, **config: Any) -> "Monitor":
         """Out-of-band configuration from the workflow (e.g. the

@@ -23,6 +23,17 @@ lowering).
 Dispatch is gated like every Pallas kernel in this library
 (:mod:`evox_tpu.ops.pallas_gate`): algorithms fall back to the XLA path
 unless the attachment has a passing capability verdict.
+
+Scope note: this kernel fuses *within* one generation (one HBM pass for
+the move).  The other fusion axis — many generations in ONE compiled
+program, so the host dispatches once per checkpoint segment instead of
+once per generation — used to exist only as one-off ``fori_loop`` bench
+twins; it is now the general, resilience-preserving
+:meth:`StdWorkflow.run_segment <evox_tpu.workflows.StdWorkflow.run_segment>`
+/ ``ResilientRunner(fused=True)`` path (quarantine, health metrics and
+batched monitor telemetry ride inside the scan).  The two compose: a
+``PallasPSO`` step body is fused across generations by the segment scan
+exactly like the XLA step is.
 """
 
 from __future__ import annotations

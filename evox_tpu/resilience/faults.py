@@ -273,6 +273,13 @@ class FaultyProblem(Problem):
         # enable_distributed auto-wrap puts the ShardedProblem ABOVE us):
         # ordered callbacks must then be avoided (see _callback_kwargs).
         self.in_sharded_program = False
+        # Set (at trace time) by the workflow's fused-segment builder: the
+        # evaluation is the body of a multi-generation lax.scan, where an
+        # ordered callback would serialize the scan against the host — and
+        # is unsupported under the vmapped/early-stop program shapes.
+        # Fault semantics are unaffected (attempt counters key on the
+        # evaluation index in the payload, never on arrival order).
+        self.in_fused_program = False
         self._lock = threading.Lock()
         self._attempts: dict[tuple[str, int], int] = {}
         self._has_host_faults = bool(
@@ -318,8 +325,16 @@ class FaultyProblem(Problem):
         callback traces inside the shard_map body and fires once per shard,
         so attempt counts scale by the shard count — wrap the
         ``ShardedProblem`` yourself (fault outside) for exactly-once
-        semantics."""
-        if self._mesh_in_chain() is not None or self.in_sharded_program:
+        semantics.  Fused multi-generation segments
+        (``StdWorkflow.run_segment``) also force unordered callbacks — the
+        scan body fires once per generation inside one compiled program,
+        and an ordered callback would serialize it against the host (see
+        ``in_fused_program``)."""
+        if (
+            self._mesh_in_chain() is not None
+            or self.in_sharded_program
+            or self.in_fused_program
+        ):
             return {"ordered": False}
         return {
             "ordered": True,

@@ -12,12 +12,17 @@ is built against:
   failing; a bare ``block_until_ready`` would wedge the driver for as long.
 
 :class:`ResilientRunner` trades a sliver of dispatch overhead for
-survivability: generations run as **chunked jitted segments** (each chunk is
-still one compiled ``fori_loop`` program, so per-generation dispatch cost is
-amortized within a chunk), and between chunks the supervisor — plain Python,
-outside XLA — checkpoints atomically, enforces a watchdog deadline, retries
-with exponential backoff, and can fall back to CPU to limp a run to its next
-checkpoint.
+survivability: generations run as **fused jitted segments** — each chunk is
+ONE compiled ``lax.scan`` over generations whose body carries every
+per-generation resilience feature (non-finite quarantine, monitor counters,
+captured-and-batched history sinks, optional unhealthy-state early stop),
+so the host touches the device exactly once per segment — and between
+segments the supervisor — plain Python, outside XLA — flushes the batched
+telemetry, probes health, checkpoints atomically, enforces a watchdog
+deadline, retries with exponential backoff, and can fall back to CPU to
+limp a run to its next checkpoint.  ``fused=False`` keeps the per-
+generation ``fori_loop`` shape (in-loop monitor callbacks) as a debug
+fallback.
 
 The checkpoint layout under ``checkpoint_dir`` is flat::
 
@@ -227,6 +232,11 @@ class RunStats:
     checkpoint_write_failures: int = 0
     checkpoint_block_seconds: float = 0.0
     chunk_sizes: list[int] = field(default_factory=list)
+    # Fused segments whose in-scan early stop froze a poisoned state before
+    # the scheduled boundary (``fused_early_stop``); the skipped
+    # generations were lax.cond no-ops, and the boundary probe saw the
+    # frozen state.
+    early_stops: int = 0
 
 
 def _numbered_checkpoints(
@@ -355,11 +365,19 @@ class ResilientRunner:
     ``unroll`` factors; the supervisor trades that ulp-level equivalence
     for survivability.
 
-    Monitor caveat: retries replay the failed chunk from its checkpoint, so
-    a monitor's *host-side history* may contain repeated generation entries
-    after a recovery (in-state metrics — top-k, ``num_nonfinite`` — are part
-    of the checkpoint and stay consistent).  The history entries carry
-    generation tags for dedup; see ``docs/guide/resilience.md``.
+    Monitor caveat: on the **fused** path (the default) a multi-generation
+    segment's history is captured in-program and flushed only after the
+    segment *succeeds*, so retrying such a segment never duplicates
+    history entries.  The exception is a single-generation segment (a
+    run's ragged tail, or a wall-interval-adapted chunk of 1), which runs
+    as the plain step with its per-generation callback live — a retry
+    after that callback fired replays it, exactly like the per-generation
+    debug path (``fused=False``), where retries replay the failed chunk
+    with all in-loop callbacks live and the host-side history may contain
+    repeated generation entries after a recovery.  In-state metrics —
+    top-k, ``num_nonfinite`` — are part of the checkpoint and stay
+    consistent in every case, and history entries carry generation tags
+    for dedup; see ``docs/guide/resilience.md``.
     """
 
     def __init__(
@@ -383,6 +401,8 @@ class ResilientRunner:
         preemption: Union[PreemptionGuard, bool, None] = None,
         store: CheckpointStore | None = None,
         verify_resume: bool = True,
+        fused: bool = True,
+        fused_early_stop: bool = False,
     ):
         """
         :param workflow: any ``Workflow`` whose ``init_step``/``step`` are
@@ -492,6 +512,38 @@ class ResilientRunner:
             writes, bit flips) are quarantined as ``*.corrupt`` and
             reported as structured ``stats.checkpoint_skips`` instead of
             being silently loaded or crashing the scan.
+        :param fused: compile each checkpoint segment as ONE
+            ``lax.scan`` over generations with the resilience features
+            carried *inside* the program
+            (:meth:`StdWorkflow.run_segment <evox_tpu.workflows.StdWorkflow.run_segment>`):
+            quarantine and monitor counters stay in-step as always, the
+            monitor's host-side history sinks are captured into batched
+            telemetry instead of firing one ``io_callback`` per
+            generation, and per-generation best fitness rides out with
+            the segment — so the segment itself costs the host one
+            ``device_get`` instead of one round-trip per generation (the
+            boundary health probe, when configured, still runs its own
+            standalone scan: one program shared with the debug path and
+            the post-restart/resume probes, keeping every verdict
+            bit-identical across paths).  This is the default hot path; the
+            final state is bit-identical to the per-generation path.
+            ``False`` (or a workflow without ``_segment_program``) falls
+            back to the per-generation ``fori_loop`` debug path, whose
+            in-loop monitor callbacks make each generation individually
+            observable from the host.
+        :param fused_early_stop: with ``fused``, additionally carry the
+            health probe's hard detectors (non-finite state, diversity
+            floor, step-size range, dead/collapsed shards) in-scan and
+            freeze the state the moment a generation turns unhealthy —
+            the remaining generations of the segment become
+            ``lax.cond``-guarded no-ops, so a poisoned state stops
+            evolving mid-segment instead of compounding until the
+            boundary (detection/restart latency is still the segment
+            boundary).  Off by default because the in-scan predicate
+            shifts XLA fusion by ulps: an early-stop run is exactly
+            reproducible against itself, but not bit-identical to a
+            ``fused=False`` (or early-stop-off) run of the same
+            configuration.
         """
         if checkpoint_every < 1:
             raise ValueError(
@@ -551,8 +603,15 @@ class ResilientRunner:
             if async_checkpoints
             else None
         )
+        # Fused segments need the workflow to expose the segment builder
+        # (StdWorkflow does); any other workflow silently keeps the
+        # per-generation fori_loop shape.
+        self.fused = bool(fused) and hasattr(workflow, "_segment_program")
+        self.fused_early_stop = bool(fused_early_stop)
+        self._segment_cfg = None
         self._adaptive_chunk = 1
         self._per_gen_ema: float | None = None
+        self._last_exec_seconds = 0.0
         self.stats = RunStats()
         self._forced_cpu = False
         # Restart policies may swap ``workflow.algorithm`` (population
@@ -579,7 +638,44 @@ class ResilientRunner:
         self._exec_cache: dict = {}
 
     # -- program shapes ----------------------------------------------------
-    def _segment(self, state: State, n: int) -> State:
+    def _fused_cfg(self):
+        """The fused segment's static config: the health probe's detector
+        set (which drives the in-scan early-stop predicate) plus the
+        runner's early-stop choice.  ``metrics=False``: the boundary
+        verdict comes from the probe's OWN standalone scan of the boundary
+        state — the same program for fused and debug segments, and for the
+        post-restart/post-resume probes that have no telemetry to read —
+        so an end-of-segment snapshot inside the fused program would be
+        computed and transferred every segment only to be discarded
+        (in-program metric values could also drift by ulps from the
+        standalone scan's, which would let the two paths' verdicts and
+        persisted stagnation windows diverge at a threshold margin).
+        Standalone ``run_segment`` callers keep ``metrics=True`` as their
+        default.  Cached — the config must compare equal across calls or
+        every segment would retrace."""
+        if self._segment_cfg is None:
+            self._segment_cfg = self.workflow.segment_config(
+                health=self.health,
+                metrics=False,
+                stop_on_unhealthy=self.fused_early_stop,
+            )
+        return self._segment_cfg
+
+    def _segment(self, state: State, n: int):
+        if n == 1:
+            # A single-generation segment (the ragged tail of a run) gains
+            # nothing from fusion — and sharing ONE plain step program
+            # between the fused and debug paths is what keeps them
+            # bit-identical here: a trip-count-1 loop gets unrolled by
+            # XLA, whose fusion then diverges between the scan-with-
+            # telemetry and bare-loop shapes.  Monitor callbacks stay live
+            # for this one generation (no telemetry to flush).
+            return self.workflow.step(state)
+        if self.fused:
+            # One lax.scan per segment: history capture, per-generation
+            # best fitness and (optionally) the unhealthy-state early stop
+            # ride inside the compiled program; returns (state, telemetry).
+            return self.workflow._segment_program(state, n, self._fused_cfg())
         return jax.lax.fori_loop(
             0, n, lambda _, s: self.workflow.step(s), state
         )
@@ -1030,11 +1126,21 @@ class ResilientRunner:
         with ctx:
             exe = self._get_executable(which, state, chunk)
             run = lambda: jax.block_until_ready(exe(state))  # noqa: E731
-            if self.watchdog_timeout is None:
-                return run()
-            return self._with_deadline(
-                run, self.watchdog_timeout, "segment execution"
-            )
+            # Execution-only timing for the wall-interval chunk adapter:
+            # _get_executable above may have paid a cold AOT compile, and
+            # folding compile seconds into the per-generation EMA would
+            # make the adapter shrink the chunk, compile the NEW length,
+            # measure that compile too, and spiral every segment into a
+            # fresh compile.
+            t0 = time.perf_counter()
+            try:
+                if self.watchdog_timeout is None:
+                    return run()
+                return self._with_deadline(
+                    run, self.watchdog_timeout, "segment execution"
+                )
+            finally:
+                self._last_exec_seconds = time.perf_counter() - t0
 
     def _reload_for_retry(self, state: State, generation: int) -> State:
         """Best source of truth for a retry: the on-disk checkpoint of the
@@ -1267,7 +1373,19 @@ class ResilientRunner:
         """Steer the chunk length toward ``checkpoint_wall_interval``
         seconds per segment (EMA-smoothed per-generation wall time),
         quantized to powers of two so at most ``log2(checkpoint_every)``
-        distinct segment programs ever compile."""
+        distinct segment programs ever compile.
+
+        The quantizer picks the NEXT segment's scan length — a fused
+        segment is one compiled ``lax.scan`` and cannot be shortened
+        mid-flight, so the decision always lands at the boundary before
+        the next scan is dispatched (``_next_chunk``), never by
+        retroactively splitting the segment already running.  ``seconds``
+        must be execution-only wall time (``_execute_once`` measures it
+        past the AOT compile): with compile time folded in, every length
+        change would measure its own cold compile as "slow generations",
+        shrink the chunk again, compile the new length, and spiral every
+        segment into a fresh compile — the lost-work-bound regression
+        ``tests/test_fused_segment.py`` pins."""
         if self.checkpoint_wall_interval is None:
             return
         per_gen = max(seconds / max(chunk, 1), 1e-9)
@@ -1414,19 +1532,51 @@ class ResilientRunner:
             if done >= n_steps:
                 break
             chunk = min(self._next_chunk(), n_steps - done)
-            seg_start = time.perf_counter()
-            state = self._attempt(
+            result = self._attempt(
                 "segment",
                 state,
                 done,
                 f"segment (generations {done + 1}..{done + chunk})",
                 chunk=chunk,
             )
-            self._adapt_chunk(chunk, time.perf_counter() - seg_start)
-            done += chunk
+            if self.fused and chunk > 1:
+                state, stepped = self._consume_telemetry(result, done, chunk)
+            else:
+                # Debug path, or the shared single-step ragged tail (see
+                # _segment): the result is the bare state.
+                state, stepped = result, chunk
+            # Adapt on the EXECUTION seconds of this segment (compile time
+            # excluded — see _execute_once), normalized by the generations
+            # that actually ran.
+            self._adapt_chunk(stepped, self._last_exec_seconds)
+            done += stepped
             self.stats.segments_run += 1
-            self.stats.chunk_sizes.append(chunk)
+            self.stats.chunk_sizes.append(stepped)
             self.stats.completed_generations = done
             self._write_checkpoint(state, done)
             probed = False
         return state
+
+    def _consume_telemetry(
+        self, result, done: int, chunk: int
+    ) -> tuple[State, int]:
+        """Boundary-side handling of a fused segment's ``(state,
+        telemetry)`` result: one ``device_get`` for the whole batch, the
+        monitor-history flush (the batched stand-in for the per-generation
+        callbacks — flushed only for *successful* segments, so retries
+        never duplicate history entries), and the early-stop accounting.
+        Returns ``(state, generations_actually_executed)``."""
+        state, telemetry = result
+        host = jax.device_get(telemetry)
+        self.workflow.flush_telemetry(host)
+        executed = int(host["executed"])
+        if bool(host["stopped"]) and executed < chunk:
+            self.stats.early_stops += 1
+            self._event(
+                f"fused segment stopped early at generation "
+                f"{done + executed}: unhealthy state detected in-scan; the "
+                f"remaining {chunk - executed} generation(s) of the "
+                f"segment were frozen no-ops",
+                warn=True,
+            )
+        return state, executed

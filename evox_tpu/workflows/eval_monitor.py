@@ -100,6 +100,16 @@ class EvalMonitor(Monitor):
         __monitor_history__[self._id_] = {t: [] for t in HistoryType}
         weakref.finalize(self, __monitor_history__.pop, self._id_, None)
 
+    # Fused-segment capture redirection (see ``Monitor._capture`` in
+    # ``core/components.py``): while a workflow traces a fused multi-
+    # generation segment, ``_capture`` is a list and ``_sink`` appends the
+    # traced payload instead of emitting an ``io_callback`` — a host
+    # round-trip per generation inside a ``lax.scan`` would stall the
+    # device loop, which is exactly what fusing exists to avoid.  The
+    # batched payloads come back as segment telemetry and are ingested at
+    # the boundary by :meth:`ingest_sinks`.
+    _capture: list | None = None
+
     # -- config ------------------------------------------------------------
     def set_config(self, **config: Any) -> "EvalMonitor":
         for k in ("multi_obj", "full_fit_history", "full_sol_history", "topk", "opt_direction", "ordered", "num_instances"):
@@ -143,6 +153,16 @@ class EvalMonitor(Monitor):
         """Stream ``data`` to host history, tagged ``(generation, instance,
         slot)`` so accessors can re-sort: unordered callbacks carry no
         delivery-order guarantee (see module docstring)."""
+        if self._capture is not None:
+            # Fused segment trace: hand the traced payload (plus its static
+            # site identity) to the workflow instead of crossing to the
+            # host — the scan batches it per generation and the boundary
+            # flush (``ingest_sinks``) appends it with identical tags and
+            # ordering to what the callback path would have produced.
+            self._capture.append(
+                (int(data_type), slot, data, state.generation, state.instance_id)
+            )
+            return
 
         def append(x, gen, inst):
             __monitor_history__[self._id_][int(data_type)].append(
@@ -277,6 +297,53 @@ class EvalMonitor(Monitor):
             for slot, k in enumerate(self.aux_keys):
                 self._sink(aux[k], HistoryType.AUXILIARY, state, slot=slot)
         return state
+
+    def ingest_sinks(self, meta, sinks, executed) -> None:
+        """Boundary flush of a fused segment's captured sink batches into
+        the host-side history (the batched counterpart of the per-
+        generation ``io_callback`` path — one call per *segment* instead of
+        one host round-trip per generation).
+
+        :param meta: ``[(history_type, slot), ...]`` — one static site
+            descriptor per sink call the traced step performs, in program
+            order (recorded at trace time by the workflow).
+        :param sinks: ``[(data, generations, instances), ...]`` matching
+            ``meta``; each array carries a leading ``(n_generations,)``
+            axis — or ``(n_instances, n_generations, ...)`` for a vmapped
+            segment.
+        :param executed: how many of the batched generations actually ran
+            (a fused segment may stop early on an unhealthy state); scalar,
+            or ``(n_instances,)`` for vmapped segments.  Rows past it are
+            padding and are dropped.
+
+        Entries are appended per generation in site program order, so the
+        resulting history is element-for-element what the ``ordered=True``
+        callback path records; tags are taken from the batched payload, so
+        the unordered accessors' re-sort semantics hold too.  Call once per
+        successfully executed segment (the supervising runner does) —
+        re-ingesting the same telemetry duplicates entries exactly like a
+        replayed callback would."""
+        hist = __monitor_history__[self._id_]
+        executed = np.asarray(executed)
+        if executed.ndim == 0:
+            for g in range(int(executed)):
+                for (data_type, slot), (data, gens, insts) in zip(meta, sinks):
+                    hist[int(data_type)].append(
+                        (int(gens[g]), int(insts[g]), slot, np.asarray(data[g]))
+                    )
+            return
+        # Vmapped segment: a leading instance axis on every batch.
+        for b in range(executed.shape[0]):
+            for g in range(int(executed[b])):
+                for (data_type, slot), (data, gens, insts) in zip(meta, sinks):
+                    hist[int(data_type)].append(
+                        (
+                            int(gens[b, g]),
+                            int(insts[b, g]),
+                            slot,
+                            np.asarray(data[b, g]),
+                        )
+                    )
 
     # -- history accessors (host side) --------------------------------------
     def _grouped(self, entries: list) -> list:

@@ -25,15 +25,47 @@ TPU-native counterpart of the reference ``StdWorkflow``
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh
 
 from ..core import Algorithm, Monitor, Problem, State, Workflow
 
-__all__ = ["StdWorkflow"]
+__all__ = ["StdWorkflow", "SegmentConfig"]
+
+
+class SegmentConfig(NamedTuple):
+    """Static configuration of one fused multi-generation segment
+    (hashable, so it can ride as a static jit argument — one compiled
+    program per distinct config, exactly like a distinct chunk length).
+
+    ``check_nonfinite`` / ``nonfinite_skip`` / ``diversity`` /
+    ``step_size`` / ``shards`` select which health metrics the compiled
+    program computes on the segment's final state (mirroring
+    :func:`evox_tpu.resilience.health.scan_state`, so a supervising
+    probe's boundary verdict sees exactly the values it would have
+    scanned itself).  ``diversity_floor`` / ``step_size_range`` are the
+    in-scan early-stop thresholds; with ``stop_on_unhealthy`` set, the
+    generation that first produces an unhealthy state (non-finite leaves,
+    diversity under the floor, step size out of range, dead/collapsed
+    shards) is the segment's last — every remaining generation of the
+    scan is a ``lax.cond``-guarded no-op, so a poisoned state stops
+    evolving mid-segment instead of compounding for the rest of the
+    chunk.  Build one with :meth:`StdWorkflow.segment_config`."""
+
+    capture_history: bool = True
+    metrics: bool = True
+    check_nonfinite: bool = True
+    nonfinite_skip: tuple = ()
+    diversity: bool = False
+    step_size: bool = False
+    shards: int | None = None
+    diversity_floor: float | None = None
+    step_size_range: tuple | None = None
+    stop_on_unhealthy: bool = False
 
 
 class StdWorkflow(Workflow):
@@ -175,6 +207,13 @@ class StdWorkflow(Workflow):
                 f"got {quarantine_granularity!r}"
             )
         self.quarantine_granularity = quarantine_granularity
+        # Fused-segment machinery: one cached jit wrapper, compiled per
+        # (state structure, n_steps, SegmentConfig).  The static sink-site
+        # identities ride INSIDE each compiled program's telemetry (as the
+        # constant ``sink_meta`` array), so a cached executable always
+        # carries the metadata of its own trace — host-side bookkeeping
+        # would go stale the moment two distinct configs share the cache.
+        self._segment_jit: Callable | None = None
         # Shard count for shard-granular quarantine: from the sharded
         # problem the evaluation actually runs through (covers the
         # enable_distributed path, a user-wrapped ShardedProblem, and any
@@ -450,4 +489,374 @@ class StdWorkflow(Workflow):
             n_steps -= 1
         return jax.lax.fori_loop(
             0, n_steps, lambda _, s: self.step(s), state, unroll=unroll
+        )
+
+    # -- fused resilient segments -------------------------------------------
+    def segment_config(
+        self,
+        *,
+        capture_history: bool = True,
+        metrics: bool = True,
+        stop_on_unhealthy: bool = False,
+        health: Any | None = None,
+    ) -> SegmentConfig:
+        """Build the :class:`SegmentConfig` for :meth:`run_segment`.
+
+        :param capture_history: batch the monitor's host-side history sinks
+            out of the compiled segment as telemetry (flushed at the
+            boundary by :meth:`flush_telemetry`) instead of letting them
+            fire as per-generation ``io_callback``\\ s inside the scan.
+            ``False`` restores the per-generation callbacks — a debug mode
+            that reintroduces one host round-trip per generation.
+        :param metrics: compute the health-metric snapshot
+            (:func:`~evox_tpu.resilience.health.scan_state`) of the
+            segment's final state inside the compiled program and carry it
+            out in the telemetry.
+        :param stop_on_unhealthy: freeze the segment when a generation
+            produces an unhealthy state (see :class:`SegmentConfig`).
+        :param health: an object with
+            :class:`~evox_tpu.resilience.HealthProbe`'s detector-config
+            attributes; when given, the segment computes exactly the
+            metrics that probe thresholds (and the early-stop predicate
+            uses the probe's floors), so the boundary verdict matches a
+            host-side probe of the same state.  Without it, the metric set
+            mirrors :meth:`health_metrics` and early stopping watches
+            non-finite state only.
+        """
+        if health is not None:
+            step_range = getattr(health, "step_size_range", None)
+            return SegmentConfig(
+                capture_history=bool(capture_history),
+                metrics=bool(metrics),
+                check_nonfinite=bool(getattr(health, "check_nonfinite", True)),
+                nonfinite_skip=tuple(getattr(health, "nonfinite_skip", ())),
+                diversity=getattr(health, "diversity_floor", None) is not None,
+                step_size=step_range is not None,
+                shards=getattr(health, "shards", None),
+                diversity_floor=getattr(health, "diversity_floor", None),
+                step_size_range=None if step_range is None else tuple(step_range),
+                stop_on_unhealthy=bool(stop_on_unhealthy),
+            )
+        return SegmentConfig(
+            capture_history=bool(capture_history),
+            metrics=bool(metrics),
+            check_nonfinite=True,
+            diversity=True,
+            step_size=True,
+            shards=self._n_shards,
+            stop_on_unhealthy=bool(stop_on_unhealthy),
+        )
+
+    def _traced_capture_step(
+        self, state: State, meta_out: list, capture: bool
+    ) -> tuple[State, tuple]:
+        """One generation with the monitor's host sinks redirected into a
+        trace-time capture list (see ``Monitor._capture``).  Returns the new
+        state plus the captured traced payloads — one ``(data, generation,
+        instance)`` triple per sink site, in program order — and records the
+        static site identities ``(history_type, slot)`` in ``meta_out``."""
+        mon = self.monitor
+        cap: list | None = [] if capture else None
+        prev = mon._capture
+        if cap is not None:
+            mon._capture = cap
+        try:
+            new_state = self._step(state, "step")
+        finally:
+            if cap is not None:
+                mon._capture = prev
+        entries = cap or []
+        meta_out[:] = [(t, slot) for (t, slot, _, _, _) in entries]
+        ys = tuple((data, gen, inst) for (_, _, data, gen, inst) in entries)
+        return new_state, ys
+
+    def _segment_program(
+        self, state: State, n_steps: int, cfg: SegmentConfig
+    ) -> tuple[State, State]:
+        """The fused checkpoint segment: ``n_steps`` generations as ONE
+        ``lax.scan`` whose body carries everything that used to cross to
+        the host per generation — quarantine and monitor counters (already
+        inside :meth:`step`), history sinks (captured and batched out),
+        and the unhealthy-state early-stop — so the host touches the
+        device exactly once per segment.  Returns ``(final_state,
+        telemetry)``; see :meth:`run_segment` for the telemetry layout.
+
+        Jittable with static ``(n_steps, cfg)``; tracing happens through
+        here for both jit dispatch and AOT lowering, so the trace-time
+        bookkeeping below (fault-wrapper callback flavor, sink metadata)
+        is applied no matter how the program is built."""
+        from ..resilience.health import _best_fitness_expr, scan_state
+
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        # Host-callback-carrying wrappers (fault injection) must emit
+        # UNORDERED callbacks inside a fused segment: an ordered callback
+        # would serialize the scan against the host, and under vmap/
+        # shard_map it is not supported at all.  Trace-time flag, restored
+        # after tracing — the compiled program keeps the choice.
+        from ..parallel import iter_problem_chain
+
+        flagged = [
+            p
+            for p in iter_problem_chain(self.problem)
+            if hasattr(p, "in_fused_program")
+        ]
+        for p in flagged:
+            p.in_fused_program = True
+        try:
+            meta: list = []
+
+            def step_out(st: State):
+                new_st, ys = self._traced_capture_step(
+                    st, meta, cfg.capture_history
+                )
+                out: dict[str, Any] = {"sinks": ys}
+                algo = new_st["algorithm"] if "algorithm" in new_st else new_st
+                best = _best_fitness_expr(new_st, algo)
+                if best is not None:
+                    out["best_fitness"] = best
+                return new_st, out
+
+            def scan_metrics(st: State):
+                return scan_state(
+                    st,
+                    check_nonfinite=cfg.check_nonfinite,
+                    nonfinite_skip=cfg.nonfinite_skip,
+                    diversity=cfg.diversity,
+                    step_size=cfg.step_size,
+                    shards=cfg.shards,
+                )
+
+            def unhealthy(st: State) -> jax.Array:
+                raw = scan_metrics(st)
+                bad = jnp.bool_(False)
+                counts = raw.get("nonfinite")
+                # len(): structural (static-under-trace) emptiness test on
+                # the per-leaf dict — `if counts:` reads as branching on a
+                # traced value to the linter.
+                if counts is not None and len(counts):
+                    bad = bad | (sum(counts.values()) > 0)
+                if cfg.diversity_floor is not None and "diversity" in raw:
+                    bad = bad | (raw["diversity"] < cfg.diversity_floor)
+                if cfg.step_size_range is not None and "step_size_min" in raw:
+                    lo, hi = cfg.step_size_range
+                    inside = (raw["step_size_min"] >= lo) & (
+                        raw["step_size_max"] <= hi
+                    )
+                    bad = bad | ~inside
+                if "shard_nonfinite" in raw:
+                    rows = raw["shard_rows"]
+                    bad = bad | jnp.any(
+                        (rows > 0) & (raw["shard_nonfinite"] == rows)
+                    )
+                if cfg.diversity_floor is not None and "shard_diversity" in raw:
+                    bad = bad | jnp.any(
+                        raw["shard_diversity"] < cfg.diversity_floor
+                    )
+                return bad
+
+            # Two body shapes, chosen by the (static) early-stop flag:
+            #
+            # * **Early stop OFF (default)** — the body is the bare step
+            #   plus telemetry packing, no conditional.  This is the shape
+            #   whose CARRY is bit-identical to the debug path's
+            #   ``fori_loop`` of :meth:`step`: measured on CPU XLA, the
+            #   plain scan body (telemetry outputs included) reproduces the
+            #   fori_loop's carried floats exactly, both for callback-free
+            #   programs and for host-callback-carrying ones
+            #   (``FaultyProblem``), whereas a cond-guarded body drifts by
+            #   ulps once the step carries a host callback — the
+            #   effect-token threading JAX adds to branch-mismatched
+            #   conditionals changes how the step's ops fuse
+            #   (``tests/test_fused_segment.py`` pins the equivalence for
+            #   PSO/DE/OpenES/NSGA-II with fault injection live).  The
+            #   stacked telemetry COPIES are the one exception: XLA may
+            #   rematerialize a payload expression into the stacking
+            #   fusion with different FMA contraction, so a captured
+            #   history row can sit ~1 ulp from the identical-valued carry
+            #   leaf — and ``lax.optimization_barrier`` is expanded before
+            #   fusion on the CPU pipeline, so the copy cannot be pinned.
+            #   Every alternative shape tried (payload routed through the
+            #   carry, barrier on the pair, pending-row shift) perturbs
+            #   the CARRY itself, which trades a cosmetic ulp in streamed
+            #   history for real divergence of the evolving state — the
+            #   plain-ys shape is strictly the right trade.
+            #
+            # * **Early stop ON** — the step is ``lax.cond``-guarded so a
+            #   poisoned state freezes mid-segment, and the unhealthy
+            #   predicate reads the state from behind an optimization
+            #   barrier (inlined, its reductions would share an
+            #   optimization context with the step and perturb its
+            #   fusion).  This shape is documented as exactly reproducible
+            #   against itself but NOT bit-identical to the predicate-free
+            #   program — the cond is the price of freeze-don't-compound.
+            if cfg.stop_on_unhealthy:
+                out_struct = jax.eval_shape(step_out, state)[1]
+                zero_out = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), out_struct
+                )
+
+                def frozen(s: State):
+                    return s, zero_out
+
+                def body(carry, _):
+                    st, stopped, executed = carry
+                    new_st, out = jax.lax.cond(stopped, frozen, step_out, st)
+                    bad = unhealthy(jax.lax.optimization_barrier(new_st))
+                    return (
+                        new_st,
+                        stopped | bad,
+                        executed + jnp.where(stopped, 0, 1),
+                    ), out
+
+                (final, stopped, executed), outs = jax.lax.scan(
+                    body,
+                    (state, jnp.bool_(False), jnp.int32(0)),
+                    None,
+                    length=n_steps,
+                )
+            else:
+
+                def body(carry, _):
+                    return step_out(carry)
+
+                final, outs = jax.lax.scan(body, state, None, length=n_steps)
+                stopped = jnp.bool_(False)
+                executed = jnp.int32(n_steps)
+
+            telemetry: dict[str, Any] = {
+                "stopped": stopped,
+                "executed": executed,
+                "sinks": outs["sinks"],
+            }
+            if "best_fitness" in outs:
+                telemetry["best_fitness"] = outs["best_fitness"]
+            if cfg.metrics:
+                telemetry["metrics"] = scan_metrics(final)
+            # Static site identities for flush_telemetry, embedded as a
+            # CONSTANT of this very program: a cached executable replays
+            # without re-tracing, so metadata held on the workflow object
+            # would describe whichever config traced LAST — a capture-off
+            # debug trace in between would silently drop (or mislabel)
+            # every later capture-on segment's history at flush time.
+            telemetry["sink_meta"] = jnp.asarray(
+                np.asarray(meta, dtype=np.int32).reshape(len(meta), 2)
+            )
+            return final, State(**telemetry)
+        finally:
+            for p in flagged:
+                p.in_fused_program = False
+
+    def run_segment(
+        self,
+        state: State,
+        n_steps: int,
+        *,
+        capture_history: bool = True,
+        metrics: bool = True,
+        stop_on_unhealthy: bool = False,
+        health: Any | None = None,
+    ) -> tuple[State, State]:
+        """Run ``n_steps`` generations as ONE compiled ``lax.scan`` segment
+        with the resilience features carried *inside* the program, and
+        return ``(state, telemetry)``.
+
+        This is the fused counterpart of stepping :meth:`step` in a host
+        loop — and the program shape
+        :class:`~evox_tpu.resilience.ResilientRunner` (``fused=True``, the
+        default) compiles per checkpoint segment.  Everything that used to
+        run on the host once per generation happens in-scan:
+
+        * **quarantine** — NaN/±Inf fitness penalties (row- and
+          shard-granular) plus the monitor's in-state counters, exactly as
+          in :meth:`step`;
+        * **history** — the monitor's host sinks are captured per
+          generation into batched telemetry arrays instead of firing one
+          ``io_callback`` per generation (``capture_history``);
+        * **health** — per-generation best fitness, an end-of-segment
+          health-metric snapshot (``metrics``), and an optional
+          ``lax.cond``-guarded early stop that freezes a poisoned state
+          mid-segment (``stop_on_unhealthy``; see :class:`SegmentConfig`).
+
+        The telemetry is a :class:`~evox_tpu.core.State` pytree::
+
+            stopped       bool    — the early-stop tripped
+            executed      int32   — generations actually run (== n_steps
+                                    unless stopped early; frozen rows in
+                                    the batched arrays are padding)
+            sinks         tuple   — per sink site, (data, generation,
+                                    instance) batches of leading length
+                                    n_steps; flush with
+                                    :meth:`flush_telemetry`
+            sink_meta     (n, 2)  — int32 (history_type, slot) identity of
+                                    each sink site, a constant of this
+                                    compiled program (so cached replays
+                                    always self-describe their sinks)
+            best_fitness  (n,)    — per-generation best (minimizing
+                                    frame), when the state exposes one
+            metrics       dict    — scan_state() of the final state
+
+        Host-side work belongs at the segment boundary: call
+        :meth:`flush_telemetry` once per successfully executed segment to
+        append the captured history to the monitor, exactly as the
+        per-generation callbacks would have.  The final state is
+        bit-identical to the same generations run as a compiled
+        ``fori_loop`` of :meth:`step` (the resilient runner's debug path)
+        when ``stop_on_unhealthy`` is off — the cond-guarded body outlines
+        the step into its own XLA computation, so it compiles exactly as
+        the unfused loop body does.  Enabling the early stop adds the
+        in-scan predicate to the program, which is enough to shift XLA's
+        fusion choices by ulps even when the stop never fires: opt in when
+        freeze-don't-compound protection matters more than bit-exact
+        agreement with the per-generation path (replaying the *same* fused
+        program stays exactly deterministic either way).
+
+        The method manages its own jit cache — call it directly (do not
+        wrap it in ``jax.jit``; it is safe under ``jax.vmap`` for stacked
+        instances, where the telemetry gains a leading instance axis).
+        """
+        cfg = self.segment_config(
+            capture_history=capture_history,
+            metrics=metrics,
+            stop_on_unhealthy=stop_on_unhealthy,
+            health=health,
+        )
+        if self._segment_jit is None:
+            self._segment_jit = jax.jit(
+                self._segment_program, static_argnums=(1, 2)
+            )
+        return self._segment_jit(state, int(n_steps), cfg)
+
+    def flush_telemetry(self, telemetry: Any) -> None:
+        """Boundary flush: append a fused segment's captured history
+        batches to the monitor's host-side history (no-op for monitors
+        without host history).  Accepts the telemetry as returned by
+        :meth:`run_segment` (device arrays or an equivalent
+        ``jax.device_get`` copy).  Call exactly once per successfully
+        executed segment — re-flushing duplicates entries, exactly like a
+        replayed callback.
+
+        Payload caveat: the batched history rows are XLA's *stacked
+        copies* of the traced sink values, and XLA may rematerialize the
+        copied expression into the stacking fusion with different FMA
+        contraction — so a history payload can differ from the
+        bit-identical carried state (and from the per-generation callback
+        stream, which reads the carry) by ~1 float32 ulp.  Entry counts,
+        generation/instance tags, and ordering are exact; counters and the
+        evolving state are bitwise."""
+        sinks = telemetry["sinks"] if "sinks" in telemetry else ()
+        ingest = getattr(self.monitor, "ingest_sinks", None)
+        if ingest is None or not sinks:
+            return
+        # Site identities come from the telemetry itself (a constant of the
+        # program that produced it — always in sync with ``sinks``, however
+        # the executable was cached).  A vmapped segment broadcasts the
+        # constant over the instance axis; every row is identical.
+        meta = np.asarray(telemetry["sink_meta"])
+        if meta.ndim == 3:
+            meta = meta[0]
+        ingest(
+            [(int(t), int(s)) for t, s in meta],
+            [tuple(np.asarray(x) for x in site) for site in sinks],
+            np.asarray(telemetry["executed"]),
         )

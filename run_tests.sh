@@ -27,6 +27,17 @@
 #                               asserting the async writer beats the sync
 #                               one on loop-blocked time (artifact under
 #                               bench_artifacts/)
+#   ./run_tests.sh --fused      fused-segment lane: compiled-segment
+#                               resilience suite (fused==debug bit-identity
+#                               matrix for PSO/DE/OpenES/NSGA-II with
+#                               quarantine + restart, batched telemetry vs
+#                               per-generation callbacks, wall-interval scan
+#                               quantization, in-scan early stop) + the
+#                               compile-sentinel fused gate, then the CPU
+#                               microbenchmark asserting fused-resilient
+#                               throughput keeps ≥90% of a bare fused loop
+#                               on the PSO Ackley config (artifact under
+#                               bench_artifacts/)
 #   ./run_tests.sh --health     health/restart lane: run-health diagnostics +
 #                               restart-policy suite, then the CPU
 #                               microbenchmark asserting the between-chunk
@@ -60,6 +71,12 @@ if [ "$1" = "--elastic" ]; then
   shift
   exec "${CPU_ENV[@]}" python -m pytest \
     tests/test_elastic.py tests/test_parallel_and_checkpoint.py -q "$@"
+fi
+if [ "$1" = "--fused" ]; then
+  shift
+  "${CPU_ENV[@]}" python -m pytest \
+    tests/test_fused_segment.py tests/test_compile_sentinel.py -q "$@" || exit 1
+  exec "${CPU_ENV[@]}" python tools/bench_fused_overhead.py
 fi
 if [ "$1" = "--health" ]; then
   shift

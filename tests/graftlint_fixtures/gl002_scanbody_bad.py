@@ -1,0 +1,66 @@
+"""GL002 loop-body true positives: host syncs and callbacks inside
+``lax.scan``/``fori_loop`` bodies reached from a segment builder that is NOT
+in the step family — the fused-segment regression surface."""
+
+import jax
+import numpy as np
+from jax.experimental import io_callback
+
+
+def _report(x):
+    print(x)
+
+
+class FusedSegmentBuilder:
+    def build_segment(self, state, n_steps):
+        # Not a step-family name: scope comes ONLY from the scan-body rule.
+        def body(carry, _):
+            st, counter = carry
+            st = self.advance(st)
+            best = float(st.fit.min())  # GL002: host sync per iteration
+            io_callback(_report, None, st.fit)  # GL002: serializes the scan
+            return (st, counter + best), st.fit
+
+        (final, _), fits = jax.lax.scan(body, (state, 0.0), None, length=n_steps)
+        return final, fits
+
+    def build_loop(self, state, n_steps):
+        def loop_body(i, st):
+            host_pop = np.asarray(st.pop)  # GL002: materializes per iteration
+            del host_pop
+            return st
+
+        return jax.lax.fori_loop(0, n_steps, loop_body, state)
+
+    def build_nested_sibling(self, state, n_steps):
+        # Nested scan whose inner body is a SIBLING def one scope up: the
+        # closure chain makes `inner` visible to the scan call inside
+        # `outer`, so its per-(inner-)iteration callback must still flag.
+        def inner(carry, _):
+            io_callback(_report, None, carry.fit)  # GL002: inner-scan callback
+            return carry, None
+
+        def outer(carry, _):
+            carry, _ys = jax.lax.scan(inner, carry, None, length=4)
+            return carry, None
+
+        final, _ = jax.lax.scan(outer, state, None, length=n_steps)
+        return final
+
+    def build_nested_inline(self, state, n_steps):
+        # Scan-in-scan with the inner body defined INSIDE the outer body:
+        # walked inline by the outer root's pass, and must count exactly
+        # once (the exact-count assertion guards the double-walk bug).
+        def outer(carry, _):
+            def inner(c, _):
+                bad = float(c.fit.min())  # GL002: host sync per iteration
+                return c, bad
+
+            carry, ys = jax.lax.scan(inner, carry, None, length=4)
+            return carry, ys
+
+        final, _ = jax.lax.scan(outer, state, None, length=n_steps)
+        return final
+
+    def advance(self, st):
+        return st

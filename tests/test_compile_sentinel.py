@@ -94,6 +94,43 @@ def test_step_compiles_exactly_once_and_survives_resume(
     fresh_sentinel.assert_compiles(1, match="cold_step", exact=True)
 
 
+@pytest.mark.parametrize(
+    "name,algo,problem", _matrix(), ids=[m[0] for m in _matrix()]
+)
+def test_fused_segment_compiles_exactly_once_across_run_and_resume(
+    name, algo, problem, tmp_path
+):
+    """The fused-segment gate (ISSUE 6): a multi-segment ``fused=True`` run
+    at a fixed chunk size compiles the segment program EXACTLY once — every
+    later segment (including the segments of a checkpoint resume) replays
+    from the cache.  A recompile per segment would silently turn the fused
+    hot path back into a compile benchmark, exactly the regression the
+    per-generation sentinel above guards the debug path against."""
+    from evox_tpu.resilience import ResilientRunner
+
+    chunk = 3
+    wf = StdWorkflow(algo, problem)
+    runner = ResilientRunner(
+        wf, tmp_path / name, checkpoint_every=chunk, fused=True
+    )
+    assert runner.fused
+    # 6 full segments (init_step counts as generation 1).
+    with CompileSentinel() as sentinel:
+        state = runner.run(wf.init(jax.random.key(11)), 1 + 6 * chunk)
+        jax.block_until_ready(state)
+    sentinel.assert_compiles(1, match="init_step", exact=True)
+    sentinel.assert_compiles(1, match="_segment", exact=True)
+
+    # Resume through the same runner: 4 more segments (10 total), ZERO new
+    # compiles — the checkpointed avals must hit the cached executable.
+    with CompileSentinel() as resumed:
+        state = runner.run(wf.init(jax.random.key(12)), 1 + 10 * chunk)
+        jax.block_until_ready(state)
+    assert runner.stats.resumed_from_generation == 1 + 6 * chunk
+    resumed.assert_compiles(0, match="_segment", exact=True)
+    resumed.assert_compiles(0, match="init_step", exact=True)
+
+
 class _GrowingPopHazard(Algorithm):
     """Deliberate recompile hazard: the population gains a row every
     generation, so every ``step`` call presents new shapes to the jit cache

@@ -1,0 +1,541 @@
+"""Fused-segment resilience: the compiled ``lax.scan``-per-checkpoint-segment
+hot path must preserve every guarantee the per-generation debug path makes.
+
+The acceptance matrix (ISSUE 6): for PSO / DE / OpenES / NSGA-II, a run with
+an injected NaN burst (quarantine event) and one health-triggered restart
+produces **bit-identical** final state, restart lineage, and monitor
+counters under ``fused=True`` and ``fused=False``, and resumes
+bit-identically from a mid-run checkpoint under both.  Plus the supporting
+machinery: batched history telemetry matches the per-generation callback
+stream entry-for-entry, retries never duplicate fused history, the
+``checkpoint_wall_interval`` adapter quantizes the NEXT segment's scan
+length (lost-work bound), and the optional in-scan early stop freezes a
+poisoned state mid-segment deterministically.
+
+Bit-identity methodology follows ``tests/test_resilience.py``: comparators
+share the faulted run's *program structure* (same ``FaultyProblem`` schedule
+with ``*_times=0`` / disarmed rows) because XLA fusion can differ between
+programs with and without the host-callback op.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.problems.numerical import DTLZ2, Sphere
+from evox_tpu.resilience import (
+    FaultyProblem,
+    HealthProbe,
+    ResilientRunner,
+    RetryPolicy,
+    RollbackToCheckpoint,
+)
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+DIM = 8
+LB = -10.0 * jnp.ones(DIM)
+UB = 10.0 * jnp.ones(DIM)
+FAST_RETRY = dict(max_retries=3, backoff_base=0.01, backoff_factor=1.0)
+
+
+def _algo(name):
+    from evox_tpu.algorithms import DE, NSGA2, PSO, OpenES
+
+    if name == "pso":
+        return PSO(16, LB, UB)
+    if name == "de":
+        return DE(16, LB, UB)
+    if name == "openes":
+        return OpenES(16, jnp.zeros(DIM), learning_rate=0.05, noise_stdev=0.1)
+    if name == "nsga2":
+        return NSGA2(16, 3, -jnp.ones(12), jnp.ones(12))
+    raise ValueError(name)
+
+
+def _problem(name):
+    return DTLZ2() if name == "nsga2" else Sphere()
+
+
+def _monitor(name):
+    return EvalMonitor(multi_obj=(name == "nsga2"), full_fit_history=False)
+
+
+def _probe(name):
+    # NSGA-II's crowding distance legitimately holds ``inf`` for boundary
+    # solutions — exempt it so the probe watches the injected corruption,
+    # not the algorithm's own sentinel values.
+    skip = ("dis",) if name == "nsga2" else ()
+    return HealthProbe(nonfinite_skip=skip)
+
+
+def _flat(state):
+    out = []
+    for leaf in jax.tree_util.tree_leaves(state):
+        if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key
+        ):
+            out.append(np.asarray(jax.random.key_data(leaf)))
+        else:
+            out.append(np.asarray(leaf))
+    return out
+
+
+def _assert_states_identical(a, b, context=""):
+    la, lb = _flat(a), _flat(b)
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(
+            x, y, err_msg=f"{context} state leaf {i}"
+        )
+
+
+ALGOS = ["pso", "de", "openes", "nsga2"]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance matrix: fused == unfused, bit for bit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ALGOS)
+def test_fused_matches_unfused_with_quarantine_and_restart(
+    name, tmp_path, key
+):
+    """NaN burst at evaluation 4 (row quarantine fires in-step) + in-state
+    corruption at evaluation 6 (boundary probe trips, rollback restart):
+    final state, restart lineage, and monitor counters must agree bitwise
+    between the fused scan path and the per-generation debug path."""
+    n_steps = 14
+    schedule = dict(
+        nan_generations=[4],
+        nan_rows=3,
+        corrupt_generations=[6],
+        corrupt_times=1,
+    )
+
+    results = {}
+    for fused in (True, False):
+        mon = _monitor(name)
+        wf = StdWorkflow(
+            _algo(name), FaultyProblem(_problem(name), **schedule), monitor=mon
+        )
+        runner = ResilientRunner(
+            wf,
+            tmp_path / f"{name}-{fused}",
+            checkpoint_every=3,
+            health=_probe(name),
+            restart=RollbackToCheckpoint(),
+            fused=fused,
+        )
+        assert runner.fused is fused
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            state = runner.run(wf.init(key), n_steps)
+        results[fused] = (runner, mon, state)
+
+    fused_runner, fused_mon, fused_state = results[True]
+    debug_runner, debug_mon, debug_state = results[False]
+
+    # The restart actually happened, identically on both paths.
+    assert [e.policy for e in fused_runner.stats.restarts] == ["rollback"]
+    assert [
+        (e.generation, e.policy, e.restart_index, e.detail)
+        for e in fused_runner.stats.restarts
+    ] == [
+        (e.generation, e.policy, e.restart_index, e.detail)
+        for e in debug_runner.stats.restarts
+    ]
+    assert (
+        fused_runner.stats.unhealthy_probes
+        == debug_runner.stats.unhealthy_probes
+        == 1
+    )
+    assert fused_runner.stats.completed_generations == n_steps
+
+    # Quarantine and restart counters live in the checkpointed state — they
+    # are part of the bitwise comparison, but assert them explicitly so a
+    # counter regression reads as itself rather than as "leaf 17 differs".
+    assert int(fused_mon.get_num_nonfinite(fused_state.monitor)) == int(
+        debug_mon.get_num_nonfinite(debug_state.monitor)
+    )
+    assert int(fused_mon.get_num_nonfinite(fused_state.monitor)) >= 1
+    assert int(fused_mon.get_num_restarts(fused_state.monitor)) == 1
+    assert int(debug_mon.get_num_restarts(debug_state.monitor)) == 1
+
+    _assert_states_identical(fused_state, debug_state, context=name)
+
+
+@pytest.mark.parametrize("name", ALGOS)
+@pytest.mark.parametrize("fused", [True, False], ids=["fused", "debug"])
+def test_mid_run_resume_is_bit_identical(name, fused, tmp_path, key):
+    """A run killed mid-segment and resumed from its checkpoint finishes
+    bit-identical to an uninterrupted run — on both program shapes."""
+    n_steps = 12
+    schedule = dict(fatal_generations=[7], fatal_times=1)
+
+    clean_wf = StdWorkflow(
+        _algo(name),
+        FaultyProblem(_problem(name), **dict(schedule, fatal_times=0)),
+        monitor=_monitor(name),
+    )
+    clean_runner = ResilientRunner(
+        clean_wf, tmp_path / "clean", checkpoint_every=3, fused=fused
+    )
+    clean_final = clean_runner.run(clean_wf.init(key), n_steps)
+
+    wf = StdWorkflow(
+        _algo(name), FaultyProblem(_problem(name), **schedule),
+        monitor=_monitor(name),
+    )
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        retry=RetryPolicy(**FAST_RETRY),
+        fused=fused,
+    )
+    with pytest.raises(Exception, match="NONRETRYABLE"):
+        runner.run(wf.init(key), n_steps)
+    assert runner.stats.completed_generations == 7
+
+    resumed_runner = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=3, fused=fused
+    )
+    final = resumed_runner.run(wf.init(jax.random.key(999)), n_steps)
+    assert resumed_runner.stats.resumed_from_generation == 7
+    _assert_states_identical(final, clean_final, context=f"{name} fused={fused}")
+
+
+def test_fused_and_unfused_resume_agree_across_paths(tmp_path, key):
+    """Cross-path check: a checkpoint written by a fused run resumes
+    bit-identically under the DEBUG path and vice versa — the segment
+    boundary is the same program point in both shapes."""
+    n_steps = 10
+    finals = {}
+    for write_fused, resume_fused in [(True, False), (False, True)]:
+        wf = StdWorkflow(
+            _algo("pso"), FaultyProblem(Sphere()), monitor=_monitor("pso")
+        )
+        d = tmp_path / f"w{write_fused}"
+        writer = ResilientRunner(
+            wf, d, checkpoint_every=3, fused=write_fused
+        )
+        writer.run(wf.init(key), 7)
+        resumer = ResilientRunner(wf, d, checkpoint_every=3, fused=resume_fused)
+        finals[(write_fused, resume_fused)] = resumer.run(
+            wf.init(key), n_steps
+        )
+        assert resumer.stats.resumed_from_generation == 7
+    _assert_states_identical(
+        finals[(True, False)], finals[(False, True)], context="cross-path"
+    )
+
+
+# ---------------------------------------------------------------------------
+# batched history telemetry
+# ---------------------------------------------------------------------------
+
+
+def _max_ulp_diff(x, y):
+    """Largest elementwise distance in float32 ulps (0 == bitwise equal)."""
+    xi = np.asarray(x, np.float32).view(np.int32).astype(np.int64)
+    yi = np.asarray(y, np.float32).view(np.int32).astype(np.int64)
+    return int(np.abs(xi - yi).max()) if xi.size else 0
+
+
+def test_fused_history_matches_per_generation_stream(tmp_path, key):
+    """The captured-and-batched sink telemetry must reproduce the
+    per-generation ``io_callback`` history — same entry count, tags, and
+    ordering, with payloads at worst a few float32 ulps apart.
+
+    The payload tolerance is deliberate, not slack: the carried STATE of a
+    fused segment is bit-identical to the debug path (the acceptance matrix
+    above pins that), but the scan's *stacked telemetry copies* are
+    separate XLA fusions that may rematerialize the payload expression with
+    different FMA contraction — and ``lax.optimization_barrier`` is
+    expanded before fusion on the CPU pipeline, so the copy cannot be
+    pinned to the carry's bits.  See the ``run_segment`` docstring."""
+    n_steps = 9
+    hists = {}
+    for fused in (True, False):
+        mon = EvalMonitor(full_fit_history=True, full_sol_history=True)
+        wf = StdWorkflow(
+            _algo("pso"), FaultyProblem(Sphere()), monitor=mon
+        )
+        runner = ResilientRunner(
+            wf, tmp_path / f"h{fused}", checkpoint_every=4, fused=fused
+        )
+        runner.run(wf.init(key), n_steps)
+        hists[fused] = (
+            mon.get_fitness_history(),
+            mon.get_solution_history(),
+        )
+    for which, label in ((0, "fitness"), (1, "solution")):
+        a, b = hists[True][which], hists[False][which]
+        assert len(a) == len(b) == n_steps
+        for i, (x, y) in enumerate(zip(a, b)):
+            ulps = _max_ulp_diff(x, y)
+            assert ulps <= 64, (
+                f"{label} history entry {i}: fused payload is {ulps} ulps "
+                f"from the per-generation stream (tolerance 64)"
+            )
+
+
+def test_fused_retry_does_not_duplicate_history(tmp_path, key):
+    """Fused-path telemetry is flushed only after a segment SUCCEEDS, so a
+    retried segment contributes its history exactly once (the per-generation
+    path documents duplicate entries after a recovery; the fused path must
+    not have them)."""
+    mon = EvalMonitor(full_fit_history=True)
+    prob = FaultyProblem(Sphere(), error_generations=[5], error_times=1)
+    wf = StdWorkflow(_algo("pso"), prob, monitor=mon)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=4,
+        retry=RetryPolicy(**FAST_RETRY),
+        fused=True,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        runner.run(wf.init(key), 10)
+    assert runner.stats.retries >= 1
+    hist = mon.get_fitness_history()
+    assert len(hist) == 10, (
+        f"expected exactly one history entry per generation, got {len(hist)}"
+    )
+
+
+def test_run_segment_standalone_telemetry(key):
+    """``StdWorkflow.run_segment`` without a runner: telemetry layout,
+    executed count, bit-identical final state against the same generations
+    as one compiled ``fori_loop`` of ``step`` (the documented contract —
+    the runner's debug-path program shape), and the boundary flush
+    appending history with the per-generation stream's tags and order."""
+    mon = EvalMonitor(full_fit_history=True)
+    wf = StdWorkflow(_algo("pso"), Sphere(), monitor=mon)
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+
+    ref_mon = EvalMonitor(full_fit_history=True)
+    ref_wf = StdWorkflow(_algo("pso"), Sphere(), monitor=ref_mon)
+    ref_state = ref_wf.init(key)
+    ref_state = jax.jit(ref_wf.init_step)(ref_state)
+
+    n = 6
+    state, telemetry = wf.run_segment(state, n)
+    assert int(telemetry["executed"]) == n
+    assert not bool(telemetry["stopped"])
+    assert telemetry["best_fitness"].shape == (n,)
+    wf.flush_telemetry(jax.device_get(telemetry))
+
+    # The bit-identity contract is against the COMPILED loop of step (the
+    # debug path), not n individually dispatched jit(step) programs —
+    # per-generation dispatch has never been bit-equal to a chunked loop
+    # (different fusion contexts; the pre-existing runner caveat).
+    loop = jax.jit(
+        lambda s: jax.lax.fori_loop(0, n, lambda _, c: ref_wf.step(c), s)
+    )
+    ref_state = loop(ref_state)
+    jax.block_until_ready(ref_state)
+
+    _assert_states_identical(state, ref_state, context="run_segment")
+    a, b = mon.get_fitness_history(), ref_mon.get_fitness_history()
+    assert len(a) == len(b) == n + 1  # +1: the init_step generation
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    for i, (x, y) in enumerate(zip(a[1:], b[1:])):
+        ulps = _max_ulp_diff(x, y)
+        assert ulps <= 64, f"history entry {i + 1}: {ulps} ulps apart"
+
+
+def test_flush_meta_survives_interleaved_config_trace(key):
+    """Regression (stale sink metadata): the sink-site identities are a
+    CONSTANT of each compiled segment program, carried in its own
+    telemetry (``sink_meta``).  A capture-on executable replayed from the
+    jit cache after a capture-off config traced last must still flush
+    every history entry with the right (type, slot) tags — metadata held
+    on the workflow object described whichever config traced most
+    recently, so exactly this interleaving silently dropped the replayed
+    segment's entire captured history at flush time."""
+    mon = EvalMonitor(full_fit_history=True, full_sol_history=True)
+    wf = StdWorkflow(_algo("pso"), Sphere(), monitor=mon)
+    state = wf.init(key)
+    state = jax.jit(wf.init_step)(state)
+
+    n = 3
+    state, t1 = wf.run_segment(state, n)  # trace 1: capture on
+    wf.flush_telemetry(jax.device_get(t1))
+    fits_before = len(mon.get_fitness_history())
+    sols_before = len(mon.get_solution_history())
+    assert fits_before == sols_before == n + 1
+
+    # Trace 2: capture off — a second cached executable with NO sinks
+    # (history flows through the live per-generation callbacks instead).
+    # Flushing its telemetry must be a no-op: nothing was captured.
+    state, t_off = wf.run_segment(state, n, capture_history=False)
+    t_off = jax.device_get(t_off)  # syncs the in-scan callbacks too
+    fits_before = len(mon.get_fitness_history())
+    sols_before = len(mon.get_solution_history())
+    wf.flush_telemetry(t_off)
+    assert len(mon.get_fitness_history()) == fits_before
+    assert len(mon.get_solution_history()) == sols_before
+
+    # Replay trace 1's cached executable (same static config — no
+    # retrace) and flush: every entry lands, correctly typed.
+    state, t2 = wf.run_segment(state, n)
+    assert np.asarray(t2["sink_meta"]).shape[0] == len(t2["sinks"])
+    wf.flush_telemetry(jax.device_get(t2))
+    fits, sols = mon.get_fitness_history(), mon.get_solution_history()
+    assert len(fits) == fits_before + n
+    assert len(sols) == sols_before + n
+    # Mislabeled types would swap the (pop,) fitness rows and the
+    # (pop, dim) solution rows between the two histories.
+    assert all(np.asarray(f).ndim == 1 for f in fits[-n:])
+    assert all(np.asarray(s).ndim == 2 for s in sols[-n:])
+
+
+# ---------------------------------------------------------------------------
+# checkpoint_wall_interval: quantize the NEXT scan length (lost-work bound)
+# ---------------------------------------------------------------------------
+
+
+def test_wall_interval_quantizer_picks_next_segment_length(tmp_path):
+    """The adapter's decision lands on the NEXT segment (`_next_chunk`),
+    quantized to powers of two capped by ``checkpoint_every`` — a fused
+    scan cannot be split retroactively."""
+    wf = StdWorkflow(_algo("pso"), Sphere())
+    runner = ResilientRunner(
+        wf, tmp_path, checkpoint_every=16, checkpoint_wall_interval=1.0
+    )
+    # Fast generations: 1 ms/gen -> target 1000 gens -> capped at 16.
+    runner._adapt_chunk(4, 0.004)
+    assert runner._next_chunk() == 16
+    # Slow generations: 0.6 s/gen -> target ~1.67 -> quantized to 1.
+    runner._per_gen_ema = None
+    runner._adapt_chunk(4, 2.4)
+    assert runner._next_chunk() == 1
+    # Mid-range: 0.08 s/gen -> target 12.5 -> power of two below: 8.
+    runner._per_gen_ema = None
+    runner._adapt_chunk(4, 0.32)
+    assert runner._next_chunk() == 8
+
+
+def test_wall_interval_run_bounds_lost_work(tmp_path, key):
+    """Lost-work-bound regression: with a wall-interval target the run's
+    segment lengths stay powers of two within ``checkpoint_every``, every
+    boundary writes a checkpoint (so at most one segment of work can be
+    lost), and the adapter only ever changes the length BETWEEN segments."""
+    wf = StdWorkflow(_algo("pso"), FaultyProblem(Sphere()))
+    runner = ResilientRunner(
+        wf,
+        tmp_path,
+        checkpoint_every=8,
+        checkpoint_wall_interval=1e-4,  # unreachably tight: pin chunks at 1
+        keep_checkpoints=0,
+        fused=True,
+    )
+    runner.run(wf.init(key), 9)
+    assert runner.stats.chunk_sizes, "run recorded no segments"
+    for c in runner.stats.chunk_sizes:
+        assert c >= 1 and (c & (c - 1)) == 0, f"non-power-of-two chunk {c}"
+    # Unreachably tight interval: after the first measurement every chunk
+    # is 1 generation — the lost-work bound the wall interval promises.
+    assert set(runner.stats.chunk_sizes[1:]) == {1}
+    # One checkpoint per boundary (plus init's): nothing to lose beyond the
+    # segment in flight.
+    assert runner.stats.checkpoints_written == len(runner.stats.chunk_sizes) + 1
+
+
+def test_wall_interval_adaptation_excludes_compile_time(tmp_path, key):
+    """Compile seconds must not poison the per-generation EMA: a cold AOT
+    compile before each new length would otherwise read as 'slow
+    generations', shrink the chunk, compile the NEW length, and spiral
+    every segment into a fresh compile."""
+    wf = StdWorkflow(_algo("pso"), FaultyProblem(Sphere()))
+    runner = ResilientRunner(
+        wf,
+        tmp_path,
+        checkpoint_every=8,
+        checkpoint_wall_interval=30.0,  # generous: CPU gens are ~ms
+        fused=True,
+    )
+    # Make every compile look catastrophically slow without touching
+    # execution: wrap the AOT step with a simulated stall.
+    real_get = runner._get_executable
+    import time as _time
+
+    def slow_compile(which, state, chunk):
+        in_cache = (
+            which,
+            chunk,
+            runner._forced_cpu,
+            runner._abstract_sig(state),
+        ) in runner._exec_cache
+        fn = real_get(which, state, chunk)
+        if not in_cache:
+            _time.sleep(0.3)  # "compile" stall, outside execution timing
+        return fn
+
+    runner._get_executable = slow_compile
+    runner.run(wf.init(key), 26)
+    # Execution-only EMA + generous target: the chunk must GROW to the cap
+    # instead of collapsing to 1 under the fake compile stalls.
+    assert runner._next_chunk() == 8, (
+        f"chunk collapsed (per-gen EMA {runner._per_gen_ema}); compile time "
+        f"leaked into the wall-interval adapter"
+    )
+
+
+# ---------------------------------------------------------------------------
+# in-scan early stop
+# ---------------------------------------------------------------------------
+
+
+def test_fused_early_stop_freezes_poisoned_segment(tmp_path, key):
+    """With ``fused_early_stop``, persistent in-state corruption freezes the
+    scan mid-segment: executed < chunk, the stop is counted and reported,
+    and the boundary probe still renders its verdict."""
+    prob = FaultyProblem(Sphere(), corrupt_generations=[4], corrupt_times=99)
+    wf = StdWorkflow(_algo("pso"), prob, monitor=EvalMonitor())
+    runner = ResilientRunner(
+        wf,
+        tmp_path,
+        checkpoint_every=6,
+        health=HealthProbe(),
+        fused=True,
+        fused_early_stop=True,
+    )
+    with pytest.warns(UserWarning, match="stopped early"):
+        runner.run(wf.init(key), 12)
+    assert runner.stats.early_stops >= 1
+    # Early-stopped segments executed fewer generations than scheduled.
+    assert any(c < 6 for c in runner.stats.chunk_sizes)
+    assert runner.stats.completed_generations == 12
+    assert runner.stats.unhealthy_probes >= 1
+
+
+def test_fused_early_stop_is_deterministic(tmp_path, key):
+    """An early-stop run is exactly reproducible against itself (the
+    documented contract: reproducible, though not bit-identical to the
+    predicate-free program)."""
+    finals = []
+    for i in range(2):
+        prob = FaultyProblem(
+            Sphere(), corrupt_generations=[4], corrupt_times=99
+        )
+        wf = StdWorkflow(_algo("pso"), prob, monitor=EvalMonitor())
+        runner = ResilientRunner(
+            wf,
+            tmp_path / str(i),
+            checkpoint_every=6,
+            health=HealthProbe(),
+            fused=True,
+            fused_early_stop=True,
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            finals.append(runner.run(wf.init(key), 12))
+    _assert_states_identical(finals[0], finals[1], context="early-stop rerun")

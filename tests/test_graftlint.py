@@ -87,6 +87,84 @@ def test_bad_fixture_finding_counts_are_exact():
         )
 
 
+def test_gl002_scanbody_bad_fixture_counts_are_exact():
+    """Loop-body scope: host syncs AND host callbacks inside lax.scan/
+    fori_loop bodies reached from a NON-step-family segment builder must
+    flag — one finding per inline GL002 marker, no over-firing."""
+    path = FIXTURES / "gl002_scanbody_bad.py"
+    expected = sum("# GL002" in line for line in path.read_text().splitlines())
+    found = [f for f in _findings(path, ["GL002"]) if f.rule == "GL002"]
+    assert len(found) == expected, "\n".join(f.format() for f in found)
+    assert any("io_callback" in f.message for f in found), (
+        "the per-iteration host-callback finding is the point of the "
+        "scan-body extension"
+    )
+
+
+def test_gl002_scanbody_ok_fixture_is_clean_across_all_rules():
+    """A disciplined fused segment — telemetry batched out of the scan,
+    boundary-only host callback — must stay clean under every rule."""
+    path = FIXTURES / "gl002_scanbody_ok.py"
+    found = _findings(path)
+    assert not found, "\n".join(f.format() for f in found)
+
+
+def test_gl002_scanbody_follows_cond_branch_closure(tmp_path):
+    """The fused segment's real shape: the scan body dispatches through
+    ``lax.cond(pred, frozen, step_out, ...)`` — a stray io_callback in a
+    BRANCH function is per-iteration host traffic exactly like one in the
+    body itself, and must flag."""
+    src = tmp_path / "seg.py"
+    src.write_text(
+        "import jax\n"
+        "from jax.experimental import io_callback\n"
+        "def build(state, n):\n"
+        "    def frozen(st):\n"
+        "        return st\n"
+        "    def step_out(st):\n"
+        "        io_callback(print, None, st.fit)\n"
+        "        return st\n"
+        "    def body(carry, _):\n"
+        "        st, stop = carry\n"
+        "        st = jax.lax.cond(stop, frozen, step_out, st)\n"
+        "        return (st, stop), None\n"
+        "    return jax.lax.scan(body, (state, False), None, length=n)\n"
+    )
+    found = _findings(src, ["GL002"])
+    assert [f.rule for f in found] == ["GL002"], [f.format() for f in found]
+    assert "io_callback" in found[0].message
+
+
+def test_gl002_boundary_callback_outside_body_is_clean(tmp_path):
+    """The sanctioned fused-segment idiom — ONE callback per segment, after
+    the scan returns — must not flag (false-positive guard for the
+    boundary-flush pattern the runner uses)."""
+    src = tmp_path / "seg.py"
+    src.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import io_callback\n"
+        "def build(state, n):\n"
+        "    def body(carry, _):\n"
+        "        return carry, jnp.min(carry.fit)\n"
+        "    final, best = jax.lax.scan(body, state, None, length=n)\n"
+        "    io_callback(print, None, best)\n"
+        "    return final\n"
+    )
+    assert not _findings(src, ["GL002"])
+
+
+def test_fused_segment_builder_is_clean_under_scanbody_scope():
+    """``StdWorkflow._segment_program``'s scan body (and its cond-branch
+    closure) is now compiled scope — the real builder must hold itself to
+    the rule it motivated."""
+    found = scan_paths(
+        [REPO / "evox_tpu" / "workflows" / "std_workflow.py"],
+        [RULES_BY_CODE["GL002"], RULES_BY_CODE["GL003"]],
+    )
+    assert not found, "\n".join(f.format() for f in found)
+
+
 # ---------------------------------------------------------------------------
 # pragma suppression
 # ---------------------------------------------------------------------------

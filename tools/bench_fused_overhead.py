@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""CPU microbenchmark: in-segment resilience overhead of the fused path.
+
+ISSUE 6's contract: compiling a checkpoint segment as ONE ``lax.scan`` with
+every per-generation resilience feature carried *inside* the program
+(non-finite quarantine, in-scan health metrics, batched telemetry) must
+cost ≤10% throughput against a bare fused loop with none of it — otherwise
+fusing the resilience in would be no better than hosting it out.  This
+benchmark pins that to a number on the PSO Ackley dispatch-bound config
+(the bench that regressed 524→287 gen/s when PRs 1–5 put resilience on the
+host side of the dispatch loop) and FAILS (exit 1) if fused-resilient
+throughput drops below ``FLOOR`` (90%) of the bare loop.
+
+Methodology: both programs run the SAME chunking — N generations as
+``N / CHUNK`` compiled calls — so the comparison isolates what rides inside
+the compiled program, not dispatch count.  The gate pair mirrors the
+regressed bench's own configuration (no monitor attached, exactly like
+``bench.py``'s ``pso_small``): bare = a jitted ``fori_loop`` of the
+quarantine-less step (``StdWorkflow.run``); resilient =
+``StdWorkflow.run_segment`` with quarantine + the health-metric snapshot +
+segment telemetry, plus its boundary ``device_get`` — everything the
+supervising runner does per segment except disk (checkpoint-write cost is
+owned by ``tools/bench_checkpoint_overhead.py``).
+
+A second, *informational* pair measures the same A/B with an
+``EvalMonitor`` attached to BOTH sides (history captured in-scan on the
+resilient side, streamed per generation on the bare side).  It is recorded
+but not gated: an EvalMonitor inside a compiled loop costs ~35% on CPU
+*regardless of path* (measured: the fused segment is at parity or slightly
+ahead of the same-monitor ``fori_loop``), so gating on a monitor-attached
+vs monitor-less ratio would charge the monitor's pre-existing in-loop cost
+to the fusion.  Repeats are interleaved A/B so machine drift hits both
+sides alike; the gate takes medians.
+
+Run via::
+
+    ./run_tests.sh --fused           # suite + this benchmark
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_fused_overhead.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.algorithms import PSO  # noqa: E402
+from evox_tpu.problems.numerical import Ackley  # noqa: E402
+from evox_tpu.workflows import EvalMonitor, StdWorkflow  # noqa: E402
+
+N_STEPS = 200
+CHUNK = 25  # generations per compiled program call (segment length)
+POP, DIM = 1024, 100  # the PSO Ackley dispatch-bound bench config
+REPEATS = 5
+FLOOR = 0.90  # fused-resilient must keep ≥90% of bare-fused throughput
+
+LB = -32.0 * jnp.ones(DIM)
+UB = 32.0 * jnp.ones(DIM)
+
+
+def _wf(monitor=None, quarantine=True):
+    return StdWorkflow(
+        PSO(POP, LB, UB),
+        Ackley(),
+        monitor=monitor,
+        quarantine_nonfinite=quarantine,
+    )
+
+
+def _bare_sweep(wf):
+    """The reference fused loop: one ``fori_loop`` of the step per chunk."""
+    run_chunk = jax.jit(lambda s: wf.run(s, CHUNK, init=False))
+
+    def sweep(state):
+        for _ in range(N_STEPS // CHUNK):
+            state = run_chunk(state)
+        return jax.block_until_ready(state)
+
+    return sweep
+
+
+def _resilient_sweep(wf):
+    """The fused-resilient segment plus its boundary host work (telemetry
+    ``device_get`` + monitor flush) — the supervisor's per-segment cost
+    minus disk."""
+
+    def sweep(state):
+        for _ in range(N_STEPS // CHUNK):
+            state, telemetry = wf.run_segment(state, CHUNK)
+            wf.flush_telemetry(jax.device_get(telemetry))
+        return jax.block_until_ready(state)
+
+    return sweep
+
+
+def _measure(pairs: dict) -> dict:
+    """Warm each sweep, then interleave REPEATS timed passes."""
+    prepped = {}
+    for tag, (wf, sweep) in pairs.items():
+        state = wf.init(jax.random.key(0))
+        state = jax.block_until_ready(jax.jit(wf.init_step)(state))
+        sweep(state)  # warm: compiles amortized out, as in any long run
+        prepped[tag] = (state, sweep, [])
+    for _ in range(REPEATS):
+        for tag, (state, sweep, times) in prepped.items():
+            t0 = time.perf_counter()
+            sweep(state)
+            times.append(time.perf_counter() - t0)
+    return {tag: times for tag, (_, _, times) in prepped.items()}
+
+
+def main() -> int:
+    # -- the gated pair: the regressed bench's own config (no monitor) ----
+    bare_wf = _wf(quarantine=False)
+    res_wf = _wf(quarantine=True)
+    gate_times = _measure(
+        {
+            "bare": (bare_wf, _bare_sweep(bare_wf)),
+            "resilient": (res_wf, _resilient_sweep(res_wf)),
+        }
+    )
+    # -- informational pair: EvalMonitor attached to both sides ----------
+    bare_mon_wf = _wf(monitor=EvalMonitor(full_fit_history=True))
+    res_mon_wf = _wf(monitor=EvalMonitor(full_fit_history=True))
+    info_times = _measure(
+        {
+            "bare_monitored": (bare_mon_wf, _bare_sweep(bare_mon_wf)),
+            "resilient_monitored": (
+                res_mon_wf,
+                _resilient_sweep(res_mon_wf),
+            ),
+        }
+    )
+
+    def gps(times):
+        return N_STEPS / statistics.median(times)
+
+    gps_bare = gps(gate_times["bare"])
+    gps_res = gps(gate_times["resilient"])
+    ratio = gps_res / gps_bare
+    mon_ratio = gps(info_times["resilient_monitored"]) / gps(
+        info_times["bare_monitored"]
+    )
+    result = {
+        "bench": "fused_resilience_overhead",
+        "backend": jax.default_backend(),
+        "n_steps": N_STEPS,
+        "chunk": CHUNK,
+        "pop_size": POP,
+        "dim": DIM,
+        "repeats": REPEATS,
+        "bare_seconds": gate_times["bare"],
+        "resilient_seconds": gate_times["resilient"],
+        "bare_gens_per_sec": gps_bare,
+        "resilient_gens_per_sec": gps_res,
+        "throughput_ratio": ratio,
+        "floor_ratio": FLOOR,
+        "within_budget": ratio >= FLOOR,
+        "monitored_informational": {
+            "bare_seconds": info_times["bare_monitored"],
+            "resilient_seconds": info_times["resilient_monitored"],
+            "bare_gens_per_sec": gps(info_times["bare_monitored"]),
+            "resilient_gens_per_sec": gps(
+                info_times["resilient_monitored"]
+            ),
+            "throughput_ratio": mon_ratio,
+        },
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(
+        out_dir, f"fused_overhead.{jax.default_backend()}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"fused resilience overhead: resilient {gps_res:.1f} gen/s vs bare "
+        f"{gps_bare:.1f} gen/s = {ratio * 100:.1f}% throughput kept "
+        f"(floor {FLOOR * 100:.0f}%; {N_STEPS} gens in {CHUNK}-gen "
+        f"segments); monitored pair (informational): "
+        f"{mon_ratio * 100:.1f}%"
+    )
+    print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+    if ratio < FLOOR:
+        print(
+            f"FAIL: fused-resilient throughput {ratio * 100:.1f}% is under "
+            f"the {FLOOR * 100:.0f}% floor",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -39,6 +39,19 @@ and bare ``f()``).  Nested functions inherit the enclosing scope, except
 functions handed to ``io_callback``/``pure_callback``/``jax.debug.callback``
 — those run on the host by construction and are exempt.
 
+**Loop-body scope.**  A function passed as the body of ``jax.lax.scan`` /
+``fori_loop`` / ``while_loop`` traces into the compiled program once per
+iteration *wherever* the combinator is called — including step/segment
+builders outside the step family (``StdWorkflow._segment_program``, the
+fused resilient segments).  Those bodies (plus their same-scope closure
+through bare calls and ``lax.cond``/``lax.switch`` branch arguments) are
+compiled scope too, and additionally **loop-body scope**: a host callback
+(``io_callback``/``pure_callback``/``jax.debug.callback``) there fires once
+per iteration and serializes the fused loop against the host, so GL002
+flags the *call site itself* — exactly the stray-callback-in-the-scan-body
+regression the fused segment work guards against.  Batch the data out as
+scan outputs instead and flush at the segment boundary.
+
 All checks are AST heuristics tuned for zero false positives on this
 codebase; genuine-but-intentional sites carry a
 ``# graftlint: disable=GLxxx`` pragma with a justification comment, and
@@ -218,6 +231,112 @@ def _host_callback_names(fn: ast.AST) -> frozenset[str]:
                 if isinstance(node.args[0], ast.Name):
                     names.add(node.args[0].id)
     return frozenset(names)
+
+
+# Positional slot of the body function in each jax.lax loop combinator:
+# lax.scan(body, ...), lax.fori_loop(lo, hi, body, ...),
+# lax.while_loop(cond, body, ...).
+_LOOP_BODY_ARG = {"scan": 0, "fori_loop": 2, "while_loop": 1}
+
+# Branch-function slots of the non-loop structured-control combinators: a
+# function handed to cond/switch from inside a loop body traces into the
+# same per-iteration program, so the body closure follows them too.
+_BRANCH_FN_CALLS = frozenset({"cond", "switch"})
+
+
+def _loop_body_functions(mod: Module) -> dict[int, ast.AST]:
+    """``{id(fn): fn}`` for every function that traces as the body of a
+    ``lax.scan``/``fori_loop``/``while_loop`` anywhere in the module, plus
+    the same-scope closure reached from those bodies through bare calls and
+    ``lax.cond``/``lax.switch`` branch arguments.
+
+    Resolution is lexical and follows Python's closure chain: a candidate
+    name resolves to a ``def`` within the combinator call's enclosing
+    function (any nesting depth), then within each transitively *enclosing*
+    function (a sibling body defined one scope up is visible to the scan
+    call — the nested-scan shape), then a module-level function, or — for
+    ``self.m`` — a method of the enclosing class.  Lambdas inline into
+    their enclosing scope and are not rooted here."""
+    all_funcs = list(_iter_functions(mod.tree))
+    module_funcs: dict[str, ast.AST] = {}
+    class_methods: dict[tuple[str, str], ast.AST] = {}
+    for fn, cls, enclosing in all_funcs:
+        if enclosing is None and cls is None:
+            module_funcs.setdefault(fn.name, fn)
+        elif enclosing is None and cls is not None:
+            class_methods[(cls, fn.name)] = fn
+    fn_class = {id(fn): cls for fn, cls, _enc in all_funcs}
+    enclosing_of = {id(fn): enc for fn, _cls, enc in all_funcs}
+
+    def local_defs(owner: ast.AST) -> dict[str, ast.AST]:
+        return {
+            n.name: n
+            for n in ast.walk(owner)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not owner
+        }
+
+    def resolve(node: ast.AST, owner: ast.AST) -> ast.AST | None:
+        if isinstance(node, ast.Name):
+            scope: ast.AST | None = owner
+            while scope is not None:
+                target = local_defs(scope).get(node.id)
+                if target is not None:
+                    return target
+                scope = enclosing_of.get(id(scope))
+            return module_funcs.get(node.id)
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            cls = fn_class.get(id(owner))
+            if cls is not None:
+                return class_methods.get((cls, node.attr))
+        return None
+
+    bodies: dict[int, ast.AST] = {}
+    owners: dict[int, ast.AST] = {}  # body fn id -> enclosing-scope owner
+    for fn, _cls, _enc in all_funcs:
+        for node in _body_walk(fn, into_nested=False):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+            slot = _LOOP_BODY_ARG.get(tail)
+            if slot is None or len(node.args) <= slot:
+                continue
+            target = resolve(node.args[slot], fn)
+            if target is not None and id(target) not in bodies:
+                bodies[id(target)] = target
+                owners[id(target)] = fn
+
+    # Same-scope closure: a body that dispatches to siblings through bare
+    # calls or cond/switch branch arguments drags them into per-iteration
+    # compiled scope (``body -> lax.cond(pred, frozen, step_out, ...)``).
+    queue = list(bodies.values())
+    while queue:
+        body = queue.pop()
+        owner = owners.get(id(body), body)
+        for node in _body_walk(body, into_nested=True):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+            candidates: list[ast.AST] = []
+            if isinstance(node.func, ast.Name):
+                target = resolve(node.func, owner)
+                if target is not None:
+                    candidates.append(target)
+            if tail in _BRANCH_FN_CALLS:
+                for arg in node.args:
+                    target = resolve(arg, owner)
+                    if target is not None:
+                        candidates.append(target)
+            for target in candidates:
+                if id(target) not in bodies:
+                    bodies[id(target)] = target
+                    owners[id(target)] = owner
+                    queue.append(target)
+    return bodies
 
 
 def compiled_functions(mod: Module) -> list[ast.AST]:
@@ -440,30 +559,63 @@ class _Taint:
                     )
 
 
-def _compiled_statements(
-    fn: ast.AST, host_names: frozenset[str], taint: _Taint
-) -> Iterator[ast.AST]:
-    """Statement-ordered walk of a compiled function: propagates taint as it
-    goes and yields every node; nested defs walked inline unless they are
-    host callbacks (their params seeded like the parent's)."""
+def _seed_all_params(fn: ast.AST, taint: _Taint) -> None:
+    """Taint every parameter of ``fn`` — the seeding for loop-body roots,
+    whose arguments (scan carry/slice, fori index/value, while carry) are
+    traced by construction regardless of their names."""
+    args = fn.args
+    for a in (
+        list(args.posonlyargs)
+        + list(args.args)
+        + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+    ):
+        taint.tainted.add(a.arg)
 
-    def walk(node: ast.AST) -> Iterator[ast.AST]:
+
+def _compiled_statements(
+    fn: ast.AST,
+    host_names: frozenset[str],
+    taint: _Taint,
+    loop_ids: frozenset[int] = frozenset(),
+    in_body: bool = False,
+) -> Iterator[tuple[ast.AST, bool, "_Taint"]]:
+    """Statement-ordered walk of a compiled function: propagates taint as it
+    goes and yields ``(node, in_loop_body, taint_in_scope)``; nested defs
+    walked inline unless they are host callbacks.  ``taint_in_scope`` is the
+    environment the node must be judged against — a nested loop body gets a
+    child taint with its own params seeded (scan carry/slice are traced by
+    construction), so callers must use the YIELDED taint, not the root's.
+    ``in_loop_body`` turns on inside functions registered as loop bodies
+    (:func:`_loop_body_functions`) — per-iteration compiled scope."""
+
+    def walk(node: ast.AST) -> Iterator[tuple[ast.AST, bool, "_Taint"]]:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if node.name in host_names:
                 return  # host callback: exempt
             inner = _Taint(node)
+            if id(node) in loop_ids:
+                # Every parameter of a loop body is traced by construction
+                # (the carry/slice of scan, the index/value of fori_loop).
+                _seed_all_params(node, inner)
             inner.tainted |= taint.tainted
             inner.traced_callables |= taint.traced_callables
             inner.dict_fields.update(taint.dict_fields)
             # The nested function traces into the same program; its findings
             # use the shared (approximate) environment.
-            yield from _compiled_statements(node, host_names, inner)
+            yield from _compiled_statements(
+                node,
+                host_names,
+                inner,
+                loop_ids,
+                in_body or id(node) in loop_ids,
+            )
             return
         if isinstance(node, ast.ClassDef):
             return
         if isinstance(node, ast.stmt):
             taint.visit_stmt(node)
-        yield node
+        yield node, in_body, taint
         for child in ast.iter_child_nodes(node):
             yield from walk(child)
 
@@ -727,7 +879,13 @@ class _CompiledScopeRule(Rule):
     def check(self, mod: Module) -> list[Finding]:
         return list(_compiled_scope_findings(mod).get(self.code, []))
 
-    def check_node(self, mod: Module, node: ast.AST, taint: _Taint) -> list[Finding]:
+    def check_node(
+        self,
+        mod: Module,
+        node: ast.AST,
+        taint: _Taint,
+        in_loop_body: bool = False,
+    ) -> list[Finding]:
         raise NotImplementedError
 
 
@@ -737,9 +895,40 @@ def _compiled_scope_findings(mod: Module) -> dict[str, list[Finding]]:
         return cached
     rules = [r for r in RULES if isinstance(r, _CompiledScopeRule)]
     findings: dict[str, list[Finding]] = {r.code: [] for r in rules}
-    for fn in compiled_functions(mod):
+    step_roots = compiled_functions(mod)
+    loop_bodies = _loop_body_functions(mod)
+    loop_ids = frozenset(loop_bodies)
+    # Loop bodies lexically inside a step-family root are walked inline by
+    # that root's pass; the rest (bodies in segment builders and other
+    # non-step functions) become compiled roots of their own.
+    covered: set[int] = set()
+    for root in step_roots:
+        covered.update(
+            id(n)
+            for n in ast.walk(root)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )
+    roots: list[tuple[ast.AST, bool]] = [(fn, False) for fn in step_roots]
+    # A body lexically nested inside another body root (scan-in-scan with
+    # the inner def inside the outer body) is walked inline by the outer
+    # root's pass — rooting it separately would double every finding in it.
+    body_roots = [fn for fid, fn in loop_bodies.items() if fid not in covered]
+    nested_in_body: set[int] = set()
+    for fn in body_roots:
+        nested_in_body.update(
+            id(n)
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        )
+    roots.extend(
+        (fn, True) for fn in body_roots if id(fn) not in nested_in_body
+    )
+    for fn, fn_in_body in roots:
         host = _host_callback_names(fn)
         taint = _Taint(fn)
+        if fn_in_body:
+            _seed_all_params(fn, taint)
         # Code under `raise`/`assert` runs at most once, at trace time — an
         # f-string or float() in an error message is not a per-step hazard.
         error_spans = [
@@ -747,9 +936,11 @@ def _compiled_scope_findings(mod: Module) -> dict[str, list[Finding]]:
             for n in _body_walk(fn, into_nested=True)
             if isinstance(n, (ast.Raise, ast.Assert))
         ]
-        for node in _compiled_statements(fn, host, taint):
+        for node, in_body, scope_taint in _compiled_statements(
+            fn, host, taint, loop_ids, fn_in_body
+        ):
             for rule in rules:
-                for f in rule.check_node(mod, node, taint):
+                for f in rule.check_node(mod, node, scope_taint, in_body):
                     if not any(lo <= f.line <= hi for lo, hi in error_spans):
                         findings[rule.code].append(f)
     mod._compiled_scope_findings = findings
@@ -765,7 +956,13 @@ class HostSyncRule(_CompiledScopeRule):
         "into io_callback/monitor accessors"
     )
 
-    def check_node(self, mod: Module, node: ast.AST, taint: _Taint) -> list[Finding]:
+    def check_node(
+        self,
+        mod: Module,
+        node: ast.AST,
+        taint: _Taint,
+        in_loop_body: bool = False,
+    ) -> list[Finding]:
         if not isinstance(node, ast.Call):
             return []
         out: list[Finding] = []
@@ -814,6 +1011,28 @@ class HostSyncRule(_CompiledScopeRule):
                     "— host sync (or trace-time ConcretizationError)",
                 )
             )
+        # Host callbacks are legitimate step-scope escapes (monitors stream
+        # history through io_callback) — but inside a lax.scan/fori_loop
+        # BODY they fire once per iteration and serialize the fused
+        # multi-generation segment against the host, defeating the fusion.
+        tail = chain.rsplit(".", 1)[-1]
+        if in_loop_body and tail in _HOST_CALLBACK_FNS:
+            out.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"`{tail}` inside a lax.scan/fori_loop body — one host "
+                    "round-trip per iteration serializes the fused segment; "
+                    "batch the data out as scan outputs and flush it at the "
+                    "segment boundary",
+                    hint=(
+                        "carry the payload out of the scan as a stacked "
+                        "output (telemetry) and do the host work once per "
+                        "segment — see StdWorkflow.run_segment / "
+                        "Monitor._capture"
+                    ),
+                )
+            )
         return out
 
 
@@ -826,7 +1045,13 @@ class TracedBranchRule(_CompiledScopeRule):
         "branches, jax.lax.while_loop for loops"
     )
 
-    def check_node(self, mod: Module, node: ast.AST, taint: _Taint) -> list[Finding]:
+    def check_node(
+        self,
+        mod: Module,
+        node: ast.AST,
+        taint: _Taint,
+        in_loop_body: bool = False,
+    ) -> list[Finding]:
         if isinstance(node, (ast.If, ast.While)) and taint.is_traced(node.test):
             kw = "if" if isinstance(node, ast.If) else "while"
             return [
@@ -850,7 +1075,13 @@ class RecompileHazardRule(_CompiledScopeRule):
         "fori_loop, and key caches by static config only"
     )
 
-    def check_node(self, mod: Module, node: ast.AST, taint: _Taint) -> list[Finding]:
+    def check_node(
+        self,
+        mod: Module,
+        node: ast.AST,
+        taint: _Taint,
+        in_loop_body: bool = False,
+    ) -> list[Finding]:
         out: list[Finding] = []
         if isinstance(node, ast.Call):
             chain = _dotted(node.func) or ""
@@ -936,7 +1167,13 @@ class ImpureStepRule(_CompiledScopeRule):
         "values belong in the State (`state.replace(...)`)"
     )
 
-    def check_node(self, mod: Module, node: ast.AST, taint: _Taint) -> list[Finding]:
+    def check_node(
+        self,
+        mod: Module,
+        node: ast.AST,
+        taint: _Taint,
+        in_loop_body: bool = False,
+    ) -> list[Finding]:
         targets: list[ast.expr] = []
         if isinstance(node, ast.Assign):
             targets = node.targets
