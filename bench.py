@@ -953,6 +953,75 @@ def bench_distributed_8dev_resilient(n_steps, profile_dir=None):
     return result
 
 
+def bench_scaling(n_steps, profile_dir=None):
+    """Weak-scaling efficiency ladder: gen/s/chip vs chips (ROADMAP item 4).
+
+    The MULTICHIP_r*.json artifacts only ever proved the sharded step RUNS
+    on a multi-chip mesh; this config tracks how well it SCALES.  Work per
+    chip is held constant (pop = 8192 x chips, the distributed PSO shape)
+    while the mesh doubles: 1, 2, 4, ... up to every visible device.  Ideal
+    weak scaling keeps gen/s flat as chips double (per-chip work constant,
+    one fitness all-gather per generation); the headline ``value`` is the
+    max-chip efficiency ``gen/s(n) / gen/s(1)``, so BENCH_HISTORY.json's
+    ``vs_baseline`` tracks scaling-efficiency drift — gated by
+    ``tools/check_scaling.py``.  Each rung also records gen/s/chip (the
+    per-chip cost of joining the collective) and the process count, so a
+    future ``jax.distributed`` multi-host sweep lands in the same artifact
+    shape as a single-host one."""
+    import jax
+
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    from evox_tpu.parallel import make_pop_mesh
+
+    n_total = len(jax.devices())
+    rungs = []
+    n = 1
+    while n <= n_total:
+        rungs.append(n)
+        n *= 2
+    if rungs[-1] != n_total:
+        rungs.append(n_total)  # non-power-of-2 pods still measure the max
+
+    per_chip_pop = 8192
+    ladder = {}
+    for n_dev in rungs:
+        pop = per_chip_pop * n_dev
+        lb, ub = _box(256)
+        wf = StdWorkflow(
+            PSO(pop, lb, ub),
+            Sphere(),
+            enable_distributed=True,
+            mesh=make_pop_mesh(n_dev),
+        )
+        gps, _ = _timed_steps(wf, n_steps)
+        ladder[str(n_dev)] = {
+            "gens_per_sec": round(gps, 3),
+            "per_chip": round(gps / n_dev, 3),
+            "pop": pop,
+        }
+        _log(
+            f"scaling: {n_dev} chip(s) pop={pop} -> {gps:.1f} gen/s "
+            f"({gps / n_dev:.1f}/chip)"
+        )
+    base = ladder[str(rungs[0])]["gens_per_sec"]
+    for rung in ladder.values():
+        rung["efficiency"] = round(rung["gens_per_sec"] / base, 3) if base else 0.0
+    efficiency = ladder[str(rungs[-1])]["efficiency"]
+    return {
+        "metric": (
+            f"Weak-scaling efficiency at {rungs[-1]} chips "
+            f"(distributed PSO, pop={per_chip_pop}/chip, dim=256, Sphere)"
+        ),
+        "value": efficiency,
+        "unit": "efficiency (gen/s vs 1 chip, constant work/chip)",
+        "n_devices": n_total,
+        "ladder": ladder,
+    }
+
+
 def bench_smoke(n_steps, profile_dir=None):
     del n_steps, profile_dir
     return run_smoke()
@@ -1003,6 +1072,7 @@ CONFIGS = {
     "vmapped_instances_resilient": (bench_vmapped_instances_resilient, 200, 50),
     "distributed_8dev": (bench_distributed_8dev, 100, 10),
     "distributed_8dev_resilient": (bench_distributed_8dev_resilient, 100, 10),
+    "scaling": (bench_scaling, 100, 10),
 }
 
 
@@ -1246,6 +1316,11 @@ def main() -> int:
         devices = jax.devices()
         if devices:
             result["device_kind"] = devices[0].device_kind
+        # Process count: single-host and multi-host (jax.distributed fleet)
+        # measurements of the same config must be distinguishable in the
+        # artifact record — per-chip numbers mean something different when
+        # the all-gather crosses DCN instead of ICI.
+        result["n_processes"] = int(jax.process_count())
         with open(args.json_out, "w") as f:
             json.dump(result, f)
         _log(f"child: {args.child} -> {result['value']} {result['unit']}")

@@ -9,13 +9,23 @@ the mesh natively.
 """
 
 __all__ = [
+    "FleetHealth",
+    "FleetReport",
+    "FleetTopology",
+    "HostHeartbeat",
+    "HostVerdict",
     "ShardedProblem",
+    "bootstrap_fleet",
     "find_sharded",
+    "fleet_barrier",
+    "gather_replicated",
     "init_multi_host",
+    "is_primary",
     "iter_problem_chain",
     "make_pop_mesh",
     "pad_population",
     "population_mask",
+    "read_heartbeats",
     "replicate",
     "shard_population",
     "shard_row_ids",
@@ -31,5 +41,17 @@ from .mesh import (
     shard_population,
     shard_row_ids,
     unpad_fitness,
+)
+from .multihost import (
+    FleetHealth,
+    FleetReport,
+    FleetTopology,
+    HostHeartbeat,
+    HostVerdict,
+    bootstrap_fleet,
+    fleet_barrier,
+    gather_replicated,
+    is_primary,
+    read_heartbeats,
 )
 from .sharded_problem import ShardedProblem, find_sharded, iter_problem_chain

@@ -58,6 +58,22 @@ This subsystem adds the missing layer:
   and per-individual PRNG streams fold the global slot index
   (``parallel/sharded_problem.py``).
 
+* Fleet supervision (``fleet.py``) — host-level resilience for
+  ``jax.distributed`` multi-host runs: :class:`FleetSupervisor` launches N
+  worker processes with the ``EVOX_TPU_FLEET_*`` bootstrap contract
+  (``evox_tpu.parallel.bootstrap_fleet``), watches exit codes plus the
+  heartbeat plane (``evox_tpu.parallel.FleetHealth``) for dead / wedged /
+  straggling hosts, stops survivors gracefully (SIGTERM → emergency
+  checkpoint at the boundary, SIGKILL after the grace window), and
+  relaunches on the surviving process count — elastic resume makes the
+  continued run bit-identical to an uninterrupted run at that world size.
+  Checkpoint I/O runs a single-writer discipline: process 0 publishes,
+  GCs, and quarantines; every other process holds a
+  :class:`~evox_tpu.utils.ReadOnlyCheckpointStore`.  Fleet chaos (host
+  SIGKILL, coordinator partition, per-host slowdown) lives in
+  :class:`FaultyProblem`'s ``kill_process_at`` /
+  ``partition_process_at`` / ``slow_process_at`` schedules.
+
 Non-finite fitness quarantine lives in the workflow layer itself
 (``StdWorkflow(quarantine_nonfinite=True)``, the default) so NaN/±Inf never
 silently propagate through ranking — see ``workflows/std_workflow.py``.
@@ -78,6 +94,14 @@ from .faults import (
     InjectedBackendError,
     InjectedFatalError,
     InjectedStorageError,
+)
+from .fleet import (
+    EX_PREEMPTED,
+    FleetError,
+    FleetStats,
+    FleetSupervisor,
+    WorkerSpec,
+    free_coordinator_port,
 )
 from .health import HealthProbe, HealthReport
 from .preemption import Preempted, PreemptionGuard
@@ -137,4 +161,10 @@ __all__ = [
     "InjectedBackendError",
     "InjectedFatalError",
     "InjectedStorageError",
+    "FleetSupervisor",
+    "FleetError",
+    "FleetStats",
+    "WorkerSpec",
+    "EX_PREEMPTED",
+    "free_coordinator_port",
 ]

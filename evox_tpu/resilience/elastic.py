@@ -283,7 +283,19 @@ def remesh_state(
     and ``pop_size`` to the leading dimension of ``state.algorithm.pop``
     when the state carries one; with no discoverable population the whole
     tree is replicated (correct, if not bandwidth-optimal — XLA re-shards
-    at the next ``shard_map`` entry)."""
+    at the next ``shard_map`` entry).
+
+    **Multi-process meshes** (a ``jax.distributed`` fleet re-meshing after
+    a host-count change) skip explicit placement entirely: ``device_put``
+    onto a sharding that spans other processes' devices is refused, and
+    the restored leaves are global host values anyway — the next jitted
+    dispatch places them under the new mesh.  Same values, placement one
+    dispatch later."""
+    if any(
+        getattr(d, "process_index", 0) != jax.process_index()
+        for d in mesh.devices.flat
+    ):
+        return state
     if axis_name is None:
         axis_name = str(mesh.axis_names[0])
     if pop_size is None:

@@ -60,6 +60,17 @@ replays deterministically):
   preemption actually kills a job.  Only meaningful under an installed
   :class:`~evox_tpu.resilience.PreemptionGuard` — without one the default
   handler terminates the test process.
+* **fleet chaos** — process-keyed faults for ``jax.distributed`` multi-host
+  runs: ``kill_process_at`` SIGKILLs the scheduled host outright (host
+  death — survivors wedge in their next collective),
+  ``partition_process_at`` freezes the scheduled host's progress while its
+  liveness heartbeat keeps beating (coordinator partition / wedged host),
+  and ``slow_process_at`` makes one host chronically slow (the cross-host
+  straggler; under ``eval_deadline`` each injected sleep is *abandoned*
+  after the deadline — fitness values are never altered, the collective
+  just keeps moving — and counted in the host-side ``deadline_trips``,
+  the per-host verdict a :class:`~evox_tpu.resilience.FleetSupervisor`
+  reads through the heartbeat plane).
 
 Transient faults are **attempt-counted on the host side**: a fault fires for
 its first ``*_times`` attempts of a given evaluation index and then stops,
@@ -159,6 +170,14 @@ class FaultyProblem(Problem):
         shards: int | None = None,
         eval_deadline: float | None = None,
         deadline_penalty: float = float("nan"),
+        kill_process_at: Mapping[int, Sequence[int]] | None = None,
+        kill_times: int = 1,
+        partition_process_at: Mapping[int, Sequence[int]] | None = None,
+        partition_seconds: float = 3600.0,
+        partition_times: int = 1,
+        slow_process_at: Mapping[int, Sequence[int]] | None = None,
+        slow_process_seconds: float = 1.0,
+        slow_process_times: int = 1,
     ):
         """
         :param nan_generations: evaluation indices whose fitness gets NaN
@@ -220,6 +239,42 @@ class FaultyProblem(Problem):
         :param deadline_penalty: fitness value substituted for a deadlined
             evaluation (default NaN, so the workflow quarantine penalizes
             and counts it).
+        :param kill_process_at: ``{process_index: evaluation indices}`` —
+            **fleet chaos**: the scheduled process sends itself a real
+            ``SIGKILL`` (no handler, no cleanup, no goodbye) for the first
+            ``kill_times`` attempts of each index, modeling host death /
+            OOM-kill / pod loss mid-run.  Keyed on ``jax.process_index()``
+            read on the host, so only the scheduled member of a
+            ``jax.distributed`` fleet dies; single-process runs die only
+            when index 0 is scheduled.  Survivors wedge in their next
+            collective — exactly the production signature a
+            :class:`~evox_tpu.resilience.FleetSupervisor` exists to
+            detect.  A relaunched worker constructs a NEW wrapper (attempt
+            counters are per-process memory), so key the schedule on the
+            supervisor's attempt number to model "the bad host left the
+            pool".
+        :param partition_process_at: ``{process_index: evaluation
+            indices}`` — fleet chaos: the scheduled process's host
+            callback sleeps ``partition_seconds`` (default: an hour — in
+            practice, forever), for the first ``partition_times`` attempts
+            of each index.  Models a network partition from the
+            coordinator / a wedged host: the process stays alive (its
+            heartbeat liveness thread keeps beating) while its generation
+            progress freezes — the supervisor's **wedged** verdict, as
+            opposed to the **dead** one.
+        :param slow_process_at: ``{process_index: evaluation indices}`` —
+            fleet chaos: the scheduled process's host callback sleeps
+            ``slow_process_seconds`` for the first ``slow_process_times``
+            attempts of each ``(process, eval)`` — one chronically slow
+            host stalling every peer's collective, the cross-host
+            straggler.  Combine with ``eval_deadline`` to exercise the
+            quarantine path: the deadline *abandons* each injected sleep
+            (unlike the host-fault channel there is no penalty-row
+            substitution — fitness values are never altered, the
+            collective just keeps moving after at most ``eval_deadline``
+            seconds) and bumps the worker's ``deadline_trips`` counter,
+            which — surfaced through its heartbeat — feeds the
+            supervisor's per-host **slow** verdict.
         """
         self.problem = problem
         self.nan_generations = tuple(int(g) for g in nan_generations)
@@ -268,6 +323,28 @@ class FaultyProblem(Problem):
             None if eval_deadline is None else float(eval_deadline)
         )
         self.deadline_penalty = float(deadline_penalty)
+        self.kill_process_at = {
+            int(p): frozenset(int(g) for g in gens)
+            for p, gens in (kill_process_at or {}).items()
+        }
+        self.kill_times = int(kill_times)
+        self.partition_process_at = {
+            int(p): frozenset(int(g) for g in gens)
+            for p, gens in (partition_process_at or {}).items()
+        }
+        self.partition_seconds = float(partition_seconds)
+        self.partition_times = int(partition_times)
+        self.slow_process_at = {
+            int(p): frozenset(int(g) for g in gens)
+            for p, gens in (slow_process_at or {}).items()
+        }
+        self.slow_process_seconds = float(slow_process_seconds)
+        self.slow_process_times = int(slow_process_times)
+        # Host-side count of eval-deadline expiries on THIS process — the
+        # per-host straggler self-report a worker surfaces through its
+        # heartbeat payload so the fleet supervisor can render a per-host
+        # slow verdict (multi-host straggler quarantine).
+        self.deadline_trips = 0
         # Set by StdWorkflow when this wrapper ends up sharing a program
         # with a shard_map it cannot see from its own chain (the
         # enable_distributed auto-wrap puts the ShardedProblem ABOVE us):
@@ -288,6 +365,17 @@ class FaultyProblem(Problem):
             or self.delay_generations
             or self.sigterm_generations
             or self.straggler_shards
+        )
+        # Fleet (process-keyed) faults ride a separate callback channel:
+        # a plain callback only executes on process 0's host in a
+        # multi-process program, so these dispatch through a shard_map'd
+        # callback that fires on every process (see evaluate).  Presence is
+        # keyed on the SCHEDULE, not the times, so a ``*_times=0``
+        # comparator run compiles the identical program.
+        self._has_fleet_faults = bool(
+            self.kill_process_at
+            or self.partition_process_at
+            or self.slow_process_at
         )
 
     def _mesh_in_chain(self) -> int | None:
@@ -358,6 +446,7 @@ class FaultyProblem(Problem):
         """Forget all attempt counts (faults re-arm)."""
         with self._lock:
             self._attempts.clear()
+            self.deadline_trips = 0
 
     def _corrupt_flag(self, gen) -> np.bool_:
         """Host side of the corruption schedule: True while the fault is
@@ -396,17 +485,55 @@ class FaultyProblem(Problem):
                     # straggler device stalls the all-gather barrier.
                     time.sleep(self.straggler_delay)
 
-    def _guarded_hook(self, gen) -> np.bool_:
-        """``_host_hook`` under the eval deadline: run it in an abandoned-on-
-        timeout daemon worker and report whether the deadline tripped.  A
-        worker that finishes in time re-raises its exception (error faults
-        keep their retry semantics); one that does not is left to die with
-        its sleep while the evaluation falls back to the penalty."""
+    def _fleet_hook(self, gen) -> None:
+        """Host side of the process-keyed fleet faults.
+
+        Fires on EVERY process's host (see the shard-mapped dispatch in
+        :meth:`evaluate` — a plain callback only executes on process 0 in a
+        multi-process program); only the scheduled ``jax.process_index()``
+        acts.  Reached once per *local shard* per evaluation, so the
+        ``*_times`` attempt counters absorb the multiplicity: times=1 means
+        "once per evaluation index", however many local shards bump it."""
+        g = int(gen)
+        proc = int(jax.process_index())
+        if g in self.kill_process_at.get(proc, ()):
+            if self._bump(f"kill{proc}", g) <= self.kill_times:
+                # A real SIGKILL to the real process: no handler runs, no
+                # checkpoint flushes — host death, the failure the fleet
+                # supervisor exists for.
+                os.kill(os.getpid(), signal.SIGKILL)
+        if g in self.partition_process_at.get(proc, ()):
+            if self._bump(f"partition{proc}", g) <= self.partition_times:
+                # Alive but unreachable: generation progress freezes while
+                # the liveness heartbeat keeps beating — the wedged-host
+                # (coordinator partition) signature.
+                time.sleep(self.partition_seconds)
+        if g in self.slow_process_at.get(proc, ()):
+            if self._bump(f"slowproc{proc}", g) <= self.slow_process_times:
+                # One chronically slow host stalls every peer's collective
+                # (the cross-host straggler); under eval_deadline the sleep
+                # runs inside the deadline guard and is abandoned to
+                # penalty rows + a deadline_trips bump instead.
+                if self.eval_deadline is not None:
+                    self._deadline_guarded(
+                        lambda: time.sleep(self.slow_process_seconds)
+                    )
+                else:
+                    time.sleep(self.slow_process_seconds)
+
+    def _deadline_guarded(self, fn) -> bool:
+        """Run ``fn()`` in an abandoned-on-timeout daemon worker; returns
+        whether the eval deadline tripped.  A worker that finishes in time
+        re-raises its exception (error faults keep their retry semantics);
+        one that does not is left to die with its sleep.  Every trip is
+        counted in ``deadline_trips`` — the per-host straggler self-report
+        a worker surfaces through its heartbeat so the fleet supervisor
+        can quarantine the slow host at a segment boundary."""
         result: dict = {}
 
         def target() -> None:
             try:
-                self._host_hook(gen)
+                fn()
             except BaseException as e:  # noqa: BLE001 - re-raised below
                 result["error"] = e
 
@@ -416,10 +543,17 @@ class FaultyProblem(Problem):
         worker.start()
         worker.join(self.eval_deadline)
         if worker.is_alive():
-            return np.bool_(True)
+            with self._lock:
+                self.deadline_trips += 1
+            return True
         if "error" in result:
             raise result["error"]
-        return np.bool_(False)
+        return False
+
+    def _guarded_hook(self, gen) -> np.bool_:
+        """``_host_hook`` under the eval deadline: the evaluation falls
+        back to the penalty when the deadline trips."""
+        return np.bool_(self._deadline_guarded(lambda: self._host_hook(gen)))
 
     # -- component protocol ------------------------------------------------
     def setup(self, key: jax.Array) -> State:
@@ -447,9 +581,55 @@ class FaultyProblem(Problem):
             fit,
         )
 
+    def _dispatch_fleet_hook(self, gen: jax.Array) -> None:
+        """Trace the process-keyed fleet-fault callback so it fires on
+        EVERY process's host.
+
+        A plain (unsharded) callback op executes only on process 0 in a
+        multi-process program — a kill scheduled for process 2 would never
+        fire.  When a mesh is on the WRAPPED chain (below us — so this
+        evaluate traces outside any shard body) and the fleet is real
+        (``process_count > 1``), the hook is traced inside a trivial
+        ``shard_map`` over that mesh, so each process's local shards invoke
+        it on their own host (the ``*_times`` counters absorb the
+        per-shard multiplicity).  Inside an ``enable_distributed``
+        auto-wrap the mesh is ABOVE us — evaluate() already traces in the
+        shard body, so the plain unordered callback fires per shard on
+        every process; single-process programs have only one host.  Note
+        ``in_sharded_program`` cannot discriminate here: it is set whenever
+        the program contains a shard_map *anywhere* (it governs callback
+        ordering, not placement) — below-the-wrapper meshes set it too."""
+        from ..parallel import find_sharded
+
+        sharded = find_sharded(self.problem)
+        # Sanctioned GL007 site: process_count() is FLEET-UNIFORM (the same
+        # value on every host), so this trace-time branch picks the same
+        # callback placement on every process — no divergent tracing.  The
+        # rule exists for process_index()-style branches, which do differ.
+        if sharded is not None and int(jax.process_count()) > 1:  # graftlint: disable=GL007
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.sharded_problem import _CHECK_KW, _shard_map
+
+            def _hook_shard(g):
+                io_callback(self._fleet_hook, None, g, ordered=False)
+                return g
+
+            _shard_map(
+                _hook_shard,
+                mesh=sharded.mesh,
+                in_specs=P(),
+                out_specs=P(),
+                **{_CHECK_KW: False},
+            )(gen)
+        else:
+            io_callback(self._fleet_hook, None, gen, ordered=False)
+
     def evaluate(self, state: State, pop: jax.Array) -> tuple[jax.Array, State]:
         gen = state.fault_generation
         timed_out = None
+        if self._has_fleet_faults:
+            self._dispatch_fleet_hook(gen)
         if self._has_host_faults:
             # Ordered + pinned to one device: fires exactly once per
             # evaluation, in program order, like a real backend fault would.

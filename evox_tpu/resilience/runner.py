@@ -57,7 +57,7 @@ import time
 import warnings
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Callable, Union
+from typing import Any, Callable, Union
 
 import jax
 
@@ -308,13 +308,23 @@ def scan_checkpoints(
         if verify:
             try:
                 verify_checkpoint(path)
+            except FileNotFoundError:
+                # The file vanished between the listing and the read: a
+                # concurrent cleaner (the fleet's primary process GC-ing or
+                # quarantining while a read-only peer scans) got there
+                # first.  Not this scanner's candidate, not this scanner's
+                # problem.
+                rejected.append(
+                    (path, "vanished during scan (concurrent cleaner)", False)
+                )
+                continue
             except CheckpointCorruptError as e:
                 renamed = False
                 if quarantine:
                     try:
                         store.rename(path, _quarantine_target(path))
                         renamed = True
-                    except OSError:  # pragma: no cover - racing cleaners
+                    except OSError:  # racing cleaners / read-only store
                         pass
                 rejected.append((path, str(e), renamed))
                 continue
@@ -403,6 +413,8 @@ class ResilientRunner:
         verify_resume: bool = True,
         fused: bool = True,
         fused_early_stop: bool = False,
+        primary: bool | None = None,
+        heartbeat: Any | None = None,
     ):
         """
         :param workflow: any ``Workflow`` whose ``init_step``/``step`` are
@@ -544,6 +556,26 @@ class ResilientRunner:
             reproducible against itself, but not bit-identical to a
             ``fused=False`` (or early-stop-off) run of the same
             configuration.
+        :param primary: whether this process holds the fleet's
+            **single-writer** role for the checkpoint directory.  Defaults
+            to ``evox_tpu.parallel.is_primary()`` — ``True`` for every
+            single-process run, and for process 0 of a
+            ``jax.distributed`` fleet.  A non-primary runner computes the
+            identical trajectory (checkpoint *decisions* are replicated)
+            but performs **no mutating directory operation**: no publish,
+            no GC, no ``*.corrupt`` quarantine rename — its store is
+            swapped for a
+            :class:`~evox_tpu.utils.ReadOnlyCheckpointStore`, so even a
+            code path that slips past the gating is refused at the seam.
+            Resume still *reads* the primary's checkpoints on every
+            process.
+        :param heartbeat: optional
+            :class:`~evox_tpu.parallel.HostHeartbeat` (or any object with
+            a compatible ``beat``) the runner publishes progress through:
+            one beat per segment boundary carrying the completed
+            generation and the segment's execution seconds — the signal a
+            :class:`~evox_tpu.resilience.FleetSupervisor` renders into
+            per-host dead/wedged/slow verdicts.
         """
         if checkpoint_every < 1:
             raise ValueError(
@@ -582,7 +614,23 @@ class ResilientRunner:
         self.restart = restart
         self.max_restarts = int(max_restarts)
         self.remesh = bool(remesh)
-        self.store = store if store is not None else CheckpointStore()
+        if primary is None:
+            # One definition of the single-writer role (multi-host fleets):
+            # process 0 writes, everyone else is read-only.
+            from ..parallel.multihost import is_primary
+
+            primary = is_primary()
+        self.primary = bool(primary)
+        if self.primary:
+            self.store = store if store is not None else CheckpointStore()
+        else:
+            # Belt and braces under the CheckpointStore seam: even a code
+            # path that slips past the primary gating below cannot mutate
+            # the directory from a non-primary process.
+            from ..utils.checkpoint import ReadOnlyCheckpointStore
+
+            self.store = ReadOnlyCheckpointStore()
+        self.heartbeat = heartbeat
         self.verify_resume = bool(verify_resume)
         self.checkpoint_wall_interval = checkpoint_wall_interval
         # ``preemption=True`` builds a guard the runner OWNS: each run()
@@ -600,7 +648,7 @@ class ResilientRunner:
                 durable=True,
                 on_error=self._note_write_failure,
             )
-            if async_checkpoints
+            if async_checkpoints and self.primary
             else None
         )
         # Fused segments need the workflow to expose the segment builder
@@ -739,8 +787,9 @@ class ResilientRunner:
         only *after* a successful durable publish (inline on the sync
         path, from the writer's post-publish hook on the async path), so
         the last valid checkpoint can never be deleted ahead of its
-        successor existing on disk."""
-        if not self.keep_checkpoints:
+        successor existing on disk.  Single-writer discipline: only the
+        fleet's primary process ever GCs."""
+        if not self.keep_checkpoints or not self.primary:
             return
         numbered = _numbered_checkpoints(self.checkpoint_dir)
         for _, stale in numbered[: -self.keep_checkpoints]:
@@ -754,6 +803,46 @@ class ResilientRunner:
         writer / pending work)."""
         if self._writer is not None:
             self._writer.barrier()
+
+    def _fleet_sync(self) -> None:
+        """Cross-host barrier at points where the single writer's disk
+        state is about to be read fleet-wide (restart policies scanning
+        the checkpoint directory).  No-op for single-process runs.  Every
+        process reaches these call sites under identical control flow —
+        boundary verdicts are pure functions of the replicated state — so
+        the collective always matches up."""
+        if jax.process_count() <= 1:
+            return
+        from ..parallel.multihost import fleet_barrier
+
+        fleet_barrier("evox_tpu_runner_boundary")
+
+    def _gather_state(self, state: State) -> State:
+        """Make every state leaf process-addressable at a segment boundary.
+
+        A multi-process program can hand back leaves sharded across hosts;
+        boundary work (checkpoint serialization, restart policies, the
+        final return) needs the full value on every host.  This is a
+        collective (one all-gather per sharded leaf), executed by ALL
+        processes at the same boundary — and it is also what keeps fleet
+        runs bit-identical to their resumed reruns: every segment starts
+        from a host-replicated state, exactly the placement a
+        checkpoint-restored state has.  Single-process runs (and fleets
+        whose state stayed replicated) pass through untouched."""
+        if jax.process_count() <= 1:
+            return state
+        from ..parallel.multihost import gather_replicated
+
+        return gather_replicated(state)
+
+    def _beat(self, generation: int) -> None:
+        """Publish a heartbeat progress beat for this boundary (no-op
+        without a heartbeat)."""
+        if self.heartbeat is not None:
+            self.heartbeat.beat(
+                generation=int(generation),
+                segment_seconds=self._last_exec_seconds,
+            )
 
     def _write_checkpoint(
         self,
@@ -771,7 +860,13 @@ class ResilientRunner:
         the success event, and GC happen on the writer thread.  Emergency
         writes (preemption) are synchronous — the process is about to exit,
         so "submitted" is not good enough.  Returns whether a synchronous
-        write succeeded (always True for async submissions)."""
+        write succeeded (always True for async submissions).
+
+        Single-writer discipline: a non-primary fleet process returns
+        ``True`` without touching the directory — the primary's write of
+        the identical (replicated) state IS this boundary's checkpoint."""
+        if not self.primary:
+            return True
         self.checkpoint_dir.mkdir(parents=True, exist_ok=True)
         path = self._ckpt_path(generation)
         metadata = self._manifest_extras(probed)
@@ -892,7 +987,10 @@ class ResilientRunner:
         candidates, rejected = scan_checkpoints(
             self.checkpoint_dir,
             verify=self.verify_resume,
-            quarantine=self.verify_resume,
+            # Quarantine renames are directory mutations: primary-only
+            # (a read-only store would refuse them anyway — the flag keeps
+            # the scan from even trying).
+            quarantine=self.verify_resume and self.primary,
             store=self.store,
         )
         for path, reason, quarantined in rejected:
@@ -905,6 +1003,13 @@ class ResilientRunner:
                         f"manifest generation {manifest['generation']} does "
                         f"not match filename generation {gen}"
                     )
+            except FileNotFoundError:
+                # Concurrent-cleaner race (fleet primary GC vs read-only
+                # scanner): the candidate vanished — fall back, don't die.
+                self._skip_candidate(
+                    path, "vanished during resume (concurrent cleaner)"
+                )
+                continue
             except (CheckpointError, ValueError) as e:
                 self._skip_candidate(path, str(e))
                 continue
@@ -954,6 +1059,11 @@ class ResilientRunner:
                 # value for new leaves (with a warning) instead of losing
                 # the whole run to a schema bump.
                 state = load_state(path, candidate_template, allow_missing=True)
+            except FileNotFoundError:
+                self._skip_candidate(
+                    path, "vanished during resume (concurrent cleaner)"
+                )
+                continue
             except CheckpointCorruptError as e:
                 # Byte damage surfacing only at restore time (verify off, or
                 # damage the digest pass cannot see): same quarantine as the
@@ -1245,7 +1355,11 @@ class ResilientRunner:
         # directory for candidates): flush the boundary's in-flight async
         # write first, so the policy sees the same directory a synchronous
         # writer would have produced — and its decisions stay replayable.
+        # In a fleet, additionally barrier the other hosts on the primary's
+        # flush: a non-primary policy must never scan a directory the
+        # single writer is still publishing into.
         self._barrier_writer()
+        self._fleet_sync()
         idx = len(self.stats.restarts)
         ctx = RestartContext(
             runner=self,
@@ -1304,12 +1418,16 @@ class ResilientRunner:
         # lands before we enumerate the directory for the invalidation.
         self._write_checkpoint(new_state, new_done, probed=not needs_init)
         self._barrier_writer()
-        for gen, path in _numbered_checkpoints(self.checkpoint_dir):
-            if gen > new_done:
-                try:
-                    self.store.unlink(path)
-                except OSError:  # pragma: no cover - racing cleaners
-                    pass
+        if self.primary:
+            for gen, path in _numbered_checkpoints(self.checkpoint_dir):
+                if gen > new_done:
+                    try:
+                        self.store.unlink(path)
+                    except OSError:  # pragma: no cover - racing cleaners
+                        pass
+        # Fleet lockstep: non-primary hosts must not run on past a restart
+        # while the single writer is still invalidating the stale future.
+        self._fleet_sync()
         self.stats.completed_generations = new_done
         if needs_init:
             # The post-init state is a fresh boundary of its own: probe it
@@ -1470,11 +1588,13 @@ class ResilientRunner:
     def _run_supervised(self, state: State, n_steps: int, fresh: bool) -> State:
         done = 0
         probed = False
-        if fresh and self.checkpoint_dir.is_dir():
+        if fresh and self.primary and self.checkpoint_dir.is_dir():
             # Clear the old lineage: stale higher-generation files would
             # otherwise survive pruning (which keeps the N highest numbers)
             # and hijack the next resume.  Quarantined files go too — they
             # are evidence of the OLD lineage's storage, not this run's.
+            # Single-writer: only the primary clears (fresh runs never read
+            # the directory, so peers have nothing to race).
             self._barrier_writer()
             for _, path in _numbered_checkpoints(self.checkpoint_dir):
                 try:
@@ -1499,14 +1619,20 @@ class ResilientRunner:
                 self.stats.resumed_from_generation = done
                 self.stats.completed_generations = done
                 probed = self._resumed_probed
+                # Publish a progress beat immediately: a fleet supervisor
+                # watching a relaunched worker must see it land on its
+                # resume point, not wait a whole first segment.
+                self._beat(done)
         if done == 0:
             state = self._attempt(
                 "init", state, 0, "init_step (generation 1)"
             )
+            state = self._gather_state(state)
             done = 1
             self.stats.segments_run += 1
             self.stats.completed_generations = done
             self._write_checkpoint(state, done)
+            self._beat(done)
             probed = False
         while True:
             # Preemption is checked at every boundary, BEFORE more work is
@@ -1545,6 +1671,12 @@ class ResilientRunner:
                 # Debug path, or the shared single-step ragged tail (see
                 # _segment): the result is the bare state.
                 state, stepped = result, chunk
+            # Boundary gather (multi-process fleets only): leaves the
+            # program left sharded across hosts come back addressable, so
+            # checkpointing, probes, and restart policies see full values —
+            # and every segment starts from the same host-replicated
+            # placement a resumed run starts from (bit-identity).
+            state = self._gather_state(state)
             # Adapt on the EXECUTION seconds of this segment (compile time
             # excluded — see _execute_once), normalized by the generations
             # that actually ran.
@@ -1554,6 +1686,7 @@ class ResilientRunner:
             self.stats.chunk_sizes.append(stepped)
             self.stats.completed_generations = done
             self._write_checkpoint(state, done)
+            self._beat(done)
             probed = False
         return state
 
@@ -1567,7 +1700,9 @@ class ResilientRunner:
         never duplicate history entries), and the early-stop accounting.
         Returns ``(state, generations_actually_executed)``."""
         state, telemetry = result
-        host = jax.device_get(telemetry)
+        # Telemetry leaves can come back process-sharded like state leaves
+        # (the gather no-ops single-process and on replicated trees).
+        host = jax.device_get(self._gather_state(telemetry))
         self.workflow.flush_telemetry(host)
         executed = int(host["executed"])
         if bool(host["stopped"]) and executed < chunk:

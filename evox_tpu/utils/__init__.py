@@ -24,6 +24,7 @@ from .checkpoint import (
     CheckpointCorruptError,
     CheckpointError,
     CheckpointStore,
+    ReadOnlyCheckpointStore,
     load_state,
     read_manifest,
     save_state,
@@ -56,6 +57,7 @@ __all__ = [
     "CheckpointError",
     "CheckpointCorruptError",
     "CheckpointStore",
+    "ReadOnlyCheckpointStore",
     "AsyncCheckpointWriter",
     "register_vmap_op",
     "host_op",

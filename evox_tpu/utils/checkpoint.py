@@ -76,6 +76,7 @@ __all__ = [
     "CheckpointError",
     "CheckpointCorruptError",
     "CheckpointStore",
+    "ReadOnlyCheckpointStore",
     "AsyncCheckpointWriter",
 ]
 
@@ -156,6 +157,49 @@ class CheckpointStore:
     def rename(self, src: Union[str, Path], dst: Union[str, Path]) -> None:
         """Move a file aside (the resume scan's ``*.corrupt`` quarantine)."""
         os.replace(src, dst)
+
+
+class ReadOnlyCheckpointStore(CheckpointStore):
+    """A store that refuses every mutating operation — the non-primary side
+    of a multi-host fleet's **single-writer discipline**.
+
+    In a fleet, exactly one process (process 0 — see
+    ``evox_tpu.parallel.is_primary``) owns the checkpoint directory: it
+    publishes, garbage-collects, and quarantines.  Every other process
+    holds one of these instead, so a non-primary scanner can *read* the
+    directory (reads never route through the store) but any attempted
+    publish, GC ``unlink``, or ``*.corrupt`` quarantine ``rename`` raises
+    ``OSError(EROFS)`` — which the resilience layer's existing
+    ``except OSError`` guards turn into clean no-ops.  Two processes
+    scanning the same directory therefore cannot double-quarantine a
+    corrupt file or race each other's renames
+    (``tests/test_multihost.py::test_concurrent_scanners_single_rename``).
+    """
+
+    def __init__(self, reason: str = "non-primary fleet process"):
+        self.reason = str(reason)
+
+    def _refuse(self, op: str) -> "OSError":
+        import errno
+
+        return OSError(
+            errno.EROFS,
+            f"checkpoint store is read-only ({self.reason}): {op} refused — "
+            f"only the fleet's primary process mutates the checkpoint "
+            f"directory",
+        )
+
+    def open_temp(self, directory, prefix):
+        raise self._refuse("write")
+
+    def publish(self, tmp, final):
+        raise self._refuse("publish")
+
+    def unlink(self, path):
+        raise self._refuse(f"unlink of {path}")
+
+    def rename(self, src, dst):
+        raise self._refuse(f"rename of {src}")
 
 
 _DEFAULT_STORE = CheckpointStore()

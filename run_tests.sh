@@ -38,6 +38,18 @@
 #                               throughput keeps ≥90% of a bare fused loop
 #                               on the PSO Ackley config (artifact under
 #                               bench_artifacts/)
+#   ./run_tests.sh --multihost  multi-host fleet lane: the fast multihost
+#                               suite (FleetTopology/bootstrap/heartbeat/
+#                               verdict plumbing, single-writer checkpoint
+#                               discipline, supervisor decision logic), then
+#                               the REAL subprocess fleets — N local workers
+#                               rendezvous on a loopback coordinator with
+#                               gloo CPU collectives, get SIGKILLed / slowed
+#                               / partitioned mid-run, and the supervisor's
+#                               resumed run is asserted bit-identical to an
+#                               uninterrupted one.  The whole lane runs
+#                               under a HARD wall-clock timeout: a wedged
+#                               fleet is a test failure, never a hang.
 #   ./run_tests.sh --health     health/restart lane: run-health diagnostics +
 #                               restart-policy suite, then the CPU
 #                               microbenchmark asserting the between-chunk
@@ -45,7 +57,7 @@
 #                               200-generation run (artifact written under
 #                               bench_artifacts/)
 #   ./run_tests.sh --lint       repo lints: the graftlint static-analysis
-#                               suite (GL000 assert ratchet + GL001-GL005
+#                               suite (GL000 assert ratchet + GL001-GL007
 #                               JAX-purity rules), then the lint test suite
 #                               incl. the compile-cache sentinel gate (an
 #                               algorithm matrix must compile exactly once
@@ -77,6 +89,17 @@ if [ "$1" = "--fused" ]; then
   "${CPU_ENV[@]}" python -m pytest \
     tests/test_fused_segment.py tests/test_compile_sentinel.py -q "$@" || exit 1
   exec "${CPU_ENV[@]}" python tools/bench_fused_overhead.py
+fi
+if [ "$1" = "--multihost" ]; then
+  shift
+  # Hard timeout (SIGKILL escalation): a deadlocked collective anywhere in
+  # the subprocess fleets must fail the lane loudly, not hang CI.  The
+  # supervisor's own attempt_timeout fires far earlier; this is the
+  # backstop for a wedge in pytest/JAX itself.
+  MULTIHOST_TIMEOUT="${EVOX_TPU_MULTIHOST_TIMEOUT:-1800}"
+  exec timeout -k 30 "$MULTIHOST_TIMEOUT" \
+    "${CPU_ENV[@]}" python -m pytest \
+    tests/test_multihost.py -q "$@"
 fi
 if [ "$1" = "--health" ]; then
   shift

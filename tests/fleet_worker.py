@@ -1,0 +1,187 @@
+"""Fleet worker driven by ``tests/test_multihost.py``.
+
+One process of a :class:`~evox_tpu.resilience.FleetSupervisor`-managed
+``jax.distributed`` fleet: it bootstraps into the process group the
+supervisor's ``EVOX_TPU_FLEET_*`` environment describes, runs a
+population-sharded PSO under a :class:`~evox_tpu.resilience.ResilientRunner`
+against the shared checkpoint directory, publishes heartbeats, and — on the
+primary process — dumps the final state bitwise so the test can compare
+fleets against uninterrupted references.
+
+Invocation (built by the test's ``command`` callable)::
+
+    python fleet_worker.py <checkpoint_dir> <config.json>
+
+Config keys: ``n_steps``, ``pop``, ``dim``, ``checkpoint_every``, ``seed``,
+optional ``eval_deadline`` and a ``faults`` table keyed by supervisor
+attempt::
+
+    {"faults": {"0": {"kill": {"3": [3]}},        # attempt 0: SIGKILL host 3
+                "1": {"slow": {"1": [2, 3, 4]}}}}  # attempt 1: host 1 slow
+
+Exit codes: 0 = run complete; 75 (``EX_PREEMPTED``) = gracefully stopped by
+the supervisor's SIGTERM (resumable); anything else = failure.
+
+Importing this module (and the ``evox_tpu`` package) does NOT create a JAX
+backend — ``main()`` still bootstraps the process group before the first
+backend-touching call, which is the contract ``bootstrap_fleet`` needs.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evox_tpu.core import Problem, State
+from evox_tpu.parallel.multihost import FLEET_ENV_HEARTBEAT_DIR, bootstrap_fleet
+
+
+class NoisySphere(Problem):
+    """Stochastic eval keyed by state — the per-individual global-slot PRNG
+    folds are what make the trajectory topology-invariant, so the fleet
+    comparison is a real PRNG-stream test, not just determinism.  (Also
+    imported by ``tests/test_multihost.py`` for its in-process reference.)"""
+
+    def setup(self, key):
+        return State(key=key)
+
+    def evaluate(self, state, pop):
+        next_key, draw_key = jax.random.split(state.key)
+        noise = jax.random.normal(draw_key, (pop.shape[0],))
+        fit = jnp.sum(pop**2, axis=-1) + 0.1 * noise
+        return fit, state.replace(key=next_key)
+
+
+def _final_payload(state):
+    """Bitwise-comparable dump of the algorithm + monitor sub-states: every
+    array leaf keyed by its tree path (PRNG keys via their raw key data)."""
+    out = {}
+    for section in ("algorithm", "monitor"):
+        if section not in state:
+            continue
+        leaves = jax.tree_util.tree_flatten_with_path(state[section])[0]
+        for path, leaf in leaves:
+            key = section + jax.tree_util.keystr(path)
+            if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+                leaf.dtype, jax.dtypes.prng_key
+            ):
+                leaf = jax.random.key_data(leaf)
+            out[key] = np.asarray(leaf)
+    return out
+
+
+def main(argv):
+    checkpoint_dir = Path(argv[1])
+    with open(argv[2]) as f:
+        cfg = json.load(f)
+
+    # Join (or skip joining) the fleet BEFORE any backend-touching JAX API —
+    # bootstrap_fleet reads the supervisor's environment contract and
+    # selects gloo CPU collectives so local subprocesses can compute.
+    topo = bootstrap_fleet()
+
+    # Same persistent compile cache tests/conftest.py uses: every worker of
+    # every attempt compiles the same tiny programs — without this, a fleet
+    # test pays the full XLA compile once per process per relaunch.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            str(Path(__file__).resolve().parent.parent / ".jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.parallel import HostHeartbeat, ShardedProblem, make_pop_mesh
+    from evox_tpu.resilience import (
+        FaultyProblem,
+        Preempted,
+        ResilientRunner,
+        RetryPolicy,
+    )
+    from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+    dim = int(cfg.get("dim", 4))
+    pop = int(cfg.get("pop", 24))
+    lb, ub = -5.0 * jnp.ones(dim), 5.0 * jnp.ones(dim)
+
+    # The mesh spans every device of every process in the fleet; the
+    # population is sharded across it, algorithm state stays replicated.
+    mesh = make_pop_mesh()
+    inner = ShardedProblem(NoisySphere(), mesh)
+
+    # This attempt's fault schedule (chaos is keyed on the supervisor
+    # attempt so a removed host's faults leave the pool with it).  The
+    # FaultyProblem wrapper is always present so chaos and clean attempts
+    # trace the same program shape.
+    faults = (cfg.get("faults") or {}).get(str(topo.attempt), {})
+
+    def _sched(name):
+        return {int(p): tuple(g) for p, g in (faults.get(name) or {}).items()}
+
+    prob = FaultyProblem(
+        inner,
+        kill_process_at=_sched("kill"),
+        slow_process_at=_sched("slow"),
+        slow_process_seconds=float(cfg.get("slow_seconds", 1.0)),
+        slow_process_times=int(cfg.get("slow_times", 1)),
+        partition_process_at=_sched("partition"),
+        eval_deadline=cfg.get("eval_deadline"),
+    )
+    mon = EvalMonitor(full_fit_history=False)
+    wf = StdWorkflow(PSO(pop, lb, ub), prob, monitor=mon)
+
+    heartbeat = HostHeartbeat(
+        os.environ[FLEET_ENV_HEARTBEAT_DIR],
+        topo.process_index,
+        interval=0.25,
+        # Per-host straggler self-report: every eval-deadline expiry on
+        # THIS host rides the beat payload into the supervisor's verdicts.
+        extra=lambda: {"deadline_trips": prob.deadline_trips},
+    ).start()
+
+    runner = ResilientRunner(
+        wf,
+        checkpoint_dir,
+        checkpoint_every=int(cfg.get("checkpoint_every", 2)),
+        preemption=True,  # supervisor SIGTERM -> graceful boundary stop
+        heartbeat=heartbeat,
+        # A collective that lost its peer cannot be retried in-process:
+        # fail fast and let the SUPERVISOR relaunch the surviving world.
+        retry=RetryPolicy(max_retries=0),
+    )
+    state = wf.init(jax.random.key(int(cfg.get("seed", 0))))
+    try:
+        final = runner.run(state, n_steps=int(cfg["n_steps"]))
+    except Preempted:
+        return 75  # EX_PREEMPTED: resumable, not broken
+    finally:
+        heartbeat.stop()
+
+    if topo.process_index == 0:
+        np.savez(checkpoint_dir / "final_state.npz", **_final_payload(final))
+        with open(checkpoint_dir / "final_summary.json", "w") as f:
+            json.dump(
+                {
+                    "attempt": topo.attempt,
+                    "world": topo.num_processes,
+                    "resumed_from_generation": (
+                        runner.stats.resumed_from_generation
+                    ),
+                    "restarts": len(runner.stats.restarts),
+                    "completed_generations": (
+                        runner.stats.completed_generations
+                    ),
+                },
+                f,
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

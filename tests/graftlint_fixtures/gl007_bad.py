@@ -1,0 +1,35 @@
+"""GL007 true positives: process-identity branching inside compiled scope —
+each host of a jax.distributed fleet traces a different program and the
+mismatched collectives deadlock the whole fleet."""
+
+import jax
+import jax.numpy as jnp
+
+
+def step(state):
+    # The classic single-writer mistake: gating COMPILED work on the
+    # process identity — process 0 compiles a program with the extra sum,
+    # everyone else compiles one without it.
+    if jax.process_index() == 0:  # GL007
+        state = state.replace(best=jnp.sum(state.pop))
+    return state
+
+
+def evaluate(state, pop):
+    fit = jnp.sum(pop**2, axis=-1)
+    # Derived through an assignment: laundering the identity through a
+    # name does not make it traced-safe.
+    rank = jax.process_index()
+    is_writer = rank == 0
+    if is_writer:  # GL007
+        fit = fit + 0.0
+    return fit, state
+
+
+def tell(state, fitness):
+    # process_count-derived loop bound: a 4-host fleet unrolls a different
+    # program than a 2-host fleet, and a resumed (shrunk) fleet recompiles
+    # into collectives the checkpointed trajectory never had.
+    while jax.process_count() > 1:  # GL007
+        fitness = fitness * 0.5
+    return state.replace(fit=fitness)

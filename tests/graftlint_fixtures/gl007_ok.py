@@ -1,0 +1,44 @@
+"""GL007 negatives: sanctioned process-identity use that must stay clean —
+host-side supervisor branching (outside compiled scope), process-keyed
+logic inside host callbacks, and process identity consumed as data."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+
+def publish_checkpoint(path, state):
+    # Host-side single-writer gating at a segment boundary: not compiled
+    # scope (not in the step family), exactly where the branch belongs.
+    if jax.process_index() == 0:
+        return path
+    return None
+
+
+def supervise(world):
+    # Supervisor code branching on the world size: host-side, fine.
+    if jax.process_count() > 1:
+        return "fleet"
+    return "single"
+
+
+def evaluate(state, pop):
+    fit = jnp.sum(pop**2, axis=-1)
+
+    def fleet_hook(gen):
+        # Process-keyed fault/telemetry logic inside a host callback: the
+        # hook runs on the host, where per-process branching is the point.
+        if jax.process_index() == 1:
+            print("host 1 reached", int(gen))
+
+    io_callback(fleet_hook, None, state.generation, ordered=False)
+    return fit, state
+
+
+def step(state):
+    # Process identity consumed as DATA (no Python branching): every host
+    # traces the identical program; the value differs at runtime, which is
+    # fine — lax.cond is a traced branch, not a trace-time fork.
+    rank = jnp.asarray(jax.process_index())
+    bonus = jnp.where(rank == 0, 1.0, 0.0)
+    return state.replace(best=jnp.sum(state.pop) + bonus)

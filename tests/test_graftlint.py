@@ -505,7 +505,7 @@ def test_new_rules_start_at_zero():
         (REPO / "tools" / "graftlint" / "baseline.json").read_text()
     )
     assert sorted(committed) == [
-        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
     ]
     assert all(files == {} for files in committed.values()), (
         "GL001+ baselines must stay empty — fix or pragma new findings "
@@ -545,6 +545,57 @@ def test_gl006_flags_shard_index_fold_regression(tmp_path):
     )
     found = _findings(src, ["GL006"])
     assert [f.rule for f in found] == ["GL006"], [f.format() for f in found]
+
+
+def test_gl007_guards_fleet_dispatch():
+    """The one sanctioned GL007 site — FaultyProblem's fleet-hook dispatch,
+    which branches on the FLEET-UNIFORM process_count() (same value on
+    every host, so no divergent tracing) — must be (a) visible to the raw
+    rule, proving the rule reaches evaluate()'s same-module call closure,
+    and (b) pragma-suppressed so the suite stays clean."""
+    rule = RULES_BY_CODE["GL007"]
+    mod = Module(REPO / "evox_tpu" / "resilience" / "faults.py")
+    raw = rule.check(mod)
+    assert len(raw) == 1, [f.format() for f in raw]
+    assert all(mod.suppressed(f) for f in raw)
+    # Suite-level: nothing unsuppressed anywhere in the library.
+    assert not scan_paths([REPO / "evox_tpu"], [rule])
+
+
+def test_gl007_host_callback_branching_is_exempt(tmp_path):
+    """Process-keyed branching inside an io_callback host function — the
+    fleet-fault / single-writer pattern — must stay clean: it runs on the
+    host, where per-process behavior is the point."""
+    src = tmp_path / "hostok.py"
+    src.write_text(
+        "import jax\n"
+        "from jax.experimental import io_callback\n"
+        "def evaluate(state, pop):\n"
+        "    def hook(g):\n"
+        "        if jax.process_index() == 2:\n"
+        "            print(int(g))\n"
+        "    io_callback(hook, None, state.generation, ordered=False)\n"
+        "    return pop.sum(), state\n"
+    )
+    assert not _findings(src, ["GL007"])
+
+
+def test_gl007_flags_scan_body_branching(tmp_path):
+    """Loop-body scope: a process_index branch inside a lax.scan body
+    reached from a segment builder (not the step family) is compiled scope
+    too — the fused fleet segment would deadlock exactly the same way."""
+    src = tmp_path / "scanbody.py"
+    src.write_text(
+        "import jax\n"
+        "def build_segment(state, n):\n"
+        "    def body(carry, _):\n"
+        "        if jax.process_index() == 0:\n"
+        "            carry = carry + 1\n"
+        "        return carry, None\n"
+        "    return jax.lax.scan(body, state, None, length=n)\n"
+    )
+    found = _findings(src, ["GL007"])
+    assert [f.rule for f in found] == ["GL007"], [f.format() for f in found]
 
 
 def test_counts_match_gl000_baseline_exactly():

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Weak-scaling regression gate (ROADMAP item 4's last sentence).
+
+Reads the ``scaling`` config's artifact (``bench_artifacts/scaling.tpu.json``,
+falling back to the CPU rehearsal artifact) — the gen/s-per-chip ladder
+``bench.py --config scaling`` measures with constant work per chip — and
+FAILS (exit 1) when weak-scaling efficiency regresses:
+
+* **absolute floor** — efficiency at the max chip count must be at least
+  ``FLOOR`` (default 0.70: a fitness all-gather per generation costs
+  something, but losing >30% of a doubling means the collective, not the
+  evaluation, owns the run);
+* **drift vs baseline** — if ``BENCH_HISTORY.json`` holds a baseline for
+  the scaling metric, today's efficiency must be at least
+  ``DRIFT_FRACTION`` (default 0.90) of it, so a slow collective regression
+  cannot hide under an absolute floor it still clears.
+
+No artifact at all is a clean SKIP (exit 0): this gate runs in lanes that
+may never have had TPU (or even multi-device) access, and "nothing
+measured" is not "regressed".  CPU artifacts are REPORT-ONLY (exit 0):
+the 8 "devices" of the virtual CPU mesh share one physical core, so weak
+"scaling" there is ~1/n by construction — a number worth printing (it
+exercises the ladder end to end) but meaningless to gate.
+
+Run via::
+
+    python tools/check_scaling.py                # after bench.py --config scaling
+    python tools/check_scaling.py --floor 0.8    # stricter absolute floor
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FLOOR = 0.70
+DRIFT_FRACTION = 0.90
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--floor", type=float, default=FLOOR)
+    p.add_argument("--drift-fraction", type=float, default=DRIFT_FRACTION)
+    p.add_argument(
+        "--artifact",
+        default=None,
+        help="explicit scaling artifact path (default: bench_artifacts/"
+        "scaling.tpu.json, then scaling.cpu.json)",
+    )
+    args = p.parse_args()
+
+    candidates = (
+        [args.artifact]
+        if args.artifact
+        else [
+            os.path.join(REPO, "bench_artifacts", "scaling.tpu.json"),
+            os.path.join(REPO, "bench_artifacts", "scaling.cpu.json"),
+        ]
+    )
+    artifact = next((c for c in candidates if c and os.path.exists(c)), None)
+    if artifact is None:
+        print(
+            "check_scaling: SKIP — no scaling artifact found "
+            "(run `python bench.py --config scaling` first)"
+        )
+        return 0
+    result = _load(artifact)
+    if not result or not result.get("ladder"):
+        print(f"check_scaling: SKIP — {artifact} holds no scaling ladder")
+        return 0
+
+    ladder = result["ladder"]
+    max_chips = max(int(n) for n in ladder)
+    if max_chips < 2:
+        print(
+            "check_scaling: SKIP — single-chip ladder "
+            "(weak scaling needs >= 2 devices)"
+        )
+        return 0
+    top = ladder[str(max_chips)]
+    efficiency = float(top.get("efficiency", result.get("value", 0.0)))
+    platform = result.get("platform", "unknown")
+    label = " (CPU, indicative only)" if platform != "tpu" else ""
+
+    print(f"check_scaling: {artifact}{label}")
+    for n in sorted(ladder, key=int):
+        rung = ladder[n]
+        print(
+            f"  {int(n):3d} chip(s): {rung['gens_per_sec']:10.2f} gen/s  "
+            f"{rung['per_chip']:10.2f}/chip  eff={rung.get('efficiency', 0):.3f}"
+        )
+
+    if platform != "tpu":
+        print(
+            f"check_scaling: REPORT-ONLY — {platform} artifact (virtual "
+            f"devices share cores; weak-scaling floors only bind on real "
+            f"parallel hardware).  Measured efficiency {efficiency:.3f} at "
+            f"{max_chips} chips."
+        )
+        return 0
+
+    failures = []
+    if efficiency < args.floor:
+        failures.append(
+            f"efficiency at {max_chips} chips is {efficiency:.3f} "
+            f"< absolute floor {args.floor:.2f}"
+        )
+
+    history = _load(os.path.join(REPO, "BENCH_HISTORY.json")) or {}
+    entry = history.get(result.get("metric", ""))
+    if entry and entry.get("baseline"):
+        baseline = float(entry["baseline"])
+        needed = args.drift_fraction * baseline
+        if efficiency < needed:
+            failures.append(
+                f"efficiency {efficiency:.3f} < {args.drift_fraction:.2f} x "
+                f"baseline {baseline:.3f} (= {needed:.3f}) — weak scaling "
+                f"drifted"
+            )
+        else:
+            print(
+                f"  baseline {baseline:.3f}: within drift budget "
+                f"({efficiency:.3f} >= {needed:.3f})"
+            )
+    else:
+        print("  no BENCH_HISTORY baseline yet (first run creates it)")
+
+    if failures:
+        for f in failures:
+            print(f"check_scaling: FAIL — {f}")
+        return 1
+    print(f"check_scaling: PASS — efficiency {efficiency:.3f} at {max_chips} chips")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
 """graftlint — JAX-aware static analysis + compile-cache sentinels for evox_tpu.
 
-Static side (``engine.py`` + ``rules.py``): AST rules GL000-GL005 over the
+Static side (``engine.py`` + ``rules.py``): AST rules GL000-GL007 over the
 library, each with a ``# graftlint: disable=GLxxx`` pragma and a per-rule
 ratchet baseline (finding counts only go DOWN — the same semantics PR 1's
 assert lint established).  CLI: ``python -m tools.graftlint``.

@@ -30,6 +30,15 @@ violation into a machine-checked finding:
   a 4-way mesh, and elastic (re-meshed) checkpoint resume silently forks.
   Fold the **global slot index** instead (``parallel/sharded_problem.py``
   is the pragma'd sanctioned pattern).
+* **GL007** — process-identity branching in compiled scope:
+  ``jax.process_index()``/``jax.process_count()`` are *host* values that
+  differ per process, so a Python ``if``/``while`` on them inside a jitted
+  step traces a **different program on each host** of a ``jax.distributed``
+  fleet — mismatched collectives, fleet-wide deadlock, no exception
+  anywhere.  Host-side process branching (the single-writer checkpoint
+  gating at segment boundaries, process-keyed fault schedules inside
+  ``io_callback`` hooks) is the sanctioned pattern and is out of compiled
+  scope by construction.
 
 **Compiled scope.**  GL002-GL005 only apply inside functions that trace
 under ``jax.jit``: methods/functions named ``step``/``init_step``/
@@ -1324,6 +1333,149 @@ class AxisIndexFoldRule(Rule):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# GL007 — process-identity branching in compiled scope (fleet divergence)
+# ---------------------------------------------------------------------------
+
+
+class ProcessBranchRule(Rule):
+    code = "GL007"
+    title = "process-identity branching in compiled scope"
+    hint = (
+        "jax.process_index()/process_count() are HOST values that differ "
+        "per process: Python `if`/`while` on them inside a jitted step "
+        "traces a DIFFERENT program on each host of a jax.distributed "
+        "fleet, and the mismatched collectives deadlock the whole fleet; "
+        "move the branch to host-side supervisor code (segment "
+        "boundaries), or make the behavior data-dependent via a traced "
+        "value every process computes identically"
+    )
+
+    def check(self, mod: Module) -> list[Finding]:
+        if (
+            "process_index" not in mod.source
+            and "process_count" not in mod.source
+        ):
+            return []  # cheap pre-filter
+        # Compiled scope = the step-family closure plus loop bodies rooted
+        # outside it (the same scope GL002-GL005 analyze); host-callback
+        # functions are exempt — process-keyed host behavior (single-writer
+        # gating, fleet fault schedules) is exactly what belongs there.
+        roots: list[ast.AST] = list(compiled_functions(mod))
+        covered = {
+            id(n)
+            for r in roots
+            for n in ast.walk(r)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        body_roots = [
+            fn
+            for fid, fn in _loop_body_functions(mod).items()
+            if fid not in covered
+        ]
+        nested_in_body: set[int] = set()
+        for fn in body_roots:
+            nested_in_body.update(
+                id(n)
+                for n in ast.walk(fn)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn
+            )
+        roots.extend(fn for fn in body_roots if id(fn) not in nested_in_body)
+        findings: list[Finding] = []
+        for fn in roots:
+            findings.extend(self._check_root(mod, fn))
+        return findings
+
+    @staticmethod
+    def _is_process_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        tail = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+        return tail in ("process_index", "process_count")
+
+    def _check_root(self, mod: Module, fn: ast.AST) -> list[Finding]:
+        host = _host_callback_names(fn)
+
+        # Collect nodes lexically inside host-callback defs so both the
+        # taint fixpoint and the branch scan skip them: process-keyed host
+        # behavior (single-writer gating, fleet fault schedules) is exactly
+        # what belongs in a host callback.
+        host_nodes: set[int] = set()
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name in host
+            ):
+                host_nodes.update(id(x) for x in ast.walk(n))
+
+        # GL006-style whole-tree fixpoint taint: names assigned from
+        # process_index()/process_count()-derived expressions (statement
+        # order ignored — a deliberate over-approximation; the pragma is
+        # the escape hatch for sanctioned sites).
+        tainted: set[str] = set()
+
+        def derived(node: ast.AST) -> bool:
+            for n in ast.walk(node):
+                if id(n) in host_nodes:
+                    continue
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if self._is_process_call(n):
+                    return True
+                if isinstance(n, ast.Name) and n.id in tainted:
+                    return True
+            return False
+
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if id(node) in host_nodes:
+                    continue
+                if isinstance(node, ast.Assign) and derived(node.value):
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name) and n.id not in tainted:
+                                tainted.add(n.id)
+                                changed = True
+                elif (
+                    isinstance(node, (ast.AugAssign, ast.AnnAssign))
+                    and node.value is not None
+                    and derived(node.value)
+                    and isinstance(node.target, ast.Name)
+                    and node.target.id not in tainted
+                ):
+                    tainted.add(node.target.id)
+                    changed = True
+
+        findings: list[Finding] = []
+        flagged: set[int] = set()
+        for node in ast.walk(fn):
+            if id(node) in host_nodes:
+                continue
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                continue
+            if derived(node.test) and node.lineno not in flagged:
+                flagged.add(node.lineno)
+                kw = (
+                    "if"
+                    if isinstance(node, (ast.If, ast.IfExp))
+                    else "while"
+                )
+                findings.append(
+                    self.finding(
+                        mod,
+                        node,
+                        f"Python `{kw}` on a `jax.process_index()`/"
+                        f"`process_count()`-derived value inside compiled "
+                        f"scope — each host of a fleet traces a different "
+                        f"program and the mismatched collectives deadlock",
+                    )
+                )
+        return findings
+
+
 RULES: list[Rule] = [
     BareAssertRule(),
     KeyReuseRule(),
@@ -1332,5 +1484,6 @@ RULES: list[Rule] = [
     RecompileHazardRule(),
     ImpureStepRule(),
     AxisIndexFoldRule(),
+    ProcessBranchRule(),
 ]
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -25,6 +25,12 @@ rm -f bench_artifacts/nsga2_dtlz2_pallas.tpu.json \
       bench_artifacts/pso_northstar_pallas.tpu.json
 
 echo "=== sweep start $(date -u +%H:%M:%S) ==="
+# Every artifact records n_processes (jax.process_count()) alongside
+# device_kind: single-host and jax.distributed multi-host measurements of
+# the same config must never be conflated in BENCH_HISTORY.json — per-chip
+# numbers mean something different when the all-gather crosses DCN.  The
+# --all sweep includes the `scaling` weak-scaling ladder (gen/s/chip vs
+# chips, constant work per chip); tools/check_scaling.py gates it below.
 python bench.py --all --runs 3 --platform tpu --no-probe \
   || echo "SWEEP FAILED rc=$?"
 
@@ -72,6 +78,12 @@ for cfg in ["nsga2_dtlz2", "rank_20k", "rvea_dtlz2", "pso_northstar_fused", "pso
         with open(os.path.join(prof, "roofline.json"), "w") as f:
             f.write(out.stdout)
 EOF
+echo "=== weak-scaling gate $(date -u +%H:%M:%S) ==="
+# Gen/s/chip vs chips, measured by the sweep's `scaling` config: FAILS the
+# log (not the sweep) when efficiency at max chips drops under the absolute
+# floor or drifts >10% below the recorded baseline (ROADMAP item 4).
+python tools/check_scaling.py || echo "SCALING GATE FAILED rc=$?"
+
 echo "=== regenerate BASELINE.md table $(date -u +%H:%M:%S) ==="
 # --rebaseline re-anchors BENCH_HISTORY.json to this sweep's multi-run
 # medians (old single-run values kept as previous_baseline) so future
