@@ -894,6 +894,50 @@ def bench_vmapped_instances_resilient(n_steps, profile_dir=None):
     }
 
 
+def bench_service_pack(n_steps, profile_dir=None):
+    """Multi-tenant packed serving on the vmapped_instances shape: the same
+    8 x PSO pop=1024 dim=100 runs, packed as one ``TenantPack`` (vmapped
+    fused segments with the lane-freeze bulkhead program) — the serving
+    layer's answer to the regressed per-step vmapped_instances bench.
+    Reported as per-tenant gen/s, directly comparable with
+    ``vmapped_instances`` (every lane advances each pack generation).  The
+    64-lane tiny-pop gate variant lives in ``tools/bench_service.py``."""
+    del profile_dir
+    import jax
+    import jax.numpy as jnp
+
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.problems.numerical import Ackley
+    from evox_tpu.service import TenantPack
+    from evox_tpu.workflows import StdWorkflow
+
+    lanes, chunk = 8, 25
+    lb, ub = _box(100, -32.0, 32.0)
+    wf = StdWorkflow(PSO(1024, lb, ub), Ackley())
+    pack = TenantPack(wf, lanes, early_stop=False)
+    for uid in range(lanes):
+        key = jax.random.fold_in(jax.random.key(0), jnp.uint32(uid))
+        state, _, _ = pack.init_tenant(wf.setup(key))
+        pack.admit(state, uid)
+    pack.run_segment(chunk)  # compile + warm
+    t0 = time.perf_counter()
+    done = 0
+    while done < n_steps:
+        pack.run_segment(chunk)
+        done += chunk
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": (
+            "Tenant-pack generations/sec/tenant "
+            "(8 x PSO pop=1024 dim=100, Ackley, segment=25)"
+        ),
+        "value": round(done / elapsed, 3),
+        "unit": "generations/sec",
+        "lanes": lanes,
+        "chunk": chunk,
+    }
+
+
 def bench_distributed_8dev(n_steps, profile_dir=None):
     """Population-sharded evaluation over all local devices (the reference's
     `torchrun` + NCCL all_gather path, here shard_map + one XLA all-gather).
@@ -1070,6 +1114,7 @@ CONFIGS = {
     "neuroevolution_resilient": (bench_neuroevolution_resilient, 30, 3),
     "vmapped_instances": (bench_vmapped_instances, 200, 50),
     "vmapped_instances_resilient": (bench_vmapped_instances_resilient, 200, 50),
+    "service_pack": (bench_service_pack, 200, 50),
     "distributed_8dev": (bench_distributed_8dev, 100, 10),
     "distributed_8dev_resilient": (bench_distributed_8dev_resilient, 100, 10),
     "scaling": (bench_scaling, 100, 10),

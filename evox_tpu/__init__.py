@@ -15,6 +15,7 @@ from . import (
     operators,
     problems,
     resilience,
+    service,
     utils,
     vis_tools,
     workflows,
@@ -40,6 +41,7 @@ __all__ = [
     "operators",
     "problems",
     "resilience",
+    "service",
     "utils",
     "vis_tools",
     "workflows",

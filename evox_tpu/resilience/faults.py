@@ -72,6 +72,20 @@ replays deterministically):
   the per-host verdict a :class:`~evox_tpu.resilience.FleetSupervisor`
   reads through the heartbeat plane).
 
+* **tenant-keyed lane faults** — ``lane_faults={lane_id: {...}}``: per-lane
+  NaN/Inf rows, stagnation plateaus, and host delays that fire only for the
+  pack lane whose ``fault_lane`` state leaf matches (the multi-tenant
+  service writes each tenant's uid there at admission).  The chaos mode the
+  service layer's bulkhead tests drive: one tenant's scheduled faults,
+  cotenants untouched.
+
+The **whole fault plan is audited at construction**: negative indices,
+unknown per-lane fields, inverted plateau windows, out-of-range shard ids,
+and contradictory fleet schedules (a SIGKILLed process also scheduled to
+wedge) raise a ``ValueError`` naming the field — never a silent no-op or a
+shape error deep inside jit.  The full fault matrix is tabulated in
+``docs/guide/resilience.md``.
+
 Transient faults are **attempt-counted on the host side**: a fault fires for
 its first ``*_times`` attempts of a given evaluation index and then stops,
 modeling an outage that passes — which is what lets retry/resume tests
@@ -95,7 +109,7 @@ import os
 import signal
 import threading
 import time
-from typing import Mapping, Sequence
+from typing import Any, Mapping, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -178,6 +192,7 @@ class FaultyProblem(Problem):
         slow_process_at: Mapping[int, Sequence[int]] | None = None,
         slow_process_seconds: float = 1.0,
         slow_process_times: int = 1,
+        lane_faults: Mapping[int, Mapping[str, Any]] | None = None,
     ):
         """
         :param nan_generations: evaluation indices whose fitness gets NaN
@@ -275,6 +290,22 @@ class FaultyProblem(Problem):
             seconds) and bumps the worker's ``deadline_trips`` counter,
             which — surfaced through its heartbeat — feeds the
             supervisor's per-host **slow** verdict.
+        :param lane_faults: ``{lane_id: {field: value}}`` — **tenant-keyed
+            chaos** for multi-tenant packs (``evox_tpu.service``): faults
+            that fire only for the pack lane whose ``fault_lane`` state
+            leaf matches ``lane_id`` (the service writes each tenant's
+            stable uid into its lane at admission; unpacked runs carry the
+            ``-1`` sentinel and match nothing).  Per-lane fields:
+            ``nan_generations``/``nan_rows``,
+            ``inf_generations``/``inf_rows``,
+            ``plateau_from``/``plateau_until``/``plateau_floor``
+            (all in-jit, so they vmap over the lane axis and replay
+            deterministically), and
+            ``delay_generations``/``delay_seconds``/``delay_times``
+            (host callback keyed on the lane payload, attempt-counted per
+            ``(lane, eval)``).  Unknown fields are rejected at
+            construction — the whole fault plan is audited by one
+            validation pass (see the class docstring).
         """
         self.problem = problem
         self.nan_generations = tuple(int(g) for g in nan_generations)
@@ -340,6 +371,7 @@ class FaultyProblem(Problem):
         }
         self.slow_process_seconds = float(slow_process_seconds)
         self.slow_process_times = int(slow_process_times)
+        self.lane_faults = self._normalize_lane_faults(lane_faults or {})
         # Host-side count of eval-deadline expiries on THIS process — the
         # per-host straggler self-report a worker surfaces through its
         # heartbeat payload so the fleet supervisor can render a per-host
@@ -366,6 +398,11 @@ class FaultyProblem(Problem):
             or self.sigterm_generations
             or self.straggler_shards
         )
+        # Lane-keyed host delays ride their own callback (it carries the
+        # lane id in the payload, which the shared host hook does not).
+        self._has_lane_host_faults = any(
+            spec["delay_generations"] for spec in self.lane_faults.values()
+        )
         # Fleet (process-keyed) faults ride a separate callback channel:
         # a plain callback only executes on process 0's host in a
         # multi-process program, so these dispatch through a shard_map'd
@@ -377,6 +414,200 @@ class FaultyProblem(Problem):
             or self.partition_process_at
             or self.slow_process_at
         )
+        # One validation point for the whole fault plan: the schedule
+        # surface has grown a field or two per PR, and a typo'd index or a
+        # contradictory pair used to surface as a silent no-op (or a shape
+        # error deep inside jit) instead of a constructor error.
+        self._validate_schedules()
+
+    # -- construction-time schedule audit -----------------------------------
+    _LANE_FAULT_FIELDS = {
+        "nan_generations": (),
+        "nan_rows": 1,
+        "inf_generations": (),
+        "inf_rows": 1,
+        "plateau_from": None,
+        "plateau_until": None,
+        "plateau_floor": 1.0,
+        "delay_generations": (),
+        "delay_seconds": 1.0,
+        "delay_times": 1,
+    }
+
+    def _normalize_lane_faults(
+        self, lane_faults: Mapping[int, Mapping[str, Any]]
+    ) -> dict[int, dict[str, Any]]:
+        out: dict[int, dict[str, Any]] = {}
+        for lane, spec in sorted(lane_faults.items()):
+            unknown = sorted(set(spec) - set(self._LANE_FAULT_FIELDS))
+            if unknown:
+                raise ValueError(
+                    f"lane_faults[{lane}] has unknown fault field(s) "
+                    f"{unknown}; valid per-lane fields are "
+                    f"{sorted(self._LANE_FAULT_FIELDS)}"
+                )
+            full = {
+                k: spec.get(k, default)
+                for k, default in self._LANE_FAULT_FIELDS.items()
+            }
+            out[int(lane)] = {
+                "nan_generations": tuple(
+                    int(g) for g in full["nan_generations"]
+                ),
+                "nan_rows": int(full["nan_rows"]),
+                "inf_generations": tuple(
+                    int(g) for g in full["inf_generations"]
+                ),
+                "inf_rows": int(full["inf_rows"]),
+                "plateau_from": (
+                    None
+                    if full["plateau_from"] is None
+                    else int(full["plateau_from"])
+                ),
+                "plateau_until": (
+                    None
+                    if full["plateau_until"] is None
+                    else int(full["plateau_until"])
+                ),
+                "plateau_floor": float(full["plateau_floor"]),
+                "delay_generations": frozenset(
+                    int(g) for g in full["delay_generations"]
+                ),
+                "delay_seconds": float(full["delay_seconds"]),
+                "delay_times": int(full["delay_times"]),
+            }
+        return out
+
+    def _validate_schedules(self) -> None:
+        """Reject malformed or self-contradictory fault plans loudly, at
+        construction — the single audit point for every schedule field the
+        wrapper has grown (the full matrix is tabulated in
+        ``docs/guide/resilience.md``)."""
+
+        def gens(name: str, values) -> None:
+            bad = [g for g in values if g < 0]
+            if bad:
+                raise ValueError(
+                    f"{name} schedules 0-based evaluation indices; got "
+                    f"negative index(es) {sorted(bad)}"
+                )
+
+        def nonneg(name: str, value) -> None:
+            if value < 0:
+                raise ValueError(f"{name} must be >= 0, got {value}")
+
+        gens("nan_generations", self.nan_generations)
+        gens("inf_generations", self.inf_generations)
+        gens("corrupt_generations", self.corrupt_generations)
+        gens("error_generations", self.error_generations)
+        gens("fatal_generations", self.fatal_generations)
+        gens("delay_generations", self.delay_generations)
+        gens("sigterm_generations", self.sigterm_generations)
+        for name, count in (
+            ("nan_rows", self.nan_rows),
+            ("inf_rows", self.inf_rows),
+            ("corrupt_times", self.corrupt_times),
+            ("error_times", self.error_times),
+            ("fatal_times", self.fatal_times),
+            ("delay_times", self.delay_times),
+            ("sigterm_times", self.sigterm_times),
+            ("straggler_times", self.straggler_times),
+            ("kill_times", self.kill_times),
+            ("partition_times", self.partition_times),
+            ("slow_process_times", self.slow_process_times),
+            ("delay_seconds", self.delay_seconds),
+            ("straggler_delay", self.straggler_delay),
+            ("partition_seconds", self.partition_seconds),
+            ("slow_process_seconds", self.slow_process_seconds),
+        ):
+            nonneg(name, count)
+        for name, frm, until in [
+            ("plateau", self.plateau_from, self.plateau_until)
+        ] + [
+            (f"lane_faults[{lane}] plateau", s["plateau_from"], s["plateau_until"])
+            for lane, s in self.lane_faults.items()
+        ]:
+            if until is not None and frm is None:
+                raise ValueError(
+                    f"{name}_until without {name}_from: a plateau window "
+                    f"needs its start (plateau_from=N)"
+                )
+            if frm is not None and frm < 0:
+                raise ValueError(f"{name}_from must be >= 0, got {frm}")
+            if until is not None and frm is not None and until < frm:
+                raise ValueError(
+                    f"{name}_until ({until}) must be >= {name}_from ({frm}) "
+                    f"— the window is [from, until)"
+                )
+        n_shards = self._n_shards()
+        for name, shard_map_ in (
+            ("dead_shards", dict(self.dead_shards)),
+            ("straggler_shards", self.straggler_shards),
+        ):
+            for shard, shard_gens in shard_map_.items():
+                gens(f"{name}[{shard}]", shard_gens)
+                if shard < 0:
+                    raise ValueError(
+                        f"{name} keys are mesh shard indices; got {shard}"
+                    )
+                if n_shards is not None and shard >= n_shards:
+                    raise ValueError(
+                        f"{name} schedules shard {shard}, but the "
+                        f"evaluation runs on {n_shards} shard(s) "
+                        f"(indices 0..{n_shards - 1}) — a fault that can "
+                        f"never fire is a misconfigured test, not chaos"
+                    )
+        if self.eval_deadline is not None and self.eval_deadline <= 0:
+            raise ValueError(
+                f"eval_deadline must be > 0 seconds, got {self.eval_deadline}"
+            )
+        for name, proc_map in (
+            ("kill_process_at", self.kill_process_at),
+            ("partition_process_at", self.partition_process_at),
+            ("slow_process_at", self.slow_process_at),
+        ):
+            for proc, proc_gens in proc_map.items():
+                if proc < 0:
+                    raise ValueError(
+                        f"{name} keys are jax.process_index() values; "
+                        f"got {proc}"
+                    )
+                gens(f"{name}[{proc}]", proc_gens)
+        # A process SIGKILLed at (proc, eval) cannot also wedge or slow
+        # there: the overlap means the plan's author expected two
+        # different fates for one host at one moment.
+        for proc, kill_gens in self.kill_process_at.items():
+            for other_name, other in (
+                ("partition_process_at", self.partition_process_at),
+                ("slow_process_at", self.slow_process_at),
+            ):
+                overlap = kill_gens & other.get(proc, frozenset())
+                if overlap:
+                    raise ValueError(
+                        f"conflicting fleet schedules for process {proc}: "
+                        f"kill_process_at and {other_name} both fire at "
+                        f"evaluation(s) {sorted(overlap)} — a SIGKILLed "
+                        f"process cannot also be wedged/slowed"
+                    )
+        for lane, spec in self.lane_faults.items():
+            if lane < 0:
+                raise ValueError(
+                    f"lane_faults keys are stable lane/tenant ids >= 0 "
+                    f"(-1 is the unassigned sentinel); got {lane}"
+                )
+            gens(f"lane_faults[{lane}].nan_generations", spec["nan_generations"])
+            gens(f"lane_faults[{lane}].inf_generations", spec["inf_generations"])
+            gens(
+                f"lane_faults[{lane}].delay_generations",
+                spec["delay_generations"],
+            )
+            for fname in (
+                "nan_rows",
+                "inf_rows",
+                "delay_times",
+                "delay_seconds",
+            ):
+                nonneg(f"lane_faults[{lane}].{fname}", spec[fname])
 
     def _mesh_in_chain(self) -> int | None:
         """Mesh axis size of a ShardedProblem on the wrapped chain, if any
@@ -485,6 +716,22 @@ class FaultyProblem(Problem):
                     # straggler device stalls the all-gather barrier.
                     time.sleep(self.straggler_delay)
 
+    def _lane_host_hook(self, gen, lane) -> None:
+        """Host side of the lane-keyed delay faults: sleeps only when THIS
+        payload's lane has a scheduled delay, attempt-counted per
+        ``(lane, eval)``.  Under a vmapped pack the unordered callback
+        fires once per lane, each carrying its own lane id — a slow
+        tenant stalls the pack's step exactly like a slow tenant would
+        stall a shared accelerator (the pack-level stall is the fault
+        being modeled; the bulkhead contract is about *values*, which the
+        sleep never touches)."""
+        g, l = int(gen), int(lane)
+        spec = self.lane_faults.get(l)
+        if spec is None or g not in spec["delay_generations"]:
+            return
+        if self._bump(f"lane_delay{l}", g) <= spec["delay_times"]:
+            time.sleep(spec["delay_seconds"])
+
     def _fleet_hook(self, gen) -> None:
         """Host side of the process-keyed fleet faults.
 
@@ -567,12 +814,26 @@ class FaultyProblem(Problem):
             # present (even with an empty schedule) so faulted runs and
             # their ``*_times=0`` comparators share one program structure.
             corruption=jnp.float32(0.0),
+            # Stable lane/tenant identity for ``lane_faults`` — written by
+            # the multi-tenant service at admission (tenant uid); the -1
+            # sentinel matches no schedule, so unpacked runs are
+            # untouched.  Always present so packed states and their solo
+            # comparators share one structure.
+            fault_lane=jnp.int32(-1),
         )
 
     def _inject_rows(
-        self, fit: jax.Array, gen: jax.Array, schedule: tuple, rows: int, value
+        self,
+        fit: jax.Array,
+        gen: jax.Array,
+        schedule: tuple,
+        rows: int,
+        value,
+        extra: jax.Array | None = None,
     ) -> jax.Array:
         scheduled = jnp.any(gen == jnp.asarray(schedule, jnp.int32))
+        if extra is not None:
+            scheduled = jnp.logical_and(scheduled, extra)
         row_mask = jnp.arange(fit.shape[0]) < rows
         mask = row_mask if fit.ndim == 1 else row_mask[:, None]
         return jnp.where(
@@ -645,6 +906,14 @@ class FaultyProblem(Problem):
                     gen,
                     **self._callback_kwargs(),
                 )
+        if self._has_lane_host_faults:
+            io_callback(
+                self._lane_host_hook,
+                None,
+                gen,
+                state.fault_lane,
+                **self._callback_kwargs(),
+            )
         fit, inner = self.problem.evaluate(state.inner, pop)
         if self.nan_generations:
             fit = self._inject_rows(
@@ -654,6 +923,45 @@ class FaultyProblem(Problem):
             fit = self._inject_rows(
                 fit, gen, self.inf_generations, self.inf_rows, jnp.inf
             )
+        # Tenant-keyed lane faults: every schedule is masked on the state's
+        # lane identity, so the program is one trace for the whole pack and
+        # only the scheduled tenant's rows are touched (the bulkhead the
+        # service tests lean on).
+        for uid, spec in self.lane_faults.items():
+            is_lane = state.fault_lane == jnp.int32(uid)
+            if spec["nan_generations"]:
+                fit = self._inject_rows(
+                    fit,
+                    gen,
+                    spec["nan_generations"],
+                    spec["nan_rows"],
+                    jnp.nan,
+                    extra=is_lane,
+                )
+            if spec["inf_generations"]:
+                fit = self._inject_rows(
+                    fit,
+                    gen,
+                    spec["inf_generations"],
+                    spec["inf_rows"],
+                    jnp.inf,
+                    extra=is_lane,
+                )
+            if spec["plateau_from"] is not None:
+                in_plateau = jnp.logical_and(
+                    gen >= spec["plateau_from"], is_lane
+                )
+                if spec["plateau_until"] is not None:
+                    in_plateau = jnp.logical_and(
+                        in_plateau, gen < spec["plateau_until"]
+                    )
+                fit = jnp.where(
+                    in_plateau,
+                    jnp.maximum(
+                        fit, jnp.asarray(spec["plateau_floor"], fit.dtype)
+                    ),
+                    fit,
+                )
         if self.dead_shards:
             # Mesh-position-keyed NaN rows: the scheduled shard's whole
             # contiguous row block dies — the row→shard mapping is the

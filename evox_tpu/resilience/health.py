@@ -339,9 +339,18 @@ class HealthProbe:
         self.stagnation_tol = float(stagnation_tol)
         self.shards = None if shards is None else int(shards)
         self._window: list[float] = []
+        # Per-lane stagnation windows for multi-tenant packs, keyed by the
+        # caller's stable lane id (the service layer keys on tenant uid, so
+        # a tenant's window follows it across lane moves and
+        # eviction/readmission).  Disjoint from the solo window: one probe
+        # instance may watch one pack.
+        self._lane_windows: dict[int, list[float]] = {}
         # One compiled scan per state structure (jit re-traces on structure
         # change, e.g. after an IPOP-style population regrow).
         self._scan = jax.jit(self._scan_impl)
+        # Lane-axis variant for tenant packs: one vmapped scan over the
+        # leading lane axis, thresholded per lane on the host.
+        self._lane_scan = jax.jit(jax.vmap(self._scan_impl))
 
     # -- host-side window (persisted via checkpoint manifests) --------------
     @property
@@ -359,6 +368,27 @@ class HealthProbe:
         self._window = [float(x) for x in window]
         if self.stagnation_window:
             del self._window[: -self.stagnation_window]
+
+    # -- per-lane windows (multi-tenant packs) ------------------------------
+    def lane_window(self, lane_id: int) -> tuple[float, ...]:
+        """Best-fitness window of one pack lane (see :meth:`check_lanes`);
+        empty for an unknown lane.  The service layer persists this in the
+        tenant's checkpoint manifest, exactly like the runner persists
+        :attr:`window`."""
+        return tuple(self._lane_windows.get(int(lane_id), ()))
+
+    def restore_lane(self, lane_id: int, window: Sequence[float]) -> None:
+        """Restore one lane's stagnation window (tenant readmission), so
+        the readmitted tenant replays probe decisions identically."""
+        win = [float(x) for x in window]
+        if self.stagnation_window:
+            del win[: -self.stagnation_window]
+        self._lane_windows[int(lane_id)] = win
+
+    def reset_lane(self, lane_id: int) -> None:
+        """Clear one lane's window (fresh tenant / post-restart grace —
+        the per-lane analogue of :meth:`reset`)."""
+        self._lane_windows.pop(int(lane_id), None)
 
     # -- the jitted scan -----------------------------------------------------
     def _scan_impl(self, state: Any) -> dict[str, Any]:
@@ -378,6 +408,49 @@ class HealthProbe:
         Appends to the stagnation window as a side effect — call exactly
         once per chunk boundary (the runner does)."""
         raw = jax.device_get(self._scan(state))
+        return self._verdict(raw, generation, self._window)
+
+    def check_lanes(
+        self,
+        states: Any,
+        generation: int = 0,
+        lane_ids: Sequence[int] | None = None,
+    ) -> list[HealthReport]:
+        """Per-lane verdicts for a tenant pack: ``states`` carries a
+        leading lane axis (the stacked per-tenant states a
+        ``TenantPack`` steps through one vmapped segment), and each lane
+        is thresholded independently — one :class:`HealthReport` per
+        requested lane, in ``lane_ids`` order.
+
+        ``lane_ids`` maps the rows to *stable* identities (the service
+        passes tenant uids) so each lane's stagnation window follows its
+        tenant across lane moves and eviction/readmission; ``None`` uses
+        the row indices.  One device scan serves every lane (the scan is
+        vmapped over the lane axis); appends to each requested lane's
+        window as a side effect — call exactly once per segment boundary
+        per lane, and skip unoccupied lanes by omitting their rows from
+        ``lane_ids``... which is why ``lane_ids`` may be a sparse
+        ``[(row, id), ...]`` mapping too."""
+        raw = jax.device_get(self._lane_scan(states))
+        if lane_ids is None:
+            n = jax.tree_util.tree_leaves(states)[0].shape[0]
+            pairs = [(row, row) for row in range(n)]
+        elif lane_ids and isinstance(lane_ids[0], tuple):
+            pairs = [(int(r), int(i)) for r, i in lane_ids]
+        else:
+            pairs = list(enumerate(int(i) for i in lane_ids))
+        reports = []
+        for row, lane_id in pairs:
+            lane_raw = jax.tree_util.tree_map(lambda x: x[row], raw)
+            window = self._lane_windows.setdefault(lane_id, [])
+            reports.append(self._verdict(lane_raw, generation, window))
+        return reports
+
+    def _verdict(
+        self, raw: Mapping[str, Any], generation: int, window: list[float]
+    ) -> HealthReport:
+        """Threshold one (host-side) metric dict into a report, advancing
+        the given stagnation window in place."""
         reasons: list[str] = []
 
         nonfinite = {
@@ -458,10 +531,10 @@ class HealthProbe:
         stagnating = False
         improvement = None
         if self.stagnation_window > 0 and best is not None:
-            self._window.append(best)
-            del self._window[: -self.stagnation_window]
-            if len(self._window) == self.stagnation_window:
-                improvement = self._window[0] - self._window[-1]
+            window.append(best)
+            del window[: -self.stagnation_window]
+            if len(window) == self.stagnation_window:
+                improvement = window[0] - window[-1]
                 # NaN improvement compares False -> not flagged here; the
                 # non-finite detector owns that failure mode.
                 stagnating = improvement <= self.stagnation_tol

@@ -267,7 +267,7 @@ def _quarantine_target(path: Path) -> Path:
 def scan_checkpoints(
     checkpoint_dir: Union[str, Path],
     *,
-    verify: bool = False,
+    verify: Union[bool, str] = False,
     quarantine: bool = False,
     store: CheckpointStore | None = None,
 ) -> tuple[list[tuple[int, Path]], list[tuple[Path, str, bool]]]:
@@ -291,23 +291,42 @@ def scan_checkpoints(
 
     ``verify=False`` trusts the directory listing (no file is opened) —
     the cheap mode :func:`latest_checkpoint` uses by default.
-    ``verify=True`` reads and digests **every** candidate up front — a
-    deliberate trade: the directory (bounded by ``keep_checkpoints``
-    files under the runner) is fully triaged in one pass, so corrupt
-    files are quarantined even when a newer candidate wins.  Template
-    validation (shape/dtype against a live run's state) is *not* this
-    function's job; that happens at ``load_state`` time in
-    :meth:`ResilientRunner.resume`.  Renames route through ``store``
-    (default local), the same :class:`~evox_tpu.utils.CheckpointStore`
-    seam every other checkpoint file operation uses.
+    ``verify=True`` (or ``"full"``) reads and digests **every** candidate
+    up front — a deliberate trade: the directory (bounded by
+    ``keep_checkpoints`` files under the runner) is fully triaged in one
+    pass, so corrupt files are quarantined even when a newer candidate
+    wins.  ``verify="manifest"`` is the **fast path** for large
+    directories (the multi-tenant service's per-tenant namespaces hold
+    hundreds of archives, and a full pass is O(N·bytes) of SHA-256 per
+    scan): each candidate's manifest digest and entry inventory are
+    checked — truncation and manifest damage still reject (and
+    quarantine) exactly as before — but leaf digests are NOT recomputed;
+    the caller fully verifies only the archive it actually selects
+    (``load_state(verify=True)``, which the runner does under
+    ``verify_resume="manifest"``).  Template validation (shape/dtype
+    against a live run's state) is *not* this function's job; that
+    happens at ``load_state`` time in :meth:`ResilientRunner.resume`.
+    Renames route through ``store`` (default local), the same
+    :class:`~evox_tpu.utils.CheckpointStore` seam every other checkpoint
+    file operation uses.
     """
+    if verify not in (False, True, "full", "manifest"):
+        raise ValueError(
+            f"verify must be False, True, 'full', or 'manifest', got "
+            f"{verify!r}"
+        )
     store = store if store is not None else CheckpointStore()
     valid: list[tuple[int, Path]] = []
     rejected: list[tuple[Path, str, bool]] = []
     for gen, path in _numbered_checkpoints(checkpoint_dir):
         if verify:
             try:
-                verify_checkpoint(path)
+                # Positional-compatible call in full mode (test doubles and
+                # wrappers of verify_checkpoint predate the leaves kwarg).
+                if verify == "manifest":
+                    verify_checkpoint(path, leaves=False)
+                else:
+                    verify_checkpoint(path)
             except FileNotFoundError:
                 # The file vanished between the listing and the read: a
                 # concurrent cleaner (the fleet's primary process GC-ing or
@@ -410,7 +429,7 @@ class ResilientRunner:
         checkpoint_wall_interval: float | None = None,
         preemption: Union[PreemptionGuard, bool, None] = None,
         store: CheckpointStore | None = None,
-        verify_resume: bool = True,
+        verify_resume: Union[bool, str] = True,
         fused: bool = True,
         fused_early_stop: bool = False,
         primary: bool | None = None,
@@ -523,7 +542,16 @@ class ResilientRunner:
             scan (:func:`scan_checkpoints`): byte-damaged files (torn
             writes, bit flips) are quarantined as ``*.corrupt`` and
             reported as structured ``stats.checkpoint_skips`` instead of
-            being silently loaded or crashing the scan.
+            being silently loaded or crashing the scan.  ``True`` (the
+            default) recomputes every candidate's leaf digests up front;
+            ``"manifest"`` triages candidates by manifest digest and
+            entry inventory only — O(manifest) per candidate instead of
+            O(archive bytes) — and fully verifies just the checkpoint
+            actually selected, at load time (quarantine semantics are
+            unchanged: damage found either way still renames the file
+            aside and falls back).  The fast mode is built for
+            directories holding hundreds of archives (per-tenant service
+            namespaces); ``False`` disables scan verification entirely.
         :param fused: compile each checkpoint segment as ONE
             ``lax.scan`` over generations with the resilience features
             carried *inside* the program
@@ -631,7 +659,12 @@ class ResilientRunner:
 
             self.store = ReadOnlyCheckpointStore()
         self.heartbeat = heartbeat
-        self.verify_resume = bool(verify_resume)
+        if verify_resume not in (False, True, "full", "manifest"):
+            raise ValueError(
+                f"verify_resume must be False, True, 'full', or "
+                f"'manifest', got {verify_resume!r}"
+            )
+        self.verify_resume = verify_resume
         self.checkpoint_wall_interval = checkpoint_wall_interval
         # ``preemption=True`` builds a guard the runner OWNS: each run()
         # resets it, so rerunning the same runner after a Preempted raise
@@ -1058,7 +1091,14 @@ class ResilientRunner:
                 # corruption); a pre-upgrade checkpoint keeps the template's
                 # value for new leaves (with a warning) instead of losing
                 # the whole run to a schema bump.
-                state = load_state(path, candidate_template, allow_missing=True)
+                # Manifest-only scans defer the O(bytes) leaf-digest pass
+                # to exactly the one candidate being restored.
+                state = load_state(
+                    path,
+                    candidate_template,
+                    allow_missing=True,
+                    verify=self.verify_resume == "manifest",
+                )
             except FileNotFoundError:
                 self._skip_candidate(
                     path, "vanished during resume (concurrent cleaner)"

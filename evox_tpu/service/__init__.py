@@ -1,0 +1,40 @@
+"""Multi-tenant optimization service: thousands of concurrent runs, one
+mesh, per-tenant fault bulkheads.
+
+The serving layer over the fused-segment machinery (ROADMAP item 2):
+:class:`TenantPack` steps a compilation bucket's tenants as ONE vmapped
+fused segment with lane-granular freeze/evict semantics, and
+:class:`OptimizationService` runs the lifecycle around it — bounded-queue
+admission control, shape-bucket affinity, boundary-only
+admission/retirement (continuous batching), per-tenant PRNG/telemetry/
+health/checkpoint isolation, reject-with-reason overload behavior, and
+preemption-safe emergency checkpointing of every tenant namespace.
+
+The contract (pinned by ``tests/test_service.py``): a tenant's trajectory
+— final state, monitor counters, checkpoint content digests — is
+**bit-identical** whether it runs alone or packed beside cotenants that
+inject NaNs, stagnate, get evicted, or trigger restarts.
+"""
+
+from .pack import TenantPack, assign_fault_lane
+from .service import AdmissionError, OptimizationService, ServiceStats
+from .tenant import (
+    TenantRecord,
+    TenantSpec,
+    TenantStatus,
+    bucket_key,
+    static_signature,
+)
+
+__all__ = [
+    "AdmissionError",
+    "OptimizationService",
+    "ServiceStats",
+    "TenantPack",
+    "TenantRecord",
+    "TenantSpec",
+    "TenantStatus",
+    "assign_fault_lane",
+    "bucket_key",
+    "static_signature",
+]

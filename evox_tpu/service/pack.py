@@ -1,0 +1,300 @@
+"""Tenant-packed execution: many independent runs, one compiled program.
+
+A :class:`TenantPack` owns a fixed number of **lanes** and one bucket
+template :class:`~evox_tpu.workflows.StdWorkflow`.  Every occupied lane
+holds one tenant's full workflow state, stacked along a leading lane axis,
+and a segment advances ALL lanes together as ONE ``jax.vmap`` of the fused
+multi-generation segment program (``StdWorkflow._segment_program`` — the
+PR-6 ``lax.scan`` with quarantine, monitor counters, captured history, and
+per-lane early stop inside the compiled body).  The host touches the
+device once per segment for the whole pack — the amortization that the
+regressed per-step ``vmapped_instances`` bench pays per generation.
+
+**The bulkhead.**  Lanes are vmap batch members: the program contains no
+cross-lane operation, so one tenant's NaN burst, plateau, or frozen lane
+cannot perturb a cotenant's *values* by construction — and because every
+lane runs the same barrier-free cond-guarded body
+(``SegmentConfig(barrier=False, lane_freeze=True)``), a tenant's
+trajectory is the same bits whether its neighbors are healthy, faulty,
+frozen, or empty padding (pinned by ``tests/test_service.py`` for PSO and
+OpenES).  Three freeze channels share one mechanism:
+
+* **in-scan early stop** — a lane whose state turns unhealthy
+  mid-segment freezes itself (its remaining generations are
+  ``lax.cond`` no-ops), per lane, because the cond predicate is batched;
+* **eviction/quarantine** — the boundary writes the lane's entry in the
+  ``frozen`` mask the compiled segment takes as a *traced input*: freezing
+  or thawing a lane never recompiles anything;
+* **empty lanes** — unoccupied slots are frozen copies of an occupied
+  state (``parallel.pad_population`` over the lane axis), so a ragged
+  bucket runs the full-width program.
+
+Admission and eviction are **state surgery at segment boundaries**: a
+tenant's state is written into / read out of its lane by indexed update,
+with the one single-lane ``init_step`` program (compiled once per bucket)
+covering fresh admissions.  No admission, retirement, or freeze changes
+the segment program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import State
+from ..parallel import pad_population
+
+__all__ = ["TenantPack", "assign_fault_lane"]
+
+
+def _is_prng(leaf: Any) -> bool:
+    return isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+        leaf.dtype, jax.dtypes.prng_key
+    )
+
+
+def assign_fault_lane(state: State, uid: int) -> State:
+    """Stamp a tenant's stable uid into every ``fault_lane`` leaf of its
+    state (the :class:`~evox_tpu.resilience.FaultyProblem` tenant-keyed
+    chaos hook).  A state without such leaves passes through unchanged."""
+
+    def stamp(key_path, leaf):
+        names = [
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
+        ]
+        if names and names[-1] == "fault_lane":
+            return jnp.asarray(uid, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(stamp, state)
+
+
+class TenantPack:
+    """A fixed-width pack of fault-isolated tenant lanes over one bucket
+    template workflow.
+
+    The pack is a *device-side* structure: it owns the stacked lane
+    states, the frozen mask, and the compiled programs.  Scheduling —
+    which tenant sits in which lane, verdicts, checkpoints — belongs to
+    :class:`~evox_tpu.service.OptimizationService`; the pack only enforces
+    the mechanics (one program, lane surgery, freeze semantics).
+
+    :param workflow: the bucket template
+        :class:`~evox_tpu.workflows.StdWorkflow` (one traced program for
+        every lane; per-tenant values live in lane state).
+    :param lanes: pack width.  Fixed at construction — the compiled
+        segment's batch dimension.
+    :param health: optional probe-config object
+        (:class:`~evox_tpu.resilience.HealthProbe`); wired into the
+        segment config so the in-scan early-stop thresholds mirror the
+        boundary verdicts.
+    :param early_stop: carry the per-lane unhealthy-state early stop
+        in-scan (default True — a poisoned tenant freezes the moment it
+        degenerates instead of compounding to the boundary).
+    """
+
+    def __init__(
+        self,
+        workflow: Any,
+        lanes: int,
+        *,
+        health: Any | None = None,
+        early_stop: bool = True,
+    ):
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if not hasattr(workflow, "_segment_program"):
+            raise ValueError(
+                f"TenantPack needs a workflow exposing the fused segment "
+                f"builder (_segment_program); got "
+                f"{type(workflow).__name__}"
+            )
+        self.workflow = workflow
+        self.lanes = int(lanes)
+        self.health = health
+        # One shape for every lane and every occupancy: barrier-free (the
+        # optimization-barrier primitive cannot vmap) and lane_freeze (the
+        # frozen mask is a traced input — see the module docstring).
+        self.cfg = workflow.segment_config(
+            health=health,
+            metrics=False,
+            stop_on_unhealthy=bool(early_stop),
+            barrier=False,
+            lane_freeze=True,
+        )
+        self._states: State | None = None
+        self._frozen = np.ones((self.lanes,), dtype=bool)
+        self.occupants: list[int | None] = [None] * self.lanes
+        # Single-lane programs, compiled once per bucket: fresh admissions
+        # (init_step) run through these, identically for a pack's first
+        # tenant and its sixty-fourth.  The init program captures its
+        # monitor sinks like a segment does (the payloads belong to the
+        # admitted tenant's monitor, not to the bucket template's host
+        # history); the static site identities land in ``_init_meta`` at
+        # trace time and stay valid for every cached replay — one config,
+        # one trace per pack.
+        self._init_meta: list = []
+        self._jit_init = jax.jit(self._init_program)
+        self._jit_segment = jax.jit(self._vmapped_segment, static_argnums=2)
+
+    def _init_program(self, state: State):
+        new_state, ys = self.workflow._traced_capture_step(
+            state, self._init_meta, True, which="init_step"
+        )
+        return new_state, ys
+
+    def _vmapped_segment(self, states: State, frozen: jax.Array, n: int):
+        return jax.vmap(
+            lambda s, f: self.workflow._segment_program(s, n, self.cfg, f)
+        )(states, frozen)
+
+    # -- occupancy ----------------------------------------------------------
+    @property
+    def frozen_mask(self) -> np.ndarray:
+        """Copy of the per-lane frozen mask (True = no-op generations)."""
+        return self._frozen.copy()
+
+    def free_lanes(self) -> list[int]:
+        """Unoccupied lane indices, lowest first."""
+        return [i for i, uid in enumerate(self.occupants) if uid is None]
+
+    def occupied_lanes(self) -> list[tuple[int, int]]:
+        """``[(lane, uid), ...]`` for every occupied lane."""
+        return [
+            (i, uid) for i, uid in enumerate(self.occupants) if uid is not None
+        ]
+
+    def active_lanes(self) -> list[tuple[int, int]]:
+        """Occupied lanes that are not frozen (will actually step)."""
+        return [
+            (i, uid)
+            for i, uid in self.occupied_lanes()
+            if not self._frozen[i]
+        ]
+
+    # -- lane surgery -------------------------------------------------------
+    def init_tenant(self, state: State) -> tuple[State, list, list]:
+        """Run the single-lane ``init_step`` program on a freshly set-up
+        tenant state (generation 1) — the same compiled program for every
+        admission into this bucket, so a tenant's first generation is
+        identical however full the pack is.
+
+        Returns ``(state, sink_meta, sinks)``: the captured history
+        payloads of the init generation, shaped as length-1 batches so
+        they feed straight into ``EvalMonitor.ingest_sinks`` (the caller
+        routes them to the admitted tenant's monitor; a template build
+        just drops them)."""
+        new_state, ys = self._jit_init(state)
+        sinks = [
+            tuple(np.asarray(x)[None] for x in site)
+            for site in jax.device_get(ys)
+        ]
+        return new_state, list(self._init_meta), sinks
+
+    def admit(self, state: State, uid: int, *, frozen: bool = False) -> int:
+        """Write a tenant's (post-init or checkpoint-restored) state into
+        the first free lane; returns the lane index.  Raises when full —
+        capacity is the service's admission-control problem."""
+        free = self.free_lanes()
+        if not free:
+            raise RuntimeError(
+                f"pack is full ({self.lanes} lanes); retire or evict a "
+                f"tenant before admitting"
+            )
+        lane = free[0]
+        if self._states is None:
+            # First admission builds the stacked axis: one real row, padded
+            # to the pack width with frozen copies (pad_population repeats
+            # the last row — valid values for any program, never stepped).
+            stacked = jax.tree_util.tree_map(
+                lambda x: jnp.expand_dims(x, 0), state
+            )
+            self._states, _ = pad_population(stacked, self.lanes)
+            if lane != 0:  # pragma: no cover - first free lane is 0 here
+                raise AssertionError("first admission must land in lane 0")
+        else:
+            self._states = self._write_lane(self._states, lane, state)
+        self.occupants[lane] = int(uid)
+        self._frozen[lane] = bool(frozen)
+        return lane
+
+    def _write_lane(self, states: State, lane: int, state: State) -> State:
+        def set_row(packed, row):
+            if _is_prng(packed):
+                # .at[].set on typed PRNG-key arrays is unsupported on
+                # this jax; splice the raw key data and re-wrap.
+                data = jax.random.key_data(packed)
+                row_data = jax.random.key_data(row)
+                return jax.random.wrap_key_data(
+                    data.at[lane].set(row_data),
+                    impl=jax.random.key_impl(packed),
+                )
+            return packed.at[lane].set(row)
+
+        return jax.tree_util.tree_map(set_row, states, state)
+
+    def lane_state(self, lane: int) -> State:
+        """The full workflow state of one lane (a view by-lane slice)."""
+        if self._states is None:
+            raise RuntimeError("pack has no admitted tenants")
+        return jax.tree_util.tree_map(lambda x: x[lane], self._states)
+
+    def write_lane(self, lane: int, state: State) -> None:
+        """Overwrite one lane's state in place (restarts, restores)."""
+        if self._states is None:
+            raise RuntimeError("pack has no admitted tenants")
+        self._states = self._write_lane(self._states, lane, state)
+
+    def release(self, lane: int) -> None:
+        """Free a lane (retirement/eviction): it freezes and its slot can
+        be re-admitted into.  The stale state stays as inert padding."""
+        self.occupants[lane] = None
+        self._frozen[lane] = True
+
+    def set_frozen(self, lane: int, frozen: bool) -> None:
+        """Freeze or thaw one lane — pure mask data, never a recompile."""
+        self._frozen[lane] = bool(frozen)
+
+    # -- stepping -----------------------------------------------------------
+    def run_segment(self, n_steps: int) -> State:
+        """Advance every non-frozen lane ``n_steps`` generations as ONE
+        compiled vmapped fused segment; frozen lanes ride along as no-ops.
+        Returns the host-side telemetry (one ``device_get`` for the whole
+        pack): ``executed``/``stopped`` per lane, the captured history
+        batches (demux with
+        ``EvalMonitor.ingest_sinks(..., lane=i)``), and ``sink_meta``."""
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        if self._states is None:
+            raise RuntimeError("pack has no admitted tenants")
+        states, telemetry = self._jit_segment(
+            self._states, jnp.asarray(self._frozen), int(n_steps)
+        )
+        self._states = states
+        return jax.device_get(telemetry)
+
+    def check_lanes(
+        self,
+        probe: Any,
+        generation: int = 0,
+        lanes: Sequence[int] | None = None,
+    ) -> dict[int, Any]:
+        """Boundary health verdicts — ``{lane: HealthReport}`` via the
+        probe's lane-aware scan, windows keyed on tenant uid (stable
+        across lane moves).  ``lanes`` restricts which occupied lanes are
+        probed: a frozen lane's unchanged state must not keep feeding its
+        stagnation window (it would read as flatlined the moment it
+        thaws)."""
+        pairs = self.occupied_lanes()
+        if lanes is not None:
+            allowed = set(lanes)
+            pairs = [(l, u) for l, u in pairs if l in allowed]
+        if not pairs:
+            return {}
+        reports = probe.check_lanes(
+            self._states, generation=generation, lane_ids=pairs
+        )
+        return {lane: rep for (lane, _), rep in zip(pairs, reports)}

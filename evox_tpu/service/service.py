@@ -1,0 +1,840 @@
+"""The multi-tenant optimization service: admission, scheduling, isolation.
+
+:class:`OptimizationService` is the serving layer over
+:class:`~evox_tpu.service.TenantPack`: users :meth:`submit` independent
+optimization runs (:class:`~evox_tpu.service.TenantSpec`), the service
+buckets them by compilation shape, packs each bucket's tenants into one
+vmapped fused segment program, and advances every pack segment by segment —
+thousands of concurrent runs on one mesh, each with the full per-run
+guarantee surface of PRs 1–7 scoped *per tenant*:
+
+* **PRNG isolation** — tenant streams fold the stable uid into the service
+  key (identity-keyed, never lane-keyed);
+* **telemetry isolation** — each tenant owns an
+  :class:`~evox_tpu.workflows.EvalMonitor` fed by the per-lane demux of the
+  pack's batched telemetry (``ingest_sinks(lane=...)``), entry-for-entry
+  what a solo run records;
+* **health isolation** — per-lane verdicts from a lane-aware
+  :class:`~evox_tpu.resilience.HealthProbe` (windows keyed by uid), with a
+  per-tenant restart budget (rollback to the tenant's newest checkpoint,
+  PRNG perturbed by restart index) and lane-granular quarantine once the
+  budget is spent;
+* **checkpoint isolation** — every tenant has its own namespace directory
+  under the service root (``tenants/<tenant_id>/``), written with the
+  self-verifying format-2 archives; eviction→readmission resumes
+  bit-identically, and the resume scan uses the manifest-only fast mode
+  (full digest verification runs on exactly the archive selected);
+* **preemption** — a tripped
+  :class:`~evox_tpu.resilience.PreemptionGuard` emergency-checkpoints
+  EVERY running tenant's namespace at the boundary and raises
+  :class:`~evox_tpu.resilience.Preempted`; a fresh service resumes them
+  all.
+
+**Overload is loud.**  The waiting queue is bounded: a submission past
+``max_queue`` raises :class:`AdmissionError` with a structured reason (and
+is recorded in ``stats.rejections``) — the service never silently degrades
+admitted tenants to absorb demand.
+
+**Boundaries are the only scheduling points.**  Admission, retirement,
+eviction, verdicts, restarts, and checkpoints all happen between segments
+(continuous batching for EC); generation budgets are quantized up to whole
+segments, identically for every tenant, so a tenant's trajectory is a pure
+function of (spec, uid, service configuration) — never of its cotenants.
+That is the bulkhead contract ``tests/test_service.py`` pins bit-exactly.
+"""
+
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import State
+from ..resilience.health import HealthProbe
+from ..resilience.preemption import Preempted, PreemptionGuard
+from ..resilience.restart import perturb_prng_keys
+from ..resilience.runner import scan_checkpoints
+from ..utils.checkpoint import (
+    CheckpointError,
+    CheckpointStore,
+    load_state,
+    read_manifest,
+    save_state,
+)
+from ..workflows import EvalMonitor, StdWorkflow
+from .pack import TenantPack, assign_fault_lane
+from .tenant import TenantRecord, TenantSpec, TenantStatus, bucket_key
+
+__all__ = ["OptimizationService", "AdmissionError", "ServiceStats"]
+
+
+class AdmissionError(RuntimeError):
+    """A submission was refused.  ``reason`` is the structured cause — the
+    bounded queue is full, the tenant id collides with a live tenant, or
+    the spec is unusable.  Overload rejection is the contract: beyond its
+    bounds the service refuses loudly instead of degrading everyone."""
+
+    def __init__(self, message: str, *, reason: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+@dataclass
+class ServiceStats:
+    """Observable record of what the service did."""
+
+    submitted: int = 0
+    admitted: int = 0
+    completed: int = 0
+    segments_run: int = 0
+    rejections: list[tuple[str, str]] = field(default_factory=list)
+    quarantines: int = 0
+    restarts: int = 0
+    evictions: int = 0
+    readmissions: int = 0
+    checkpoints_written: int = 0
+    preemptions: int = 0
+    early_stops: int = 0
+
+
+@dataclass
+class _Bucket:
+    key: tuple
+    workflow: StdWorkflow
+    pack: TenantPack
+    monitor: EvalMonitor  # template (capture plumbing only; history unused)
+
+
+class OptimizationService:
+    """Packs thousands of independent optimization runs onto one mesh with
+    per-tenant fault bulkheads.
+
+    Usage::
+
+        svc = OptimizationService("svc_root", lanes_per_pack=64,
+                                  segment_steps=16, seed=0)
+        svc.submit(TenantSpec("alice-1", PSO(1024, lb, ub), Ackley(),
+                              n_steps=400))
+        svc.submit(TenantSpec("bob-7", PSO(1024, lb, ub), Ackley(),
+                              n_steps=400))      # same bucket, same program
+        svc.run()                                 # drain all tenants
+        final = svc.result("alice-1")             # full workflow state
+        history = svc.tenant("alice-1").monitor.fitness_history
+
+    :param root: service directory; tenant checkpoint namespaces live
+        under ``<root>/tenants/<tenant_id>/``.
+    :param lanes_per_pack: pack width per compilation bucket (the vmapped
+        batch size).  One pack per bucket; tenants beyond the width wait
+        in the queue for a free lane (continuous batching).
+    :param segment_steps: generations per compiled segment — the
+        scheduling quantum: admission, eviction, verdicts, and
+        checkpoints happen only at segment boundaries.
+    :param max_queue: bound on tenants waiting for a lane; submissions
+        past it raise :class:`AdmissionError` (reason ``"queue-full"``).
+    :param seed: service PRNG identity; tenant streams are
+        ``fold_in(key(seed), uid)``.
+    :param health: a :class:`~evox_tpu.resilience.HealthProbe` whose
+        detector config drives both the in-scan per-lane early stop and
+        the per-lane boundary verdicts; ``None`` builds a default probe
+        (non-finite state detection only).
+    :param max_restarts: per-tenant restart budget on unhealthy verdicts
+        (rollback to the tenant's newest checkpoint with a
+        restart-indexed PRNG perturbation); once spent, the lane is
+        quarantined (frozen) instead.
+    :param checkpoint_every: segments between a tenant's periodic
+        namespace checkpoints (1 = every boundary).
+    :param preemption: a :class:`~evox_tpu.resilience.PreemptionGuard`
+        (or ``True`` for a service-owned one): when tripped, the next
+        boundary emergency-checkpoints every running tenant and raises
+        :class:`~evox_tpu.resilience.Preempted`.
+    :param store: the :class:`~evox_tpu.utils.CheckpointStore` all
+        checkpoint file operations route through (chaos-injectable).
+    :param early_stop: carry the per-lane unhealthy-state freeze inside
+        the compiled segment (default True).
+    :param monitor_factory: builds each tenant's host-side monitor AND
+        the bucket template monitor; defaults to
+        ``EvalMonitor(ordered=False)`` (full fitness history).
+    :param on_event: one human-readable line per service event; defaults
+        to ``warnings.warn`` for failures and silence otherwise.
+    """
+
+    def __init__(
+        self,
+        root: Union[str, Path],
+        *,
+        lanes_per_pack: int = 8,
+        segment_steps: int = 16,
+        max_queue: int = 256,
+        seed: int = 0,
+        health: HealthProbe | None = None,
+        max_restarts: int = 1,
+        checkpoint_every: int = 1,
+        preemption: Union[PreemptionGuard, bool, None] = None,
+        store: CheckpointStore | None = None,
+        early_stop: bool = True,
+        monitor_factory: Callable[[], EvalMonitor] | None = None,
+        on_event: Callable[[str], None] | None = None,
+    ):
+        if lanes_per_pack < 1:
+            raise ValueError(
+                f"lanes_per_pack must be >= 1, got {lanes_per_pack}"
+            )
+        if segment_steps < 1:
+            raise ValueError(
+                f"segment_steps must be >= 1, got {segment_steps}"
+            )
+        if max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}"
+            )
+        self.root = Path(root)
+        self.lanes_per_pack = int(lanes_per_pack)
+        self.segment_steps = int(segment_steps)
+        self.max_queue = int(max_queue)
+        self.seed = int(seed)
+        self.health = health if health is not None else HealthProbe()
+        self.max_restarts = int(max_restarts)
+        self.checkpoint_every = int(checkpoint_every)
+        self._owns_guard = preemption is True
+        self.preemption: PreemptionGuard | None = (
+            PreemptionGuard() if preemption is True else (preemption or None)
+        )
+        self.store = store if store is not None else CheckpointStore()
+        self.early_stop = bool(early_stop)
+        self.monitor_factory = monitor_factory or (
+            lambda: EvalMonitor(ordered=False)
+        )
+        self.on_event = on_event
+        self.stats = ServiceStats()
+        self._tenants: dict[str, TenantRecord] = {}
+        self._tenants_by_uid: dict[int, TenantRecord] = {}
+        self._queue: list[str] = []
+        self._buckets: dict[tuple, _Bucket] = {}
+        # Post-init load_state templates per (bucket, uid): building one
+        # costs a device round through the init program, and the restart
+        # path would otherwise pay it on every rollback.
+        self._templates: dict[tuple, State] = {}
+        self._next_uid = 0
+        self._base_key = jax.random.key(self.seed)
+
+    # -- events -------------------------------------------------------------
+    def _event(self, msg: str, *, warn: bool = False) -> None:
+        if self.on_event is not None:
+            self.on_event(msg)
+        elif warn:
+            warnings.warn(msg)
+
+    def _note(self, record: TenantRecord, msg: str, *, warn: bool = False) -> None:
+        record.events.append(msg)
+        self._event(f"tenant {record.spec.tenant_id}: {msg}", warn=warn)
+
+    # -- admission control --------------------------------------------------
+    def submit(self, spec: TenantSpec) -> TenantRecord:
+        """Admit one tenant to the bounded queue (or refuse loudly).
+
+        Re-submitting an EVICTED or QUARANTINED tenant's id re-queues it
+        for readmission — it will resume from its checkpoint namespace
+        bit-identically.  A COMPLETED id must be retired with
+        :meth:`forget` first; a QUEUED/RUNNING id is a collision.
+        """
+        self.stats.submitted += 1
+        existing = self._tenants.get(spec.tenant_id)
+        if existing is not None and existing.status in (
+            TenantStatus.QUEUED,
+            TenantStatus.RUNNING,
+        ):
+            return self._reject(
+                spec,
+                "id-collision",
+                f"tenant id {spec.tenant_id!r} is already "
+                f"{existing.status.value}",
+            )
+        if existing is not None and existing.status is TenantStatus.COMPLETED:
+            return self._reject(
+                spec,
+                "id-collision",
+                f"tenant id {spec.tenant_id!r} already completed; call "
+                f"forget() to retire the record before reusing the id",
+            )
+        if len(self._queue) >= self.max_queue:
+            return self._reject(
+                spec,
+                "queue-full",
+                f"admission queue is at its bound ({self.max_queue}); "
+                f"retry after tenants retire",
+            )
+        if existing is not None:
+            if spec.uid is not None and spec.uid != existing.uid:
+                # The uid IS the tenant's PRNG/chaos/history identity;
+                # silently keeping the old one while the caller pinned a
+                # different one would diverge any cross-service
+                # comparison keyed on the explicit uid.
+                return self._reject(
+                    spec,
+                    "uid-mismatch",
+                    f"tenant id {spec.tenant_id!r} is readmission of uid "
+                    f"{existing.uid}, but the spec pins uid {spec.uid}; "
+                    f"omit uid= (or pass the original) to resume, or "
+                    f"forget() the record to start a new identity",
+                )
+            # Readmission keeps the uid (PRNG / chaos / history identity)
+            # and the monitor; only the spec's budget may be refreshed.  A
+            # quarantined tenant still holds its frozen lane — release it
+            # (its quarantine checkpoint is already on disk), so the
+            # readmission resumes from the namespace like any eviction.
+            if existing.lane is not None:
+                self._buckets[existing.bucket].pack.release(existing.lane)
+                existing.lane = None
+            existing.spec = spec
+            existing.status = TenantStatus.QUEUED
+            record = existing
+            self.stats.readmissions += 1
+            self._note(record, "re-queued for readmission")
+        else:
+            uid = spec.uid if spec.uid is not None else self._next_uid
+            if uid in self._tenants_by_uid:
+                return self._reject(
+                    spec,
+                    "uid-collision",
+                    f"uid {uid} is already assigned to another tenant",
+                )
+            self._next_uid = max(self._next_uid, uid + 1)
+            record = TenantRecord(
+                spec=spec, uid=uid, monitor=self.monitor_factory()
+            )
+            self._tenants[spec.tenant_id] = record
+            self._tenants_by_uid[uid] = record
+            self._note(record, f"queued (uid {uid})")
+        self._queue.append(spec.tenant_id)
+        return record
+
+    def _reject(self, spec: TenantSpec, reason: str, detail: str):
+        self.stats.rejections.append((spec.tenant_id, reason))
+        self._event(
+            f"rejected tenant {spec.tenant_id!r} ({reason}): {detail}",
+            warn=True,
+        )
+        raise AdmissionError(
+            f"submission of tenant {spec.tenant_id!r} refused "
+            f"({reason}): {detail}",
+            reason=reason,
+        )
+
+    # -- tenant accessors ---------------------------------------------------
+    def tenant(self, tenant_id: str) -> TenantRecord:
+        """The runtime record of one tenant (KeyError for unknown ids)."""
+        return self._tenants[tenant_id]
+
+    def result(self, tenant_id: str) -> State:
+        """A tenant's full workflow state: the final state for COMPLETED
+        tenants, the live lane state for RUNNING/QUARANTINED ones."""
+        record = self._tenants[tenant_id]
+        if record.result is not None:
+            return record.result
+        if record.lane is None:
+            raise RuntimeError(
+                f"tenant {tenant_id!r} is {record.status.value} and holds "
+                f"no lane; resume it (submit again) or read its checkpoints"
+            )
+        return self._buckets[record.bucket].pack.lane_state(record.lane)
+
+    def forget(self, tenant_id: str) -> None:
+        """Retire a COMPLETED/EVICTED/QUARANTINED tenant's record (its
+        checkpoint namespace stays on disk).  A quarantined tenant still
+        holds its frozen lane — it is released here, so retiring the
+        record returns the capacity to the pack."""
+        record = self._tenants.get(tenant_id)
+        if record is None:
+            return
+        if record.status in (TenantStatus.QUEUED, TenantStatus.RUNNING):
+            raise RuntimeError(
+                f"tenant {tenant_id!r} is {record.status.value}; evict it "
+                f"before forgetting"
+            )
+        if record.lane is not None:
+            self._buckets[record.bucket].pack.release(record.lane)
+            record.lane = None
+        self._templates.pop((record.bucket, record.uid), None)
+        self._tenants_by_uid.pop(record.uid, None)
+        del self._tenants[tenant_id]
+
+    # -- checkpoint namespaces ----------------------------------------------
+    def namespace(self, tenant_id: str) -> Path:
+        """The tenant's private checkpoint directory."""
+        return self.root / "tenants" / tenant_id
+
+    def _ckpt_path(self, record: TenantRecord, generation: int) -> Path:
+        return self.namespace(record.spec.tenant_id) / (
+            f"ckpt_{generation:08d}.npz"
+        )
+
+    def _checkpoint_tenant(
+        self,
+        record: TenantRecord,
+        state: State,
+        *,
+        emergency: bool = False,
+        reason: str | None = None,
+    ) -> None:
+        ns = self.namespace(record.spec.tenant_id)
+        ns.mkdir(parents=True, exist_ok=True)
+        metadata: dict[str, Any] = {
+            "tenant_id": record.spec.tenant_id,
+            "uid": record.uid,
+            "tenant_status": record.status.value,
+            "tenant_restarts": record.restarts,
+            "lane_health_window": list(self.health.lane_window(record.uid)),
+        }
+        if emergency:
+            metadata.update(
+                preempted=True, preemption_reason=reason or "preempted"
+            )
+        path = self._ckpt_path(record, record.generations)
+        try:
+            save_state(
+                path,
+                state,
+                generation=record.generations,
+                metadata=metadata,
+                store=self.store,
+                durable=emergency,
+            )
+        except (OSError, RuntimeError, ValueError) as e:
+            self._note(
+                record,
+                f"checkpoint write of {path.name} failed "
+                f"({type(e).__name__}: {e}); previous checkpoint remains "
+                f"the resume point",
+                warn=True,
+            )
+            return
+        record.segments_since_checkpoint = 0
+        self.stats.checkpoints_written += 1
+
+    # -- tenant state construction -------------------------------------------
+    def _tenant_key(self, uid: int) -> jax.Array:
+        # Identity-keyed stream: stable across lanes, packs, and
+        # readmissions (the GL006 discipline, applied to tenants).
+        return jax.random.fold_in(self._base_key, jnp.uint32(uid))
+
+    def _fresh_state(self, bucket: _Bucket, record: TenantRecord) -> State:
+        """A tenant's pre-init state, built exactly like
+        ``StdWorkflow.setup`` but from the tenant's identity-folded key,
+        with the uid stamped into the monitor instance id and every
+        ``fault_lane`` chaos leaf."""
+        wf = bucket.workflow
+        algo_key, prob_key, mon_key = jax.random.split(
+            self._tenant_key(record.uid), 3
+        )
+        mon_state = wf.monitor.setup(mon_key)
+        if "instance_id" in mon_state:
+            mon_state = mon_state.replace(
+                instance_id=jnp.asarray(record.uid, jnp.int32)
+            )
+        state = State(
+            algorithm=wf.algorithm.setup(algo_key),
+            problem=wf.problem.setup(prob_key),
+            monitor=mon_state,
+        )
+        return assign_fault_lane(state, record.uid)
+
+    def _resume_state(
+        self, bucket: _Bucket, record: TenantRecord
+    ) -> tuple[State, int] | None:
+        """Newest usable checkpoint of the tenant's namespace, or None.
+
+        The scan is the manifest-only fast path (a service root holds one
+        directory per tenant, hundreds of archives in aggregate; hashing
+        every byte of every candidate on every readmission is the O(N·B)
+        cost the fast mode exists to avoid) — the selected archive is then
+        FULLY digest-verified at load.  Corrupt candidates are quarantined
+        ``*.corrupt`` exactly like the runner's scan."""
+        ns = self.namespace(record.spec.tenant_id)
+        if not ns.is_dir():
+            return None
+        # One template build per (bucket, tenant): it costs a device pass
+        # through the init program, and the rollback-restart path resumes
+        # repeatedly.  Tenant-specific (not per-bucket) because
+        # allow_missing restores keep TEMPLATE values for leaves a
+        # pre-upgrade checkpoint lacks — those must be this tenant's.
+        tkey = (bucket.key, record.uid)
+        template = self._templates.get(tkey)
+        if template is None:
+            template, _, _ = bucket.pack.init_tenant(
+                self._fresh_state(bucket, record)
+            )
+            self._templates[tkey] = template
+        candidates, rejected = scan_checkpoints(
+            ns, verify="manifest", quarantine=True, store=self.store
+        )
+        for path, why, quarantined in rejected:
+            self._note(
+                record,
+                f"resume scan skipped {path.name}: {why}"
+                + (" (quarantined)" if quarantined else ""),
+                warn=True,
+            )
+        for gen, path in reversed(candidates):
+            try:
+                manifest = read_manifest(path)
+                state = load_state(
+                    path, template, allow_missing=True, verify=True
+                )
+            except FileNotFoundError:
+                continue
+            except (CheckpointError, ValueError) as e:
+                self._note(
+                    record,
+                    f"resume skipped {path.name}: {e}",
+                    warn=True,
+                )
+                continue
+            self.health.restore_lane(
+                record.uid, manifest.get("lane_health_window", [])
+            )
+            # max(): a rollback restart reloads a checkpoint written
+            # BEFORE the restart fired — adopting its (lower) count would
+            # hand the tenant an unspendable budget and loop forever.
+            record.restarts = max(
+                record.restarts, int(manifest.get("tenant_restarts", 0))
+            )
+            self._note(record, f"resumed from {path.name} (generation {gen})")
+            return state, gen
+        return None
+
+    # -- buckets ------------------------------------------------------------
+    def _bucket_for(self, spec: TenantSpec) -> _Bucket:
+        bkey = bucket_key(spec)
+        bucket = self._buckets.get(bkey)
+        if bucket is None:
+            monitor = self.monitor_factory()
+            workflow = StdWorkflow(
+                spec.algorithm, spec.problem, monitor=monitor
+            )
+            pack = TenantPack(
+                workflow,
+                self.lanes_per_pack,
+                health=self.health,
+                early_stop=self.early_stop,
+            )
+            bucket = _Bucket(
+                key=bkey, workflow=workflow, pack=pack, monitor=monitor
+            )
+            self._buckets[bkey] = bucket
+            self._event(
+                f"new bucket {bkey[0]} pop={bkey[1]} dim={bkey[2]} "
+                f"({self.lanes_per_pack} lanes)"
+            )
+        return bucket
+
+    # -- scheduling ---------------------------------------------------------
+    # OptimizationService.step() is a HOST-side scheduling round (the pack
+    # dispatches the compiled programs); the linter's name-based step-family
+    # scope pulls its closure in, but nothing here is ever traced.
+    def _admit_pending(self) -> None:  # graftlint: disable=GL005
+        """Fill free lanes from the queue (boundary-only admission)."""
+        still_waiting: list[str] = []
+        for tenant_id in self._queue:
+            record = self._tenants[tenant_id]
+            bucket = self._bucket_for(record.spec)
+            if not bucket.pack.free_lanes():
+                still_waiting.append(tenant_id)
+                continue
+            resumed = self._resume_state(bucket, record)
+            if resumed is not None:
+                state, generations = resumed
+                # The resume point can sit BEHIND history the monitor
+                # already recorded (an eviction whose final checkpoint
+                # write failed falls back to an older archive): prune the
+                # tail past it, or the replay's tags would collide with
+                # the stale entries.
+                if record.monitor is not None and hasattr(
+                    record.monitor, "truncate_history"
+                ):
+                    record.monitor.truncate_history(generations)
+                if generations >= record.spec.n_steps:
+                    # Budget already met at the resume point (a refreshed
+                    # smaller budget, or a completed tenant's surviving
+                    # namespace): return the resumed state as the result
+                    # instead of burning a lane on a whole extra segment.
+                    record.bucket = bucket.key
+                    record.generations = generations
+                    record.status = TenantStatus.COMPLETED
+                    record.result = jax.device_get(state)
+                    self.stats.admitted += 1
+                    self.stats.completed += 1
+                    self._note(
+                        record,
+                        f"resumed at generation {generations}, already at "
+                        f"or past the n_steps={record.spec.n_steps} "
+                        f"budget — completed without occupying a lane",
+                    )
+                    continue
+            else:
+                state, init_meta, init_sinks = bucket.pack.init_tenant(
+                    self._fresh_state(bucket, record)
+                )
+                generations = 1
+                self.health.reset_lane(record.uid)
+                if init_sinks and record.monitor is not None:
+                    # The init generation's history belongs to THIS
+                    # tenant's monitor, exactly like a solo run's first
+                    # callback.
+                    record.monitor.ingest_sinks(
+                        init_meta, init_sinks, np.int32(1)
+                    )
+            record.bucket = bucket.key
+            record.generations = generations
+            record.lane = bucket.pack.admit(state, record.uid)
+            record.status = TenantStatus.RUNNING
+            record.segments_since_checkpoint = 0
+            self.stats.admitted += 1
+            self._note(
+                record,
+                f"admitted to lane {record.lane} at generation "
+                f"{generations}",
+            )
+            if resumed is None:
+                # The post-init state is the tenant's first resume point:
+                # a fresh tenant killed before its first boundary must not
+                # restart from scratch while cotenants move on.
+                self._checkpoint_tenant(record, state)
+        self._queue = still_waiting
+
+    def evict(self, tenant_id: str) -> None:
+        """Checkpoint a RUNNING/QUARANTINED tenant's lane to its namespace
+        and free the lane (boundary semantics: call between :meth:`step`
+        calls).  Readmission (:meth:`submit` with the same id) resumes
+        bit-identically from the checkpoint."""
+        record = self._tenants[tenant_id]
+        if record.lane is None:
+            raise RuntimeError(
+                f"tenant {tenant_id!r} is {record.status.value} and holds "
+                f"no lane"
+            )
+        bucket = self._buckets[record.bucket]
+        self._checkpoint_tenant(record, bucket.pack.lane_state(record.lane))
+        bucket.pack.release(record.lane)
+        record.lane = None
+        record.status = TenantStatus.EVICTED
+        self.stats.evictions += 1
+        self._note(record, "evicted (checkpointed; lane freed)")
+
+    def _handle_preemption(self) -> None:
+        reason = self.preemption.reason or "preempted"
+        for record in self._tenants.values():
+            if record.lane is None:
+                continue
+            bucket = self._buckets[record.bucket]
+            state = bucket.pack.lane_state(record.lane)
+            mon = bucket.workflow.monitor
+            if "monitor" in state:
+                state = state.replace(
+                    monitor=mon.record_preemption(state["monitor"])
+                )
+                bucket.pack.write_lane(record.lane, state)
+            self._checkpoint_tenant(
+                record, state, emergency=True, reason=reason
+            )
+            # Leave the record in the EVICTED shape (lane freed, resume
+            # point on disk): "resubmit the same tenants" then works on
+            # THIS instance exactly like on a fresh one over the same
+            # root — without this, the records would sit RUNNING and
+            # every resubmission would bounce off the id-collision guard.
+            bucket.pack.release(record.lane)
+            record.lane = None
+            record.status = TenantStatus.EVICTED
+            self._note(record, f"preempted ({reason}); lane freed")
+        self.stats.preemptions += 1
+        self._event(
+            f"preempted ({reason}); emergency checkpoints published for "
+            f"every running tenant",
+            warn=True,
+        )
+        raise Preempted(
+            f"service preempted ({reason}); every running tenant's "
+            f"namespace holds an emergency checkpoint — resubmit the same "
+            f"tenants to resume bit-identically",
+            reason=reason,
+        )
+
+    def step(self) -> bool:
+        """One scheduling round: boundary work (preemption check,
+        admissions), then one fused segment per pack with active lanes,
+        then per-lane boundary work (telemetry demux, verdicts,
+        restarts/quarantine, retirement, checkpoints).  Returns whether
+        any lane actually stepped."""
+        if self.preemption is not None and self.preemption.triggered:
+            self._handle_preemption()
+        self._admit_pending()
+        stepped_any = False
+        for bucket in self._buckets.values():
+            if not bucket.pack.active_lanes():
+                continue
+            telemetry = bucket.pack.run_segment(self.segment_steps)
+            self.stats.segments_run += 1
+            stepped_any = True
+            self._boundary(bucket, telemetry)
+        # Late admissions: lanes freed by this round's retirements.
+        if self._queue:
+            self._admit_pending()
+        return stepped_any
+
+    def run(self, max_rounds: int | None = None) -> None:
+        """Drain the service: step until no lane can make progress (all
+        tenants COMPLETED, QUARANTINED, or EVICTED and the queue cannot be
+        placed).  ``max_rounds`` bounds the loop for tests.
+
+        Installs the preemption guard (when configured) for the duration,
+        exactly like ``ResilientRunner.run``; a service-owned guard
+        (``preemption=True``) is reset first so a previous run's trip
+        cannot re-fire."""
+        installed_guard = False
+        if self.preemption is not None:
+            if self._owns_guard:
+                self.preemption.reset()
+            if not self.preemption.installed:
+                self.preemption.install()
+                installed_guard = True
+        try:
+            rounds = 0
+            while True:
+                if max_rounds is not None and rounds >= max_rounds:
+                    return
+                progressed = self.step()
+                rounds += 1
+                if not progressed and not self._queue:
+                    return
+                if not progressed and self._queue:
+                    # Queue waits on lanes that no longer free themselves
+                    # (every occupant quarantined/complete but
+                    # un-forgotten): admission had its chance in step();
+                    # nothing will change.
+                    return
+        finally:
+            if installed_guard:
+                self.preemption.uninstall()
+
+    # -- boundary work ------------------------------------------------------
+    # Host-side boundary work on device_get-ed telemetry (see the
+    # step-family scope note above _admit_pending).
+    def _boundary(self, bucket: _Bucket, telemetry: Any) -> None:  # graftlint: disable=GL002
+        executed = np.asarray(telemetry["executed"])
+        stopped = np.asarray(telemetry["stopped"])
+        meta_pairs = StdWorkflow.sink_meta_pairs(telemetry)
+        sinks = telemetry["sinks"] if "sinks" in telemetry else ()
+        was_active = {
+            lane for lane, _ in bucket.pack.occupied_lanes()
+            if executed[lane] > 0 or not bucket.pack.frozen_mask[lane]
+        }
+        for lane, uid in bucket.pack.occupied_lanes():
+            if lane not in was_active:
+                continue
+            record = self._record_by_uid(uid)
+            record.generations += int(executed[lane])
+            record.segments_since_checkpoint += 1
+            if sinks and record.monitor is not None:
+                record.monitor.ingest_sinks(
+                    meta_pairs, sinks, np.asarray(telemetry["executed"]),
+                    lane=lane,
+                )
+            if bool(stopped[lane]) and int(executed[lane]) < self.segment_steps:
+                self.stats.early_stops += 1
+                self._note(
+                    record,
+                    f"in-scan early stop at generation "
+                    f"{record.generations}: lane froze mid-segment",
+                    warn=True,
+                )
+        # Verdicts on the post-segment states (one vmapped scan for the
+        # whole pack); windows keyed by uid.  Only lanes that stepped are
+        # probed — frozen lanes must not feed their stagnation windows.
+        reports = bucket.pack.check_lanes(self.health, lanes=was_active)
+        for lane, report in reports.items():
+            record = self._record_by_uid(bucket.pack.occupants[lane])
+            report.generation = record.generations
+            if record.generations >= record.spec.n_steps:
+                self._complete(bucket, record)
+                continue
+            if report.healthy:
+                if record.segments_since_checkpoint >= self.checkpoint_every:
+                    self._checkpoint_tenant(
+                        record, bucket.pack.lane_state(lane)
+                    )
+                continue
+            self._unhealthy(bucket, record, report)
+
+    def _record_by_uid(self, uid: int) -> TenantRecord:
+        return self._tenants_by_uid[uid]
+
+    def _complete(self, bucket: _Bucket, record: TenantRecord) -> None:
+        state = bucket.pack.lane_state(record.lane)
+        record.status = TenantStatus.COMPLETED
+        self._checkpoint_tenant(record, state)
+        record.result = jax.device_get(state)
+        bucket.pack.release(record.lane)
+        record.lane = None
+        self.stats.completed += 1
+        self._note(
+            record,
+            f"completed at generation {record.generations} (lane freed)",
+        )
+
+    def _unhealthy(
+        self, bucket: _Bucket, record: TenantRecord, report: Any
+    ) -> None:
+        reasons = "; ".join(report.reasons)
+        if record.restarts < self.max_restarts:
+            resumed = self._resume_state(bucket, record)
+            if resumed is not None:
+                state, generations = resumed
+                record.restarts += 1
+                # Same stream discipline as RollbackToCheckpoint: replay
+                # from the known-good state with every PRNG leaf folded by
+                # the restart index, so the retry explores a fresh
+                # trajectory deterministically.
+                state = perturb_prng_keys(state, record.restarts)
+                mon = bucket.workflow.monitor
+                if "monitor" in state:
+                    state = state.replace(
+                        monitor=mon.record_restart(state["monitor"])
+                    )
+                bucket.pack.write_lane(record.lane, state)
+                record.generations = generations
+                # The rollback replays generations the tenant's monitor
+                # already recorded: prune the stale tail or the replay's
+                # tags would collide (duplicate-tag guard in the history
+                # accessors).
+                if record.monitor is not None and hasattr(
+                    record.monitor, "truncate_history"
+                ):
+                    record.monitor.truncate_history(generations)
+                self.health.reset_lane(record.uid)
+                self.stats.restarts += 1
+                self._note(
+                    record,
+                    f"restart #{record.restarts} (rollback to generation "
+                    f"{generations}): {reasons}",
+                    warn=True,
+                )
+                return
+        bucket.pack.set_frozen(record.lane, True)
+        record.status = TenantStatus.QUARANTINED
+        self.stats.quarantines += 1
+        self._checkpoint_tenant(
+            record, bucket.pack.lane_state(record.lane)
+        )
+        self._note(
+            record,
+            f"quarantined at generation {record.generations} (lane "
+            f"frozen; restart budget "
+            f"{record.restarts}/{self.max_restarts} spent): {reasons}",
+            warn=True,
+        )

@@ -1,0 +1,209 @@
+"""Tenant model for the multi-tenant optimization service.
+
+A **tenant** is one independent optimization run a user submitted: an
+algorithm configuration, a problem, a generation budget, and a stable
+identity.  The service packs tenants whose compiled program would be
+identical — same algorithm class and static configuration, same
+``(pop, dim)`` shape, same problem program — into one **bucket**, and steps
+every tenant of a bucket as one vmapped fused segment
+(:class:`~evox_tpu.service.TenantPack`).
+
+Identity discipline (the bulkhead contract leans on it):
+
+* ``uid`` — a stable non-negative integer, assigned at first submission and
+  kept across eviction/readmission.  It seeds the tenant's PRNG stream
+  (``fold_in(service_key, uid)`` — *identity*-keyed, never lane-keyed, the
+  same topology-invariance discipline GL006 enforces for shard streams), it
+  is the monitor ``instance_id`` every history payload carries, and it is
+  the ``fault_lane`` value tenant-keyed chaos schedules match on.  Lane
+  *position* is a placement detail that may change on every readmission and
+  must never influence a value.
+* ``bucket_key`` — the compilation-shape identity: two tenants share a
+  bucket only when their algorithm/problem static configuration digests are
+  equal, so one traced program is exact for every lane.  Over-splitting is
+  always safe (a lonely tenant just gets its own pack); under-splitting
+  never happens silently.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "TenantSpec",
+    "TenantStatus",
+    "TenantRecord",
+    "bucket_key",
+    "static_signature",
+]
+
+
+class TenantStatus(Enum):
+    """Lifecycle of one tenant inside the service.
+
+    ``QUEUED`` — admitted to the bounded queue, waiting for a lane.
+    ``RUNNING`` — occupying a live pack lane.
+    ``QUARANTINED`` — its lane is frozen (health verdict after the restart
+    budget, or an in-scan early stop): the state stops evolving, cotenants
+    are untouched, and the tenant stays resumable from its checkpoints.
+    ``EVICTED`` — checkpointed to its namespace and removed from its lane
+    (operator decision / preemption); readmission resumes bit-identically.
+    ``COMPLETED`` — generation budget reached; final state retrievable.
+    """
+
+    QUEUED = "queued"
+    RUNNING = "running"
+    QUARANTINED = "quarantined"
+    EVICTED = "evicted"
+    COMPLETED = "completed"
+
+
+@dataclass
+class TenantSpec:
+    """What a user submits: one independent optimization run.
+
+    :param tenant_id: caller-chosen name; also the tenant's checkpoint
+        namespace directory (restricted to ``[A-Za-z0-9._-]`` so it is a
+        safe path component).
+    :param algorithm: the algorithm instance (its static configuration
+        keys the bucket; evolving values live in per-tenant state).
+    :param problem: the problem instance.  The FIRST tenant of a bucket
+        donates the actual traced objects (the bucket template); later
+        tenants' objects must be configuration-equal (enforced via
+        :func:`bucket_key`) and are used for bucketing only.
+    :param n_steps: generation budget.  Generations advance in the
+        service's fixed segment length, so completion lands on the first
+        segment boundary at or past the budget (continuous-batching
+        quantization — the same rounding for every tenant, solo or
+        packed).
+    :param uid: optional explicit stable identity (see the module
+        docstring); auto-assigned by submission order when ``None``.
+        Supply it when a bit-exact cross-service comparison (the bulkhead
+        tests) needs the same tenant identity in two service instances.
+    """
+
+    tenant_id: str
+    algorithm: Any
+    problem: Any
+    n_steps: int
+    uid: int | None = None
+
+    def __post_init__(self) -> None:
+        if not re.fullmatch(r"[A-Za-z0-9._-]+", self.tenant_id or ""):
+            raise ValueError(
+                f"tenant_id must be a non-empty [A-Za-z0-9._-] string (it "
+                f"names the tenant's checkpoint namespace directory), got "
+                f"{self.tenant_id!r}"
+            )
+        if self.n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {self.n_steps}")
+        if self.uid is not None and self.uid < 0:
+            raise ValueError(f"uid must be >= 0, got {self.uid}")
+
+
+@dataclass
+class TenantRecord:
+    """The service's runtime record of one tenant (host-side bookkeeping;
+    every evolving *value* lives in the tenant's lane state)."""
+
+    spec: TenantSpec
+    uid: int
+    status: TenantStatus = TenantStatus.QUEUED
+    bucket: tuple | None = None
+    lane: int | None = None
+    generations: int = 0
+    restarts: int = 0
+    segments_since_checkpoint: int = 0
+    # Human-readable lifecycle trail: admissions, verdicts, restarts,
+    # evictions — the per-tenant analogue of RunStats.failures.
+    events: list[str] = field(default_factory=list)
+    monitor: Any | None = None
+    result: Any | None = None
+
+
+def _hash_value(h: "hashlib._Hash", value: Any) -> None:
+    if isinstance(value, (bool, int, float, str, bytes, type(None))):
+        h.update(repr(value).encode())
+    elif isinstance(value, (tuple, list, frozenset, set)):
+        h.update(b"(")
+        for item in sorted(value, key=repr) if isinstance(
+            value, (set, frozenset)
+        ) else value:
+            _hash_value(h, item)
+        h.update(b")")
+    elif isinstance(value, dict):
+        h.update(b"{")
+        for k in sorted(value, key=repr):
+            _hash_value(h, k)
+            _hash_value(h, value[k])
+        h.update(b"}")
+    elif hasattr(value, "dtype") and hasattr(value, "shape"):
+        arr = np.asarray(value)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    elif hasattr(value, "__dict__") or hasattr(value, "evaluate") or hasattr(
+        value, "step"
+    ):
+        # Nested component (a problem wrapper chain, an inner optimizer):
+        # recurse into its static configuration.
+        h.update(type(value).__name__.encode())
+        _hash_attrs(h, value)
+    else:
+        # Opaque object: type identity only.  Conservative — two tenants
+        # holding distinct opaque objects of one type bucket together only
+        # if everything else matches; the traced template then defines the
+        # program, which is exactly the sharing the bucket promises.
+        h.update(type(value).__name__.encode())
+
+
+# Runtime-volatile component attributes that must not split buckets (or
+# drift a tenant's bucket between submissions): trace-time flags the
+# workflow toggles, host-side fault counters.
+_VOLATILE_ATTRS = frozenset(
+    {"in_sharded_program", "in_fused_program", "deadline_trips"}
+)
+
+
+def _hash_attrs(h: "hashlib._Hash", obj: Any) -> None:
+    attrs = getattr(obj, "__dict__", None)
+    if not attrs:
+        return
+    for name in sorted(attrs):
+        if name.startswith("_") or name in _VOLATILE_ATTRS:
+            continue
+        h.update(name.encode())
+        _hash_value(h, attrs[name])
+
+
+def static_signature(obj: Any) -> str:
+    """Digest of a component's static (public, non-volatile)
+    configuration — attribute names and values, arrays by bytes, nested
+    components recursively.  Two components with equal signatures trace
+    the same program modulo the values that live in per-tenant state."""
+    h = hashlib.sha256()
+    h.update(type(obj).__name__.encode())
+    _hash_attrs(h, obj)
+    return h.hexdigest()
+
+
+def bucket_key(spec: TenantSpec) -> tuple:
+    """The compilation-shape bucket a tenant belongs to: algorithm class +
+    ``(pop, dim)`` + the static-configuration digests of algorithm and
+    problem.  Tenants sharing a key are safe to step through ONE traced
+    program with per-tenant state."""
+    algo = spec.algorithm
+    return (
+        type(algo).__name__,
+        int(getattr(algo, "pop_size", 0)),
+        int(getattr(algo, "dim", 0)),
+        type(spec.problem).__name__,
+        static_signature(algo),
+        static_signature(spec.problem),
+    )

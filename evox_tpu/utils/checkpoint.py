@@ -381,8 +381,11 @@ def read_manifest(path: Union[str, Path]) -> dict[str, Any]:
         ) from e
 
 
-def _verify_archive(path: Path, data: Any) -> dict[str, Any]:
-    """Digest-check an open npz archive; returns the verified manifest."""
+def _verify_archive(path: Path, data: Any, leaves: bool = True) -> dict[str, Any]:
+    """Digest-check an open npz archive; returns the verified manifest.
+
+    ``leaves=False`` verifies the manifest digest and the archive's entry
+    inventory only — O(manifest bytes) instead of O(archive bytes)."""
     if MANIFEST_KEY not in data:
         raise CheckpointError(
             f"checkpoint {path} has no {MANIFEST_KEY} entry — not written "
@@ -424,14 +427,15 @@ def _verify_archive(path: Path, data: Any) -> dict[str, Any]:
                 f"manifest (missing {missing!r}, unexpected {extra!r}) — "
                 f"torn or tampered archive"
             )
-        for name in names:
-            actual = _entry_digest(data[name])
-            if actual != digests[name]:
-                raise CheckpointCorruptError(
-                    f"checkpoint {path}: leaf {name!r} digest mismatch "
-                    f"(recorded {digests[name][:12]}…, recomputed "
-                    f"{actual[:12]}…) — bit rot or torn write"
-                )
+        if leaves:
+            for name in names:
+                actual = _entry_digest(data[name])
+                if actual != digests[name]:
+                    raise CheckpointCorruptError(
+                        f"checkpoint {path}: leaf {name!r} digest mismatch "
+                        f"(recorded {digests[name][:12]}…, recomputed "
+                        f"{actual[:12]}…) — bit rot or torn write"
+                    )
     except CheckpointError:
         raise
     except Exception as e:
@@ -442,7 +446,9 @@ def _verify_archive(path: Path, data: Any) -> dict[str, Any]:
     return manifest
 
 
-def verify_checkpoint(path: Union[str, Path]) -> dict[str, Any]:
+def verify_checkpoint(
+    path: Union[str, Path], *, leaves: bool = True
+) -> dict[str, Any]:
     """Integrity-check a checkpoint without a template: recompute every
     leaf's SHA-256 against the manifest's ``leaf_digests`` and the
     manifest's own digest against the archive's ``__digest__`` entry.
@@ -454,11 +460,21 @@ def verify_checkpoint(path: Union[str, Path]) -> dict[str, Any]:
     (pre-digest) pass structurally with a warning.  Note ``zipfile``'s
     CRC-32 does NOT cover this: ``np.load`` streams members without
     reaching the end-of-stream CRC check, so a bit-flipped archive loads
-    silently without this function."""
+    silently without this function.
+
+    :param leaves: recompute per-leaf digests (the full O(archive-bytes)
+        pass).  ``leaves=False`` is the **manifest-only** fast mode:
+        the archive must open, carry a manifest whose own digest matches,
+        and list exactly the entries the manifest records — truncation
+        and manifest damage are caught, but leaf-byte bit rot is not.
+        Scan loops over large directories (the multi-tenant service's
+        per-tenant namespaces hold hundreds of archives) use it to triage
+        candidates cheaply and then fully verify only the archive
+        actually selected for resume (``load_state(verify=True)``)."""
     path = _resolve(path)
     try:
         with np.load(path) as data:
-            return _verify_archive(path, data)
+            return _verify_archive(path, data, leaves=leaves)
     except (CheckpointError, FileNotFoundError):
         raise
     except Exception as e:

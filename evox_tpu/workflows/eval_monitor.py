@@ -298,7 +298,7 @@ class EvalMonitor(Monitor):
                 self._sink(aux[k], HistoryType.AUXILIARY, state, slot=slot)
         return state
 
-    def ingest_sinks(self, meta, sinks, executed) -> None:
+    def ingest_sinks(self, meta, sinks, executed, lane: int | None = None) -> None:
         """Boundary flush of a fused segment's captured sink batches into
         the host-side history (the batched counterpart of the per-
         generation ``io_callback`` path — one call per *segment* instead of
@@ -315,6 +315,16 @@ class EvalMonitor(Monitor):
             (a fused segment may stop early on an unhealthy state); scalar,
             or ``(n_instances,)`` for vmapped segments.  Rows past it are
             padding and are dropped.
+        :param lane: demux mode — ingest ONLY the given instance-axis row
+            of a vmapped pack's telemetry into *this* monitor, as if the
+            lane had run solo.  This is how a multi-tenant pack
+            (``evox_tpu.service.TenantPack``) routes one compiled
+            segment's interleaved telemetry to each tenant's own monitor:
+            one ``ingest_sinks(..., lane=i)`` call per occupied lane, each
+            on that tenant's monitor instance.  Tags (generation,
+            instance id) come from the lane's own payload rows, so the
+            resulting history is entry-for-entry what the tenant's solo
+            run would have recorded.
 
         Entries are appended per generation in site program order, so the
         resulting history is element-for-element what the ``ordered=True``
@@ -325,6 +335,18 @@ class EvalMonitor(Monitor):
         replayed callback would."""
         hist = __monitor_history__[self._id_]
         executed = np.asarray(executed)
+        if lane is not None:
+            if executed.ndim == 0:
+                raise ValueError(
+                    "ingest_sinks(lane=...) demuxes a VMAPPED pack's "
+                    "telemetry (leading instance axis); this telemetry is "
+                    "unbatched — ingest it directly"
+                )
+            lane = int(lane)
+            executed = executed[lane]
+            sinks = [
+                tuple(np.asarray(x)[lane] for x in site) for site in sinks
+            ]
         if executed.ndim == 0:
             for g in range(int(executed)):
                 for (data_type, slot), (data, gens, insts) in zip(meta, sinks):
@@ -424,6 +446,21 @@ class EvalMonitor(Monitor):
         """Drop this monitor's host-side history (state-side top-k and
         latest-generation buffers are untouched)."""
         __monitor_history__[self._id_] = {t: [] for t in HistoryType}
+
+    def truncate_history(self, generation: int) -> None:
+        """Drop host-side history entries tagged PAST ``generation`` —
+        rollback support: a run restarted from an earlier checkpoint
+        replays those generations, and without pruning the stale entries
+        the replay's re-ingested tags would collide with them (the
+        unordered accessors detect duplicate ``(generation, instance)``
+        tags and raise rather than mis-group).  Entries at or before the
+        rollback generation are exactly the ones the restored state's
+        trajectory already produced, so they stay."""
+        hist = __monitor_history__[self._id_]
+        for data_type in list(hist):
+            hist[data_type] = [
+                e for e in hist[data_type] if e[0] <= generation
+            ]
 
     # -- result accessors ----------------------------------------------------
     def get_latest_fitness(self, state: State) -> jax.Array:

@@ -66,6 +66,21 @@ class SegmentConfig(NamedTuple):
     diversity_floor: float | None = None
     step_size_range: tuple | None = None
     stop_on_unhealthy: bool = False
+    # The early-stop predicate reads the state from behind a
+    # ``lax.optimization_barrier`` so its reductions cannot perturb the
+    # step's fusion.  That primitive has no vmap batching rule (jax
+    # 0.4.x), so vmapped packs (``service.TenantPack`` — many tenants,
+    # one leading lane axis) trace with ``barrier=False``: every lane of
+    # the pack runs the same barrier-free shape, so the packed-vs-solo
+    # bit-identity contract is between two traces of the SAME program.
+    barrier: bool = True
+    # With ``lane_freeze`` the compiled segment takes an extra traced
+    # boolean (``frozen``): lanes entering the segment frozen (evicted /
+    # quarantined tenants) run every generation as a no-op — the
+    # same cond-guarded shape as ``stop_on_unhealthy``, with the carry's
+    # stop flag *initialized* from the input instead of False.  Eviction
+    # therefore never re-compiles: the mask is data, not program.
+    lane_freeze: bool = False
 
 
 class StdWorkflow(Workflow):
@@ -499,6 +514,8 @@ class StdWorkflow(Workflow):
         metrics: bool = True,
         stop_on_unhealthy: bool = False,
         health: Any | None = None,
+        barrier: bool = True,
+        lane_freeze: bool = False,
     ) -> SegmentConfig:
         """Build the :class:`SegmentConfig` for :meth:`run_segment`.
 
@@ -522,7 +539,21 @@ class StdWorkflow(Workflow):
             host-side probe of the same state.  Without it, the metric set
             mirrors :meth:`health_metrics` and early stopping watches
             non-finite state only.
+        :param barrier: pin the early-stop predicate's reads behind a
+            ``lax.optimization_barrier`` (the solo default).  Vmapped
+            packs trace with ``False`` — the barrier primitive has no
+            vmap batching rule (see :class:`SegmentConfig`).
+        :param lane_freeze: compile the segment to take a traced
+            ``frozen`` boolean that pre-freezes the whole segment — the
+            service layer's no-recompile eviction mechanism (see
+            :class:`SegmentConfig`).  The lane-freeze body is the
+            where-select shape built for vmapped packs, where the
+            barrier primitive cannot apply — ``barrier`` is therefore
+            normalized to ``False`` whenever ``lane_freeze`` is set (a
+            config claiming barrier semantics the program cannot deliver
+            would be a lie in the cache key).
         """
+        barrier = bool(barrier) and not lane_freeze
         if health is not None:
             step_range = getattr(health, "step_size_range", None)
             return SegmentConfig(
@@ -536,6 +567,8 @@ class StdWorkflow(Workflow):
                 diversity_floor=getattr(health, "diversity_floor", None),
                 step_size_range=None if step_range is None else tuple(step_range),
                 stop_on_unhealthy=bool(stop_on_unhealthy),
+                barrier=bool(barrier),
+                lane_freeze=bool(lane_freeze),
             )
         return SegmentConfig(
             capture_history=bool(capture_history),
@@ -545,23 +578,27 @@ class StdWorkflow(Workflow):
             step_size=True,
             shards=self._n_shards,
             stop_on_unhealthy=bool(stop_on_unhealthy),
+            barrier=bool(barrier),
+            lane_freeze=bool(lane_freeze),
         )
 
     def _traced_capture_step(
-        self, state: State, meta_out: list, capture: bool
+        self, state: State, meta_out: list, capture: bool, which: str = "step"
     ) -> tuple[State, tuple]:
         """One generation with the monitor's host sinks redirected into a
         trace-time capture list (see ``Monitor._capture``).  Returns the new
         state plus the captured traced payloads — one ``(data, generation,
         instance)`` triple per sink site, in program order — and records the
-        static site identities ``(history_type, slot)`` in ``meta_out``."""
+        static site identities ``(history_type, slot)`` in ``meta_out``.
+        ``which`` selects the step family member (``"init_step"`` for the
+        service layer's captured single-lane admission program)."""
         mon = self.monitor
         cap: list | None = [] if capture else None
         prev = mon._capture
         if cap is not None:
             mon._capture = cap
         try:
-            new_state = self._step(state, "step")
+            new_state = self._step(state, which)
         finally:
             if cap is not None:
                 mon._capture = prev
@@ -571,7 +608,11 @@ class StdWorkflow(Workflow):
         return new_state, ys
 
     def _segment_program(
-        self, state: State, n_steps: int, cfg: SegmentConfig
+        self,
+        state: State,
+        n_steps: int,
+        cfg: SegmentConfig,
+        frozen: jax.Array | None = None,
     ) -> tuple[State, State]:
         """The fused checkpoint segment: ``n_steps`` generations as ONE
         ``lax.scan`` whose body carries everything that used to cross to
@@ -589,6 +630,17 @@ class StdWorkflow(Workflow):
 
         if n_steps < 1:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        if cfg.lane_freeze and frozen is None:
+            raise ValueError(
+                "SegmentConfig(lane_freeze=True) compiles the segment to "
+                "take the frozen flag as a traced input; pass frozen="
+            )
+        if frozen is not None and not cfg.lane_freeze:
+            raise ValueError(
+                "frozen= requires SegmentConfig(lane_freeze=True): the "
+                "cond-guarded program shape must be chosen at config time "
+                "so cached executables stay in sync with their inputs"
+            )
         # Host-callback-carrying wrappers (fault injection) must emit
         # UNORDERED callbacks inside a fused segment: an ordered callback
         # would serialize the scan against the host, and under vmap/
@@ -690,19 +742,82 @@ class StdWorkflow(Workflow):
             #   fusion).  This shape is documented as exactly reproducible
             #   against itself but NOT bit-identical to the predicate-free
             #   program — the cond is the price of freeze-don't-compound.
-            if cfg.stop_on_unhealthy:
+            if cfg.lane_freeze:
+                # The pack (vmapped-lane) freeze shape: the step is
+                # computed unconditionally and the carry SELECTS between
+                # stepped and frozen values per lane.  ``lax.cond`` is the
+                # wrong tool here twice over: a vmapped cond with IO
+                # effects (fault-injection callbacks, sigterm chaos) is
+                # unsupported by JAX's batching rules, and a batched cond
+                # would compute both branches anyway.  ``jnp.where`` with
+                # a scalar-per-lane predicate returns the selected operand
+                # exactly, so an active lane's carry is bitwise the
+                # stepped value — the packed-vs-solo contract is between
+                # two traces of this same shape.  Note host callbacks in
+                # the step body still FIRE for frozen lanes (with the
+                # frozen, non-advancing evaluation index — attempt
+                # counters absorb the repeats); only the *values* freeze.
+
+                def select_tree(pred, on_true: State, on_false: State):
+                    def sel(a, b):
+                        if isinstance(a, jax.Array) and jax.dtypes.issubdtype(
+                            a.dtype, jax.dtypes.prng_key
+                        ):
+                            return jax.random.wrap_key_data(
+                                jnp.where(
+                                    pred,
+                                    jax.random.key_data(a),
+                                    jax.random.key_data(b),
+                                ),
+                                impl=jax.random.key_impl(a),
+                            )
+                        return jnp.where(pred, a, b)
+
+                    return jax.tree_util.tree_map(sel, on_true, on_false)
+
+                def body(carry, _):
+                    st, stopped, executed = carry
+                    new_st, out = step_out(st)
+                    kept = select_tree(stopped, st, new_st)
+                    if cfg.stop_on_unhealthy:
+                        bad = unhealthy(kept)
+                    else:
+                        # Pure freeze shape: the stop flag only ever
+                        # enters through the frozen input — no in-scan
+                        # health predicate.
+                        bad = jnp.bool_(False)
+                    return (
+                        kept,
+                        stopped | bad,
+                        executed + jnp.where(stopped, 0, 1),
+                    ), out
+
+                (final, stopped, executed), outs = jax.lax.scan(
+                    body,
+                    (state, jnp.asarray(frozen, jnp.bool_), jnp.int32(0)),
+                    None,
+                    length=n_steps,
+                )
+            elif cfg.stop_on_unhealthy:
                 out_struct = jax.eval_shape(step_out, state)[1]
                 zero_out = jax.tree_util.tree_map(
                     lambda s: jnp.zeros(s.shape, s.dtype), out_struct
                 )
 
-                def frozen(s: State):
+                def frozen_step(s: State):
                     return s, zero_out
 
                 def body(carry, _):
                     st, stopped, executed = carry
-                    new_st, out = jax.lax.cond(stopped, frozen, step_out, st)
-                    bad = unhealthy(jax.lax.optimization_barrier(new_st))
+                    new_st, out = jax.lax.cond(
+                        stopped, frozen_step, step_out, st
+                    )
+                    guarded = (
+                        jax.lax.optimization_barrier(new_st)
+                        if cfg.barrier
+                        else new_st
+                    )
+                    bad = unhealthy(guarded)
                     return (
                         new_st,
                         stopped | bad,
@@ -756,6 +871,8 @@ class StdWorkflow(Workflow):
         metrics: bool = True,
         stop_on_unhealthy: bool = False,
         health: Any | None = None,
+        barrier: bool = True,
+        frozen: jax.Array | None = None,
     ) -> tuple[State, State]:
         """Run ``n_steps`` generations as ONE compiled ``lax.scan`` segment
         with the resilience features carried *inside* the program, and
@@ -820,12 +937,14 @@ class StdWorkflow(Workflow):
             metrics=metrics,
             stop_on_unhealthy=stop_on_unhealthy,
             health=health,
+            barrier=barrier,
+            lane_freeze=frozen is not None,
         )
         if self._segment_jit is None:
             self._segment_jit = jax.jit(
                 self._segment_program, static_argnums=(1, 2)
             )
-        return self._segment_jit(state, int(n_steps), cfg)
+        return self._segment_jit(state, int(n_steps), cfg, frozen)
 
     def flush_telemetry(self, telemetry: Any) -> None:
         """Boundary flush: append a fused segment's captured history
@@ -848,15 +967,23 @@ class StdWorkflow(Workflow):
         ingest = getattr(self.monitor, "ingest_sinks", None)
         if ingest is None or not sinks:
             return
-        # Site identities come from the telemetry itself (a constant of the
-        # program that produced it — always in sync with ``sinks``, however
-        # the executable was cached).  A vmapped segment broadcasts the
-        # constant over the instance axis; every row is identical.
-        meta = np.asarray(telemetry["sink_meta"])
-        if meta.ndim == 3:
-            meta = meta[0]
         ingest(
-            [(int(t), int(s)) for t, s in meta],
+            self.sink_meta_pairs(telemetry),
             [tuple(np.asarray(x) for x in site) for site in sinks],
             np.asarray(telemetry["executed"]),
         )
+
+    @staticmethod
+    def sink_meta_pairs(telemetry: Any) -> list[tuple[int, int]]:
+        """The static ``(history_type, slot)`` identity of each sink site
+        in a segment's telemetry, as ``ingest_sinks`` expects it — ONE
+        definition of the ``sink_meta`` layout for every consumer
+        (:meth:`flush_telemetry` and the service layer's per-lane demux).
+        Site identities come from the telemetry itself (a constant of the
+        program that produced it — always in sync with ``sinks``, however
+        the executable was cached); a vmapped segment broadcasts the
+        constant over the instance axis, every row identical."""
+        meta = np.asarray(telemetry["sink_meta"])
+        if meta.ndim == 3:
+            meta = meta[0]
+        return [(int(t), int(s)) for t, s in meta]

@@ -38,6 +38,20 @@
 #                               throughput keeps ≥90% of a bare fused loop
 #                               on the PSO Ackley config (artifact under
 #                               bench_artifacts/)
+#   ./run_tests.sh --service    multi-tenant service lane: tenant bulkheads
+#                               (bit-identity of a tenant packed beside
+#                               NaN-bursting / stagnating-restarting /
+#                               evicted cotenants vs the same tenant solo,
+#                               PSO + OpenES), lane freeze/evict/readmit,
+#                               admission control + overload rejection,
+#                               per-lane telemetry demux, tenant-keyed
+#                               chaos validation, manifest-only checkpoint
+#                               scans, packed SIGTERM preemption — then
+#                               the load-test harness asserting a packed
+#                               64-tenant bucket keeps ≥70% of solo
+#                               per-tenant gen/s (artifact under
+#                               bench_artifacts/).  Runs under a HARD
+#                               wall-clock timeout like --multihost.
 #   ./run_tests.sh --multihost  multi-host fleet lane: the fast multihost
 #                               suite (FleetTopology/bootstrap/heartbeat/
 #                               verdict plumbing, single-writer checkpoint
@@ -89,6 +103,16 @@ if [ "$1" = "--fused" ]; then
   "${CPU_ENV[@]}" python -m pytest \
     tests/test_fused_segment.py tests/test_compile_sentinel.py -q "$@" || exit 1
   exec "${CPU_ENV[@]}" python tools/bench_fused_overhead.py
+fi
+if [ "$1" = "--service" ]; then
+  shift
+  # Hard timeout (SIGKILL escalation), same pattern as --multihost: a
+  # wedged pack or a stuck preemption test must fail the lane loudly.
+  SERVICE_TIMEOUT="${EVOX_TPU_SERVICE_TIMEOUT:-1200}"
+  timeout -k 30 "$SERVICE_TIMEOUT" \
+    "${CPU_ENV[@]}" python -m pytest \
+    tests/test_service.py tests/test_preemption.py -q "$@" || exit 1
+  exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_service.py
 fi
 if [ "$1" = "--multihost" ]; then
   shift

@@ -611,3 +611,104 @@ def test_wall_interval_zero_budget_keeps_chunks_minimal(tmp_path, key):
     assert runner.stats.chunk_sizes == [1, 1, 1, 1]  # init + 4 segments
     with pytest.raises(ValueError, match="checkpoint_wall_interval"):
         ResilientRunner(wf, tmp_path / "x", checkpoint_wall_interval=0.0)
+
+
+# -- packed (multi-tenant) preemption ----------------------------------------
+
+
+def test_service_sigterm_checkpoints_every_tenant_and_resumes_bit_identical(
+    tmp_path,
+):
+    """SIGTERM mid-segment with a packed bucket: every tenant namespace
+    gets an emergency checkpoint (``preempted`` in the manifest, the
+    ``num_preemptions`` counter bumped in the saved state), and a fresh
+    service over the same root resumes ALL lanes bit-identically to a
+    never-preempted pack — the ISSUE-5 acceptance, extended to tenant
+    packs."""
+    from evox_tpu.service import OptimizationService, TenantSpec
+
+    n_steps, n_tenants = 17, 3
+    lb = jnp.full((8,), -10.0)
+    ub = jnp.full((8,), 10.0)
+
+    def specs(sigterm_times):
+        # sigterm_times=0 keeps the callback in the program (structure
+        # parity) without delivering the signal — the FaultyProblem
+        # comparator idiom.
+        return [
+            TenantSpec(
+                f"t{u}",
+                PSO(16, lb, ub),
+                FaultyProblem(
+                    Sphere(),
+                    sigterm_generations=[6],
+                    sigterm_times=sigterm_times,
+                ),
+                n_steps=n_steps,
+                uid=u,
+            )
+            for u in range(n_tenants)
+        ]
+
+    def build(root):
+        return OptimizationService(
+            root,
+            lanes_per_pack=4,
+            segment_steps=4,
+            seed=0,
+            preemption=True,
+        )
+
+    clean = build(tmp_path / "clean")
+    for spec in specs(0):
+        clean.submit(spec)
+    clean.run()
+
+    svc = build(tmp_path / "pre")
+    for spec in specs(1):
+        svc.submit(spec)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with pytest.raises(Preempted) as exc_info:
+            svc.run()
+    assert exc_info.value.reason == "signal SIGTERM"
+    assert svc.stats.preemptions == 1
+    # EVERY tenant namespace holds an emergency checkpoint at the tripped
+    # boundary, marked preempted.
+    for u in range(n_tenants):
+        ns = tmp_path / "pre" / "tenants" / f"t{u}"
+        newest = sorted(ns.glob("ckpt_*.npz"))[-1]
+        manifest = read_manifest(newest)
+        assert manifest["preempted"] is True
+        assert manifest["generation"] == 9
+
+    resumed = build(tmp_path / "pre")
+    for spec in specs(0):
+        resumed.submit(spec)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        resumed.run()
+    for u in range(n_tenants):
+        rec = resumed.tenant(f"t{u}")
+        assert rec.generations == n_steps
+        assert any("resumed from" in e for e in rec.events)
+        final = resumed.result(f"t{u}")
+        baseline = clean.result(f"t{u}")
+        # num_preemptions counts the interruption itself (excluded, like
+        # the multihost acceptance); everything else is bitwise.
+        for (path, a), b in zip(
+            jax.tree_util.tree_leaves_with_path(baseline),
+            jax.tree_util.tree_leaves(final),
+        ):
+            name = jax.tree_util.keystr(path)
+            if "num_preemptions" in name:
+                assert int(b) == int(a) + 1
+                continue
+            if isinstance(a, jax.Array) and jax.dtypes.issubdtype(
+                a.dtype, jax.dtypes.prng_key
+            ):
+                a = jax.random.key_data(a)
+                b = jax.random.key_data(b)
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"tenant t{u}: leaf {name} differs after preemption resume"
+            )

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Load-test harness: packed per-tenant throughput vs the solo baseline.
+
+ISSUE 8's contract: packing tenants into ONE vmapped fused segment
+(``evox_tpu.service.TenantPack``) is the serving answer to the regressed
+dispatch-bound ``vmapped_instances`` bench (1023→580 gen/s on TPU): a small
+run stepped alone pays one dispatch per generation, while a packed lane
+pays ``1/lanes``-th of one dispatch per ``segment`` generations.  This
+harness pins the claim to a number and FAILS (exit 1) when a packed bucket
+of ``LANES`` tenants sustains less than ``FLOOR`` (70%) of the solo
+per-tenant generation rate.
+
+Definitions (per-tenant rate = generations EVERY tenant advances per
+wall-clock second — all lanes advance together, so the pack's segment rate
+IS each tenant's rate):
+
+* **solo_stepped** — the baseline: ONE tenant run the way a lone user runs
+  it today, a jitted ``step`` dispatched per generation.
+* **packed** — ``LANES`` tenants through ``TenantPack.run_segment``
+  (vmapped fused segments, ``SEGMENT`` generations per dispatch), boundary
+  ``device_get`` included.
+* **solo_fused** — informational: the same tenant through a width-1 pack
+  (what the solo tenant would get from the service), separating the
+  pack's vmap cost from its dispatch amortization.
+* **service_e2e** — informational: the full ``OptimizationService`` loop
+  (admission, per-lane verdicts, telemetry demux, namespace checkpoints)
+  over the same packed bucket, so the scheduling layer's overhead is a
+  recorded number instead of a rumor.
+
+The gate configuration is deliberately tiny (pop=16, dim=8): on this
+box's SINGLE CPU core all 64 lanes share one core, so the packed side
+only wins where dispatch — not compute — dominates; that is exactly the
+dispatch-bound regime the vmapped_instances bench regressed in.  On TPU
+the vector units absorb the lane axis and the ratio holds at production
+pop sizes — the committed CPU artifact is provisional until
+``tools/run_tpu_sweep.sh`` re-anchors it (``BENCH_HISTORY.json`` carries
+the ``indicative_only`` note).
+
+Run via::
+
+    ./run_tests.sh --service        # suite + this harness
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_service.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.algorithms import PSO  # noqa: E402
+from evox_tpu.problems.numerical import Ackley  # noqa: E402
+from evox_tpu.service import (  # noqa: E402
+    OptimizationService,
+    TenantPack,
+    TenantSpec,
+)
+from evox_tpu.workflows import StdWorkflow  # noqa: E402
+
+LANES = 64
+SEGMENT = 128  # generations per compiled pack dispatch
+N_STEPS = 512  # timed generations per pass
+POP, DIM = 8, 4  # dispatch-bound on one CPU core; TPU re-anchors bigger
+REPEATS = 3
+FLOOR = 0.70  # packed per-tenant rate must keep >=70% of solo_stepped
+
+LB = -32.0 * jnp.ones(DIM)
+UB = 32.0 * jnp.ones(DIM)
+
+
+def _wf():
+    return StdWorkflow(PSO(POP, LB, UB), Ackley())
+
+
+def _solo_stepped():
+    wf = _wf()
+    state = wf.init(jax.random.key(0))
+    state = jax.jit(wf.init_step)(state)
+    step = jax.jit(wf.step)
+    for _ in range(3):
+        state = step(state)
+    jax.block_until_ready(state)
+
+    def sweep():
+        s = state
+        for _ in range(N_STEPS):
+            s = step(s)
+        jax.block_until_ready(s)
+
+    return sweep
+
+
+def _packed(lanes):
+    wf = _wf()
+    pack = TenantPack(wf, lanes, early_stop=False)
+    for uid in range(lanes):
+        key = jax.random.fold_in(jax.random.key(0), jnp.uint32(uid))
+        state, _, _ = pack.init_tenant(wf.setup(key))
+        pack.admit(state, uid)
+    pack.run_segment(SEGMENT)  # warm/compile
+
+    def sweep():
+        done = 0
+        while done < N_STEPS:
+            pack.run_segment(SEGMENT)
+            done += SEGMENT
+
+    return sweep
+
+
+def _service_e2e(root):
+    svc = OptimizationService(
+        root,
+        lanes_per_pack=LANES,
+        segment_steps=SEGMENT,
+        max_queue=LANES + 1,
+        seed=0,
+        early_stop=False,
+        checkpoint_every=4,
+    )
+    # Effectively-unbounded budgets: the sweep measures the steady-state
+    # serving loop, so tenants must never retire mid-measurement.
+    for uid in range(LANES):
+        svc.submit(
+            TenantSpec(f"t{uid}", PSO(POP, LB, UB), Ackley(),
+                       n_steps=10**9, uid=uid)
+        )
+    svc.step()  # admit + warm the pack program
+
+    def sweep():
+        done = 0
+        while done < N_STEPS:
+            svc.step()
+            done += SEGMENT
+
+    return sweep
+
+
+def _time(sweep) -> list[float]:
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        sweep()
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def main() -> int:
+    # Each leg is measured in its own consecutive block: on a single-core
+    # box the legs share every cache, so interleaving them contaminates
+    # the gate pair with each other's working sets.
+    times: dict[str, list] = {}
+    times["solo_stepped"] = _time(_solo_stepped())
+    times["packed"] = _time(_packed(LANES))
+    times["solo_fused"] = _time(_packed(1))
+    with tempfile.TemporaryDirectory() as root:
+        times["service_e2e"] = _time(_service_e2e(root))
+
+    def gps(tag):
+        return N_STEPS / statistics.median(times[tag])
+
+    rates = {tag: gps(tag) for tag in times}
+    ratio = rates["packed"] / rates["solo_stepped"]
+    aggregate = rates["packed"] * LANES
+    result = {
+        "bench": "service_pack_throughput",
+        "backend": jax.default_backend(),
+        "lanes": LANES,
+        "segment": SEGMENT,
+        "n_steps": N_STEPS,
+        "pop_size": POP,
+        "dim": DIM,
+        "repeats": REPEATS,
+        "seconds": times,
+        "per_tenant_gens_per_sec": {t: round(r, 3) for t, r in rates.items()},
+        "aggregate_packed_gens_per_sec": round(aggregate, 3),
+        "packed_vs_solo_ratio": round(ratio, 4),
+        "floor_ratio": FLOOR,
+        "within_budget": ratio >= FLOOR,
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    # _gate suffix: bench.py's ``service_pack`` config owns the plain
+    # ``service_pack.<platform>.json`` artifact name.
+    out_path = os.path.join(
+        out_dir, f"service_pack_gate.{jax.default_backend()}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"service pack throughput ({LANES} x PSO pop={POP} dim={DIM}, "
+        f"segment={SEGMENT}): packed {rates['packed']:.0f} gen/s/tenant "
+        f"({aggregate:.0f} aggregate) vs solo stepped "
+        f"{rates['solo_stepped']:.0f} = {ratio * 100:.1f}% per-tenant rate "
+        f"kept (floor {FLOOR * 100:.0f}%); solo fused "
+        f"{rates['solo_fused']:.0f}, service end-to-end "
+        f"{rates['service_e2e']:.0f} gen/s/tenant"
+    )
+    print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+    if ratio < FLOOR:
+        print(
+            f"FAIL: packed per-tenant throughput {ratio * 100:.1f}% is "
+            f"under the {FLOOR * 100:.0f}% floor",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
