@@ -63,6 +63,30 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _load_obs():
+    """The obs plane's metrics/version modules, loaded by file path as a
+    standalone package: the parent process NEVER imports ``evox_tpu`` (a
+    transitive jax import that initializes a backend would re-introduce
+    exactly the hung-relay failure mode this harness quarantines in
+    subprocesses), and ``evox_tpu/obs`` is deliberately import-light
+    (stdlib-only at import time) to make this loadable."""
+    import importlib.util
+
+    pkg_dir = os.path.join(_REPO_ROOT, "evox_tpu", "obs")
+    name = "_bench_obs"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name,
+        os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 # ---------------------------------------------------------------------------
 # Benchmark configs.  Each returns a result dict with at least
 # {"metric", "value", "unit"}.  ``n_steps`` scales down on CPU fallback.
@@ -1251,6 +1275,9 @@ def run_child(config: str, platform: str, profile: bool) -> dict:
     result["platform"] = platform
     result["wall_s"] = round(time.perf_counter() - t0, 1)
     result["n_steps"] = n_steps
+    # Perf history and runtime telemetry share one versioned metric
+    # namespace: every artifact records which obs schema stamped it.
+    result["obs_schema_version"] = _load_obs().OBS_SCHEMA_VERSION
     if platform != "tpu":
         # Few-step single-core CPU numbers are noise relative to the TPU
         # targets; mark them so they are never read as baseline data.
@@ -1416,6 +1443,42 @@ def main() -> int:
             except OSError as e:
                 _log(f"artifact write failed for {name}: {e!r}")
         _log(json.dumps(results[name]))
+
+    # Per-config results ALSO flow through the obs metrics registry, so a
+    # sweep exports the same Prometheus text format runtime telemetry
+    # does — one metric namespace for perf history and live monitoring.
+    try:
+        obs = _load_obs()
+        registry = obs.MetricsRegistry()
+        for name, result in results.items():
+            if not result.get("value"):
+                continue
+            registry.gauge(
+                "evox_bench_result",
+                "Benchmark result value, labeled by config and unit.",
+                config=name,
+                unit=result.get("unit", ""),
+                platform=platform,
+            ).set(result["value"])
+            # Only export the ratio when a baseline comparison actually
+            # exists: a 0.0 placeholder would read as "total regression"
+            # on any dashboard, which "no data yet" is not.
+            vs = result.get("vs_baseline")
+            if vs:
+                registry.gauge(
+                    "evox_bench_vs_baseline",
+                    "Benchmark value relative to the stored baseline.",
+                    config=name,
+                    platform=platform,
+                ).set(vs)
+        prom_path = os.path.join(
+            _ARTIFACT_DIR, f"bench_metrics.{platform}.prom"
+        )
+        os.makedirs(_ARTIFACT_DIR, exist_ok=True)
+        registry.write_prometheus(prom_path)
+        _log(f"metrics snapshot -> {os.path.relpath(prom_path, _REPO_ROOT)}")
+    except Exception as e:  # metrics export must never fail a sweep
+        _log(f"bench metrics export failed: {e!r}")
 
     if args.all:
         # BENCH_ALL.json is the TPU sweep of record (BASELINE.md's table and

@@ -12,6 +12,7 @@ from . import (
     algorithms,
     core,
     metrics,
+    obs,
     operators,
     problems,
     resilience,
@@ -38,6 +39,7 @@ __all__ = [
     "algorithms",
     "core",
     "metrics",
+    "obs",
     "operators",
     "problems",
     "resilience",

@@ -1,0 +1,65 @@
+"""Unified observability plane: structured events, metrics, tracing.
+
+Three pillars, one import:
+
+* **Events** (:mod:`~evox_tpu.obs.events`) — typed :class:`Event` records
+  on an :class:`EventBus` with pluggable sinks (:class:`RingBufferSink`,
+  :class:`JsonlFileSink` with size-capped rotation, :class:`CallbackSink`
+  as the legacy string-callback adapter).
+* **Metrics** (:mod:`~evox_tpu.obs.metrics`) — a process-local
+  :class:`MetricsRegistry` of counters/gauges/histograms with label sets,
+  exported as a dict snapshot or Prometheus text format (atomic file
+  publish), and riding multi-host heartbeats via
+  ``HostHeartbeat(metrics=registry)``.
+* **Tracing** (:mod:`~evox_tpu.obs.trace`) — host-side segment spans
+  (aot-compile / execute / telemetry flush / checkpoint submit+barrier /
+  fleet barrier / health probe) exported as Chrome-trace/Perfetto JSON,
+  plus an opt-in ``jax.profiler.trace`` window around the Nth segment.
+
+The :class:`Observability` facade bundles all three; instrumented
+subsystems take it as a single ``obs=`` parameter.  Every exported
+artifact carries :data:`OBS_SCHEMA_VERSION`.
+
+**Contract:** all instrumentation is strictly host-side at segment
+boundaries — the fused ``lax.scan`` hot path is untouched (graftlint
+GL002 sweeps the call sites; ``tools/bench_obs_overhead.py`` gates the
+wall-clock cost at ≤2%; ``tests/test_obs.py`` pins bit-identity of
+instrumented vs uninstrumented runs).
+"""
+
+from .events import (
+    CallbackSink,
+    Event,
+    EventBus,
+    JsonlFileSink,
+    RingBufferSink,
+)
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    reset_default_registry,
+)
+from .plane import Observability
+from .trace import Span, Tracer
+from .version import OBS_SCHEMA_VERSION
+
+__all__ = [
+    "OBS_SCHEMA_VERSION",
+    "Event",
+    "EventBus",
+    "RingBufferSink",
+    "JsonlFileSink",
+    "CallbackSink",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "reset_default_registry",
+    "Span",
+    "Tracer",
+    "Observability",
+]

@@ -1,0 +1,165 @@
+"""Segment-level tracing: host-side spans, Chrome-trace/Perfetto export.
+
+The fused hot path is one ``lax.scan`` per checkpoint segment — XLA owns
+everything inside it, and ``jax.profiler`` already covers device time.
+What no existing tool shows is *where the boundary goes*: per segment,
+how much wall clock went to AOT compilation, to blocked execution, to the
+telemetry flush, to the checkpoint submit + writer barrier, to the fleet
+barrier, to the health probe.  :class:`Tracer` records exactly those as
+host-side spans — strictly at segment boundaries, never inside the
+compiled program — and exports them as Chrome-trace JSON that
+``chrome://tracing`` or https://ui.perfetto.dev loads directly.
+
+Spans nest naturally by time (a ``segment`` span encloses its
+``aot-compile`` and ``execute`` children; the whole run sits under one
+``run`` span): the Chrome trace viewer reconstructs the nesting from
+thread id + time containment, so the recorder stays a flat append-only
+list — one lock, two ``perf_counter`` calls per span.
+
+An opt-in ``jax.profiler.trace`` window can additionally capture the Nth
+segment (``profile_segment=N, profile_dir=...``): one segment of full
+device-level profiling without paying profiler overhead for the whole
+run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterator, Union
+
+from .version import OBS_SCHEMA_VERSION
+
+__all__ = ["Span", "Tracer"]
+
+
+@dataclass(frozen=True)
+class Span:
+    """One completed host-side span (microseconds, Chrome-trace ``ph:X``)."""
+
+    name: str
+    ts_us: float
+    dur_us: float
+    tid: int
+    args: dict[str, Any] = field(default_factory=dict)
+
+
+class Tracer:
+    """Append-only span recorder with Chrome-trace export.
+
+    :param profile_segment: opt-in — the 0-based segment index around
+        which the runner opens a ``jax.profiler.trace`` window (one
+        segment of device-level profiling; ``None`` disables).
+    :param profile_dir: where the profiler window writes its trace
+        (defaults to ``profile_trace`` under the working directory).
+    """
+
+    def __init__(
+        self,
+        *,
+        profile_segment: int | None = None,
+        profile_dir: Union[str, Path, None] = None,
+    ):
+        if profile_segment is not None and profile_segment < 0:
+            raise ValueError(
+                f"profile_segment must be >= 0, got {profile_segment}"
+            )
+        self.profile_segment = profile_segment
+        self.profile_dir = Path(profile_dir) if profile_dir else Path("profile_trace")
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        # Wall anchor: perf_counter gives monotonic high-resolution spans;
+        # the anchor lets a reader line the trace up with event t_wall.
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+        self.profiled_segments: list[int] = []
+
+    # -- recording ----------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, **args: Any) -> Iterator[None]:
+        """Record one complete span around the with-block."""
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            end = time.perf_counter()
+            self._append(name, start, end, args)
+
+    def record(self, name: str, start: float, end: float, **args: Any) -> None:
+        """Record a span from caller-measured ``perf_counter`` endpoints
+        (the runner already times compile/execute for ``segment_timings``;
+        re-measuring would double the clock calls)."""
+        self._append(name, start, end, args)
+
+    def _append(self, name: str, start: float, end: float, args: dict) -> None:
+        span = Span(
+            name=name,
+            ts_us=(start - self._t0) * 1e6,
+            dur_us=max(0.0, (end - start)) * 1e6,
+            tid=threading.get_ident(),
+            args=args,
+        )
+        with self._lock:
+            self._spans.append(span)
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    # -- the profiler window -------------------------------------------------
+    def maybe_profile(self, segment_index: int):
+        """A ``jax.profiler.trace`` context when ``segment_index`` is the
+        opted-in segment, else a no-op context.  Import is lazy so a
+        tracer never forces profiler machinery into processes that only
+        record spans."""
+        if (
+            self.profile_segment is None
+            or segment_index != self.profile_segment
+        ):
+            return contextlib.nullcontext()
+        import jax
+
+        self.profiled_segments.append(segment_index)
+        self.profile_dir.mkdir(parents=True, exist_ok=True)
+        return jax.profiler.trace(str(self.profile_dir))
+
+    # -- export --------------------------------------------------------------
+    def to_chrome_trace(self) -> dict[str, Any]:
+        """The Chrome-trace (Perfetto-loadable) JSON object."""
+        pid = os.getpid()
+        events = [
+            {
+                "name": span.name,
+                "ph": "X",
+                "ts": span.ts_us,
+                "dur": span.dur_us,
+                "pid": pid,
+                "tid": span.tid,
+                "args": span.args,
+            }
+            for span in self.spans()
+        ]
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "schema": OBS_SCHEMA_VERSION,
+                "wall_anchor": self._wall0,
+                "producer": "evox_tpu.obs",
+            },
+        }
+
+    def write(self, path: Union[str, Path]) -> Path:
+        """Write :meth:`to_chrome_trace` as JSON (loadable by
+        ``json.load`` and the Perfetto UI)."""
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+            f.write("\n")
+        return path

@@ -1,0 +1,18 @@
+"""Observability schema version.
+
+One integer stamped into every artifact the obs plane exports — JSONL
+event streams, Prometheus snapshot files, Chrome-trace JSON, and (via
+``bench.py``) every ``bench_artifacts/*.json`` — so perf history and
+runtime telemetry share one versioned metric namespace.  Bump it whenever
+an exported event field, metric name, or trace attribute changes meaning.
+
+Kept stdlib-only (no jax import, even transitively): ``bench.py``'s parent
+process never initializes a JAX backend and loads this module by file
+path.
+"""
+
+from __future__ import annotations
+
+OBS_SCHEMA_VERSION = 1
+
+__all__ = ["OBS_SCHEMA_VERSION"]

@@ -379,6 +379,7 @@ class HostHeartbeat:
         *,
         interval: float = 0.5,
         extra: Callable[[], Mapping[str, Any]] | None = None,
+        metrics: Any | None = None,
     ):
         """
         :param directory: heartbeat directory (created if absent).
@@ -389,11 +390,19 @@ class HostHeartbeat:
         :param extra: optional callable returning extra JSON-serializable
             payload fields merged into every beat (the hook a worker uses
             to self-report per-host deadline trips to the supervisor).
+        :param metrics: optional
+            :class:`~evox_tpu.obs.MetricsRegistry`: every beat carries
+            the registry's flat counters-and-gauges snapshot under a
+            ``"metrics"`` key, so a supervisor reading the heartbeat
+            plane (:func:`read_heartbeats`) sees per-host metrics with
+            no extra transport.  Publish failures follow the beat
+            contract: warn and drop, never kill the liveness thread.
         """
         self.directory = Path(directory)
         self._index = process_index
         self.interval = float(interval)
         self._extra = extra
+        self._metrics = metrics
         self._lock = threading.Lock()
         self._payload: dict[str, Any] = {
             "generation": 0,
@@ -424,6 +433,11 @@ class HostHeartbeat:
                 payload.update(self._extra())
             except Exception as e:  # pragma: no cover - broken reporter
                 payload["extra_error"] = repr(e)
+        if self._metrics is not None:
+            try:
+                payload["metrics"] = self._metrics.heartbeat_payload()
+            except Exception as e:  # pragma: no cover - broken registry
+                payload["metrics_error"] = repr(e)
         # Swallow EVERYTHING (not just OSError): a non-JSON-serializable
         # extra payload raising TypeError out of the daemon loop would
         # silently kill the liveness thread — and a stale beat gets a

@@ -121,6 +121,7 @@ from .runner import (
     ResilientRunner,
     RetryPolicy,
     RunStats,
+    SegmentTiming,
     WatchdogTimeout,
     default_retryable,
     latest_checkpoint,
@@ -138,6 +139,7 @@ __all__ = [
     "ResilientRunner",
     "RetryPolicy",
     "RunStats",
+    "SegmentTiming",
     "CheckpointSkip",
     "ResilienceError",
     "WatchdogTimeout",

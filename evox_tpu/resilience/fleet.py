@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Mapping, Sequence, Union
 
+from ..obs.plane import Observability, resolve_obs
 from ..parallel.multihost import (
     FLEET_ENV_ATTEMPT,
     FLEET_ENV_COORDINATOR,
@@ -254,6 +255,7 @@ class FleetSupervisor:
         attempt_timeout: float | None = None,
         on_event: Callable[[str], None] | None = None,
         spawn: Callable[..., Any] | None = None,
+        obs: Union["Observability", bool, None] = None,
     ):
         """
         :param command: maps a :class:`WorkerSpec` to the argv of one
@@ -302,6 +304,14 @@ class FleetSupervisor:
             supervisor's decision logic is unit-testable without real
             subprocesses; defaults to ``subprocess.Popen`` with logs under
             ``heartbeat_dir``.
+        :param obs: the :class:`~evox_tpu.obs.Observability` plane: every
+            supervisor decision (``launch``/``host-death``/``wedged``/
+            ``straggler``/``fleet-stall``/``relaunch``/``complete``)
+            publishes a structured ``fleet`` event alongside the legacy
+            ``on_event`` string, and ``evox_fleet_*`` metrics (attempts,
+            host deaths, quarantines, world size) feed the plane's
+            registry.  ``None`` builds a default plane; ``False``
+            disables instrumentation.
         """
         if num_processes < 1:
             raise ValueError(
@@ -343,13 +353,66 @@ class FleetSupervisor:
         )
         self.on_event = on_event
         self.spawn = spawn if spawn is not None else _default_spawn
+        self.obs = resolve_obs(obs, run_id=Path(checkpoint_dir).name)
+        self._metric_cursor: dict[str, float] = {}
         self.stats = FleetStats()
 
     # -- events --------------------------------------------------------------
+    # Supervisor decisions that mean something broke vs routine lifecycle.
+    _WARN_KINDS = (
+        "host-death",
+        "wedged",
+        "straggler",
+        "fleet-stall",
+        "stop",
+    )
+
     def _event(self, attempt: int, kind: str, detail: str) -> None:
         self.stats.events.append(FleetEvent(attempt, kind, detail))
+        if self.obs is not None:
+            self.obs.event(
+                "fleet",
+                f"[fleet attempt {attempt}] {kind}: {detail}",
+                severity="warning" if kind in self._WARN_KINDS else "info",
+                attempt=attempt,
+                kind=kind,
+            )
+            self.obs.counter(
+                "evox_fleet_events_total",
+                "Fleet supervisor decisions by kind.",
+                kind=kind,
+            ).inc()
+            self._publish_metrics()
         if self.on_event is not None:
             self.on_event(f"[fleet attempt {attempt}] {kind}: {detail}")
+
+    def _publish_metrics(self) -> None:
+        """Sync FleetStats into the registry (delta-published against a
+        cursor that resets with the stats, like the runner's — one shared
+        ``counter_sync`` definition)."""
+        s = self.stats
+        for name, value, help in (
+            ("evox_fleet_attempts_total", s.attempts, "Fleet attempts launched."),
+            ("evox_fleet_host_deaths_total", s.host_deaths, "Workers lost to exits or stale heartbeats."),
+            (
+                "evox_fleet_quarantines_total",
+                s.hosts_quarantined,
+                "Hosts quarantined as slow/wedged (culprit-less stalls included).",
+            ),
+            (
+                "evox_fleet_removed_hosts_total",
+                len(s.removed_hosts),
+                "Hosts removed from the fleet across attempts.",
+            ),
+        ):
+            self.obs.registry.counter_sync(
+                self._metric_cursor, name, value, help
+            )
+        if s.world_sizes:
+            self.obs.gauge(
+                "evox_fleet_world_size",
+                "Process count of the current fleet attempt.",
+            ).set(s.world_sizes[-1])
 
     # -- world planning ------------------------------------------------------
     def plan_relaunch(self, world: int, removed: set[int]) -> int:
@@ -561,6 +624,7 @@ class FleetSupervisor:
         :class:`FleetError` when the relaunch budget or ``min_processes``
         floor is hit (the stats ride on the exception)."""
         self.stats = FleetStats()
+        self._metric_cursor = {}
         world = self.num_processes
         attempt = 0
         while True:

@@ -57,11 +57,12 @@ import time
 import warnings
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any, Callable, Union
+from typing import Any, Callable, NamedTuple, Union
 
 import jax
 
 from ..core import State, Workflow
+from ..obs.plane import Observability, resolve_obs
 from ..utils.checkpoint import (
     AsyncCheckpointWriter,
     CheckpointCorruptError,
@@ -87,6 +88,7 @@ __all__ = [
     "ResilientRunner",
     "RetryPolicy",
     "RunStats",
+    "SegmentTiming",
     "CheckpointSkip",
     "ResilienceError",
     "WatchdogTimeout",
@@ -199,6 +201,23 @@ class CheckpointSkip:
     quarantined: bool = False
 
 
+class SegmentTiming(NamedTuple):
+    """Where one segment's wall clock went, measured at the boundary.
+
+    ``compile_seconds`` is the AOT compile paid for this segment's
+    program (0.0 once the executable is cached — only the first segment
+    of each distinct chunk length compiles); ``execute_seconds`` is
+    dispatch + ``block_until_ready``; ``checkpoint_block_seconds`` is how
+    long the loop was blocked publishing this boundary's checkpoint
+    (submit + predecessor barrier under the async writer).  On a retried
+    segment the numbers are the *successful* attempt's."""
+
+    generation: int
+    compile_seconds: float
+    execute_seconds: float
+    checkpoint_block_seconds: float
+
+
 @dataclass
 class RunStats:
     """Observable record of what the supervisor did during :meth:`run`.
@@ -237,6 +256,9 @@ class RunStats:
     # generations were lax.cond no-ops, and the boundary probe saw the
     # frozen state.
     early_stops: int = 0
+    # One SegmentTiming per executed segment (init segment included):
+    # where the wall clock went — compile vs execute vs checkpoint block.
+    segment_timings: list[SegmentTiming] = field(default_factory=list)
 
 
 def _numbered_checkpoints(
@@ -434,6 +456,7 @@ class ResilientRunner:
         fused_early_stop: bool = False,
         primary: bool | None = None,
         heartbeat: Any | None = None,
+        obs: Union[Observability, bool, None] = None,
     ):
         """
         :param workflow: any ``Workflow`` whose ``init_step``/``step`` are
@@ -604,6 +627,21 @@ class ResilientRunner:
             generation and the segment's execution seconds — the signal a
             :class:`~evox_tpu.resilience.FleetSupervisor` renders into
             per-host dead/wedged/slow verdicts.
+        :param obs: the :class:`~evox_tpu.obs.Observability` plane this
+            runner publishes through — structured events for every
+            supervisor decision (the string ``on_event`` callback keeps
+            working unchanged alongside), ``evox_runner_*`` metrics into
+            the plane's registry at every segment boundary, and (when the
+            plane carries a :class:`~evox_tpu.obs.Tracer`) host-side
+            spans per boundary phase plus an opt-in
+            ``jax.profiler.trace`` window around the Nth segment.
+            ``None`` (default) builds a plane on the process-local
+            default registry with an in-memory event ring; ``False``
+            disables instrumentation entirely.  All instrumentation is
+            strictly host-side at segment boundaries — the compiled
+            programs are identical with and without it
+            (``tests/test_obs.py`` pins bit-identity,
+            ``tools/bench_obs_overhead.py`` gates the wall-clock cost).
         """
         if checkpoint_every < 1:
             raise ValueError(
@@ -659,6 +697,10 @@ class ResilientRunner:
 
             self.store = ReadOnlyCheckpointStore()
         self.heartbeat = heartbeat
+        self.obs = resolve_obs(obs, run_id=Path(checkpoint_dir).name)
+        # Counters are monotone and (by default) process-shared: publish
+        # per-run stats as deltas against this cursor, reset with stats.
+        self._metric_cursor: dict[str, float] = {}
         if verify_resume not in (False, True, "full", "manifest"):
             raise ValueError(
                 f"verify_resume must be False, True, 'full', or "
@@ -680,6 +722,7 @@ class ResilientRunner:
                 store=self.store,
                 durable=True,
                 on_error=self._note_write_failure,
+                registry=self.obs.registry if self.obs is not None else None,
             )
             if async_checkpoints and self.primary
             else None
@@ -693,6 +736,7 @@ class ResilientRunner:
         self._adaptive_chunk = 1
         self._per_gen_ema: float | None = None
         self._last_exec_seconds = 0.0
+        self._last_compile_seconds = 0.0
         self.stats = RunStats()
         self._forced_cpu = False
         # Restart policies may swap ``workflow.algorithm`` (population
@@ -762,11 +806,142 @@ class ResilientRunner:
         )
 
     # -- events ------------------------------------------------------------
-    def _event(self, msg: str, *, warn: bool = False) -> None:
+    def _event(
+        self,
+        msg: str,
+        *,
+        warn: bool = False,
+        category: str = "runner",
+        **payload: Any,
+    ) -> None:
+        """One supervisor event: always onto the obs bus (typed, with
+        severity), AND through the legacy string callback / warning.
+
+        Historical bug (fixed here, regression-tested in
+        ``tests/test_obs.py``): with ``on_event`` set, warn-severity
+        events used to reach only the callback as a bare string — the
+        severity was silently dropped.  The bus now carries every event
+        with its severity regardless of the callback."""
+        if self.obs is not None:
+            self.obs.event(
+                category,
+                msg,
+                severity="warning" if warn else "info",
+                **payload,
+            )
         if self.on_event is not None:
             self.on_event(msg)
         elif warn:
             warnings.warn(msg)
+
+    def _span(self, name: str, **args: Any):
+        """A tracer span when the obs plane is live, else a no-op context
+        — the one guard every instrumented wait/flush site shares."""
+        if self.obs is not None:
+            return self.obs.span(name, **args)
+        return contextlib.nullcontext()
+
+    # -- metrics -----------------------------------------------------------
+    def _sync_counter(self, name: str, value: float, help: str = "") -> None:
+        """Publish a run-scoped monotone stat as a process-level counter
+        (delta against the per-run cursor; stats reset every ``run()``,
+        counters never do)."""
+        self.obs.registry.counter_sync(self._metric_cursor, name, value, help)
+
+    def _publish_metrics(self, state: State | None = None) -> None:
+        """Feed the registry from ``RunStats`` (and, when a state is at
+        hand, the monitor's in-state counters) — called at segment
+        boundaries and on every run exit, strictly host-side."""
+        if self.obs is None:
+            return
+        s = self.stats
+        self._sync_counter(
+            "evox_runner_generations_total",
+            s.completed_generations,
+            "Generations completed by ResilientRunner.",
+        )
+        self._sync_counter(
+            "evox_runner_segments_total", s.segments_run,
+            "Compiled segments executed.",
+        )
+        self._sync_counter(
+            "evox_runner_retries_total", s.retries, "Segment retries."
+        )
+        self._sync_counter(
+            "evox_runner_watchdog_timeouts_total", s.watchdog_timeouts,
+            "Segments abandoned past the watchdog deadline.",
+        )
+        self._sync_counter(
+            "evox_runner_cpu_fallbacks_total", s.cpu_fallbacks,
+            "Runs that fell back to the CPU backend.",
+        )
+        self._sync_counter(
+            "evox_runner_restarts_total", len(s.restarts),
+            "Health-triggered restart-policy firings.",
+        )
+        self._sync_counter(
+            "evox_runner_health_checks_total", s.health_checks,
+            "Boundary health probes run.",
+        )
+        self._sync_counter(
+            "evox_runner_unhealthy_probes_total", s.unhealthy_probes,
+            "Boundary health probes with unhealthy verdicts.",
+        )
+        self._sync_counter(
+            "evox_runner_early_stops_total", s.early_stops,
+            "Fused segments frozen early by the in-scan detector.",
+        )
+        self._sync_counter(
+            "evox_runner_checkpoints_written_total", s.checkpoints_written,
+            "Checkpoints durably published.",
+        )
+        self._sync_counter(
+            "evox_runner_checkpoint_write_failures_total",
+            s.checkpoint_write_failures,
+            "Checkpoint writes that failed (run continued).",
+        )
+        self._sync_counter(
+            "evox_runner_checkpoint_skips_total", len(s.checkpoint_skips),
+            "Resume candidates rejected by the scan.",
+        )
+        self._sync_counter(
+            "evox_runner_checkpoint_quarantines_total",
+            sum(1 for k in s.checkpoint_skips if k.quarantined),
+            "Byte-damaged checkpoints renamed *.corrupt.",
+        )
+        self._sync_counter(
+            "evox_runner_preemptions_total", 1.0 if s.preempted else 0.0,
+            "Graceful preemption stops (emergency checkpoint published).",
+        )
+        self._sync_counter(
+            "evox_runner_checkpoint_block_seconds_total",
+            s.checkpoint_block_seconds,
+            "Wall seconds the generation loop spent blocked on "
+            "checkpointing.",
+        )
+        if state is not None and "monitor" in state:
+            mon = state["monitor"]
+            # run_id label: gauges are last-write-wins, so two concurrent
+            # runners sharing the process registry must not clobber each
+            # other's boundary snapshots (counters aggregate fine
+            # unlabeled; gauges do not).
+            labels = (
+                {"run_id": self.obs.run_id}
+                if self.obs.run_id is not None
+                else {}
+            )
+            for key in (
+                "num_nonfinite",
+                "num_shard_quarantines",
+                "num_restarts",
+                "num_preemptions",
+            ):
+                if key in mon:
+                    self.obs.gauge(
+                        f"evox_monitor_{key}",
+                        "EvalMonitor in-state counter (boundary snapshot).",
+                        **labels,
+                    ).set(float(jax.device_get(mon[key])))
 
     # -- checkpointing -----------------------------------------------------
     def _ckpt_path(self, generation: int) -> Path:
@@ -813,6 +988,9 @@ class ResilientRunner:
             f"{exc}); continuing — the previous checkpoint remains the "
             f"resume point",
             warn=True,
+            category="checkpoint",
+            path=name,
+            error=f"{type(exc).__name__}: {exc}",
         )
 
     def _gc_stale_checkpoints(self) -> None:
@@ -835,7 +1013,8 @@ class ResilientRunner:
         """Wait out any in-flight async checkpoint write (no-op without a
         writer / pending work)."""
         if self._writer is not None:
-            self._writer.barrier()
+            with self._span("checkpoint-barrier"):
+                self._writer.barrier()
 
     def _fleet_sync(self) -> None:
         """Cross-host barrier at points where the single writer's disk
@@ -848,7 +1027,8 @@ class ResilientRunner:
             return
         from ..parallel.multihost import fleet_barrier
 
-        fleet_barrier("evox_tpu_runner_boundary")
+        with self._span("fleet-barrier"):
+            fleet_barrier("evox_tpu_runner_boundary")
 
     def _gather_state(self, state: State) -> State:
         """Make every state leaf process-addressable at a segment boundary.
@@ -911,7 +1091,11 @@ class ResilientRunner:
 
                 def _published(gen: int = generation) -> None:
                     self.stats.checkpoints_written += 1
-                    self._event(f"checkpoint written at generation {gen}")
+                    self._event(
+                        f"checkpoint written at generation {gen}",
+                        category="checkpoint",
+                        generation=gen,
+                    )
                     self._gc_stale_checkpoints()
 
                 self._writer.submit(
@@ -937,12 +1121,24 @@ class ResilientRunner:
             self.stats.checkpoints_written += 1
             self._event(
                 f"checkpoint written at generation {generation}"
-                + (" (emergency)" if emergency else "")
+                + (" (emergency)" if emergency else ""),
+                category="checkpoint",
+                generation=generation,
+                emergency=emergency,
             )
             self._gc_stale_checkpoints()
             return True
         finally:
-            self.stats.checkpoint_block_seconds += time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self.stats.checkpoint_block_seconds += t1 - t0
+            if self.obs is not None:
+                self.obs.record_span(
+                    "checkpoint-submit",
+                    t0,
+                    t1,
+                    generation=generation,
+                    emergency=emergency,
+                )
 
     def _pop_size_hint(self) -> int | None:
         """Population size for re-mesh divisibility checks, when the
@@ -1241,12 +1437,31 @@ class ResilientRunner:
             traced = lambda s: self._jit_segment(s, chunk)  # noqa: E731
             lower = lambda: self._jit_segment.lower(state, chunk)  # noqa: E731
         compile_now = lambda: lower().compile()  # noqa: E731
+        # The compile seconds used to be measured (excluded from the
+        # wall-interval EMA) and thrown away; keep them — they feed
+        # ``stats.segment_timings``, the compile histogram, and the
+        # ``aot-compile`` trace span.
+        t0 = time.perf_counter()
         if self.compile_timeout is not None:
             exe = self._with_deadline(
                 compile_now, self.compile_timeout, f"compile of {which}"
             )
         else:
             exe = compile_now()
+        t1 = time.perf_counter()
+        self._last_compile_seconds += t1 - t0
+        if self.obs is not None:
+            self.obs.record_span(
+                "aot-compile", t0, t1, which=which, chunk=chunk
+            )
+            self.obs.counter(
+                "evox_runner_compiles_total",
+                "Cold AOT compiles paid by the runner.",
+            ).inc()
+            self.obs.histogram(
+                "evox_runner_segment_compile_seconds",
+                "AOT compile seconds per compiled segment program.",
+            ).observe(t1 - t0)
 
         def call(s: State, _exe=exe, _traced=traced, _sig=sig) -> State:
             try:
@@ -1268,6 +1483,7 @@ class ResilientRunner:
     ) -> State:
         """One attempt: (cached) AOT compile, then watchdog-guarded
         execution to completion (``block_until_ready``)."""
+        self._last_compile_seconds = 0.0
         if self._forced_cpu:
             state = jax.device_put(state, self._cpu_device())
             ctx = jax.default_device(self._cpu_device())
@@ -1290,7 +1506,16 @@ class ResilientRunner:
                     run, self.watchdog_timeout, "segment execution"
                 )
             finally:
-                self._last_exec_seconds = time.perf_counter() - t0
+                t1 = time.perf_counter()
+                self._last_exec_seconds = t1 - t0
+                if self.obs is not None:
+                    self.obs.record_span(
+                        "execute", t0, t1, which=which, chunk=chunk
+                    )
+                    self.obs.histogram(
+                        "evox_runner_segment_execute_seconds",
+                        "Blocked execution seconds per segment attempt.",
+                    ).observe(t1 - t0)
 
     def _reload_for_retry(self, state: State, generation: int) -> State:
         """Best source of truth for a retry: the on-disk checkpoint of the
@@ -1372,7 +1597,8 @@ class ResilientRunner:
         """
         if self.health is None:
             return state, done
-        report = self.health.check(state, generation=done)
+        with self._span("health-probe", generation=done):
+            report = self.health.check(state, generation=done)
         self.stats.health_checks += 1
         self.stats.last_report = report
         if report.healthy:
@@ -1381,7 +1607,11 @@ class ResilientRunner:
         reasons = "; ".join(report.reasons)
         if self.restart is None or done >= n_steps:
             self._event(
-                f"unhealthy state at generation {done}: {reasons}", warn=True
+                f"unhealthy state at generation {done}: {reasons}",
+                warn=True,
+                category="health",
+                generation=done,
+                reasons=list(report.reasons),
             )
             return state, done
         if len(self.stats.restarts) >= self.max_restarts:
@@ -1389,6 +1619,9 @@ class ResilientRunner:
                 f"unhealthy state at generation {done} ({reasons}) but the "
                 f"restart budget of {self.max_restarts} is spent; continuing",
                 warn=True,
+                category="health",
+                generation=done,
+                reasons=list(report.reasons),
             )
             return state, done
         # Restart policies read checkpoints from disk (rollback scans the
@@ -1423,6 +1656,11 @@ class ResilientRunner:
             f"restart #{idx + 1} ({self.restart.name}) at generation {done}: "
             f"{reasons}",
             warn=True,
+            category="restart",
+            policy=self.restart.name,
+            generation=done,
+            restart_index=idx,
+            reasons=list(report.reasons),
         )
         # Give the restarted search a full window to prove itself: stale
         # pre-restart entries would otherwise re-trip the stagnation
@@ -1511,7 +1749,12 @@ class ResilientRunner:
             f"preempted at generation {done} ({reason}); emergency "
             f"checkpoint {outcome}",
             warn=True,
+            category="preemption",
+            generation=done,
+            reason=reason,
+            checkpoint_published=ok,
         )
+        self._publish_metrics(state)
         raise Preempted(
             f"run preempted at generation {done} ({reason}); rerun the same "
             f"supervisor to resume bit-identically from "
@@ -1589,6 +1832,9 @@ class ResilientRunner:
         if n_steps < 1:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
         self.stats = RunStats()
+        # The metric cursor tracks stats: both reset together, so counter
+        # deltas stay non-negative across runs of one runner.
+        self._metric_cursor = {}
         # A previous run's CPU fallback must not pin THIS run to the CPU
         # backend: give the (possibly recovered) accelerator a fresh chance.
         self._forced_cpu = False
@@ -1611,7 +1857,8 @@ class ResilientRunner:
                 self.preemption.install()
                 installed_guard = True
         try:
-            return self._run_supervised(state, n_steps, fresh)
+            with self._span("run", n_steps=n_steps):
+                return self._run_supervised(state, n_steps, fresh)
         finally:
             # The newest submitted checkpoint must be durably on disk by
             # the time control leaves the supervisor — whether the run
@@ -1622,6 +1869,9 @@ class ResilientRunner:
             t0 = time.perf_counter()
             self._barrier_writer()
             self.stats.checkpoint_block_seconds += time.perf_counter() - t0
+            # Final registry sync: async-writer publishes that landed
+            # during the barrier, the terminal block-seconds, failures.
+            self._publish_metrics()
             if installed_guard:
                 self.preemption.uninstall()
 
@@ -1664,14 +1914,27 @@ class ResilientRunner:
                 # resume point, not wait a whole first segment.
                 self._beat(done)
         if done == 0:
-            state = self._attempt(
-                "init", state, 0, "init_step (generation 1)"
+            # The init segment is segment index 0 of a fresh run for the
+            # opt-in profiler window (a resumed run has no init segment,
+            # so its first loop segment takes index 0 instead — the index
+            # counts segments executed by THIS run()).
+            profile_ctx = (
+                self.obs.maybe_profile(self.stats.segments_run)
+                if self.obs is not None
+                else contextlib.nullcontext()
             )
+            with profile_ctx:
+                state = self._attempt(
+                    "init", state, 0, "init_step (generation 1)"
+                )
             state = self._gather_state(state)
             done = 1
             self.stats.segments_run += 1
             self.stats.completed_generations = done
+            blocked0 = self.stats.checkpoint_block_seconds
             self._write_checkpoint(state, done)
+            self._record_segment_timing(done, blocked0)
+            self._publish_metrics(state)
             self._beat(done)
             probed = False
         while True:
@@ -1698,13 +1961,22 @@ class ResilientRunner:
             if done >= n_steps:
                 break
             chunk = min(self._next_chunk(), n_steps - done)
-            result = self._attempt(
-                "segment",
-                state,
-                done,
-                f"segment (generations {done + 1}..{done + chunk})",
-                chunk=chunk,
+            # Opt-in device profiling of exactly the Nth segment executed
+            # by this run() (fresh runs: init segment = 0): one
+            # jax.profiler.trace window, no profiler cost anywhere else.
+            profile_ctx = (
+                self.obs.maybe_profile(self.stats.segments_run)
+                if self.obs is not None
+                else contextlib.nullcontext()
             )
+            with profile_ctx:
+                result = self._attempt(
+                    "segment",
+                    state,
+                    done,
+                    f"segment (generations {done + 1}..{done + chunk})",
+                    chunk=chunk,
+                )
             if self.fused and chunk > 1:
                 state, stepped = self._consume_telemetry(result, done, chunk)
             else:
@@ -1725,10 +1997,29 @@ class ResilientRunner:
             self.stats.segments_run += 1
             self.stats.chunk_sizes.append(stepped)
             self.stats.completed_generations = done
+            blocked0 = self.stats.checkpoint_block_seconds
             self._write_checkpoint(state, done)
+            self._record_segment_timing(done, blocked0)
+            self._publish_metrics(state)
             self._beat(done)
             probed = False
         return state
+
+    def _record_segment_timing(self, done: int, blocked_before: float) -> None:
+        """Keep where this segment's wall clock went: the AOT compile the
+        boundary paid (0 once cached), blocked execution, and the
+        checkpoint submit+barrier block — the split ROADMAP item 1's
+        dispatch-overhead hunt needs per segment, not just as run totals."""
+        self.stats.segment_timings.append(
+            SegmentTiming(
+                generation=done,
+                compile_seconds=self._last_compile_seconds,
+                execute_seconds=self._last_exec_seconds,
+                checkpoint_block_seconds=(
+                    self.stats.checkpoint_block_seconds - blocked_before
+                ),
+            )
+        )
 
     def _consume_telemetry(
         self, result, done: int, chunk: int
@@ -1740,10 +2031,12 @@ class ResilientRunner:
         never duplicate history entries), and the early-stop accounting.
         Returns ``(state, generations_actually_executed)``."""
         state, telemetry = result
-        # Telemetry leaves can come back process-sharded like state leaves
-        # (the gather no-ops single-process and on replicated trees).
-        host = jax.device_get(self._gather_state(telemetry))
-        self.workflow.flush_telemetry(host)
+        with self._span("telemetry-flush", generation=done):
+            # Telemetry leaves can come back process-sharded like state
+            # leaves (the gather no-ops single-process and on replicated
+            # trees).
+            host = jax.device_get(self._gather_state(telemetry))
+            self.workflow.flush_telemetry(host)
         executed = int(host["executed"])
         if bool(host["stopped"]) and executed < chunk:
             self.stats.early_stops += 1

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import State
+from ..obs.plane import Observability, resolve_obs
 from ..resilience.health import HealthProbe
 from ..resilience.preemption import Preempted, PreemptionGuard
 from ..resilience.restart import perturb_prng_keys
@@ -161,6 +162,15 @@ class OptimizationService:
         ``EvalMonitor(ordered=False)`` (full fitness history).
     :param on_event: one human-readable line per service event; defaults
         to ``warnings.warn`` for failures and silence otherwise.
+    :param obs: the :class:`~evox_tpu.obs.Observability` plane: service
+        lifecycle publishes structured ``service`` events, per-tenant
+        lifecycle publishes ``tenant`` events carrying ``tenant_id``,
+        and ``evox_service_*`` / tenant-labeled ``evox_tenant_*``
+        metrics feed the plane's registry (rejections labeled by
+        structured reason, per-tenant generations/restarts/quarantines
+        by tenant id).  ``None`` builds a default plane; ``False``
+        disables instrumentation.  Strictly host-side at boundaries:
+        the packed segment programs are identical either way.
     """
 
     def __init__(
@@ -179,6 +189,7 @@ class OptimizationService:
         early_stop: bool = True,
         monitor_factory: Callable[[], EvalMonitor] | None = None,
         on_event: Callable[[str], None] | None = None,
+        obs: Union[Observability, bool, None] = None,
     ):
         if lanes_per_pack < 1:
             raise ValueError(
@@ -212,6 +223,7 @@ class OptimizationService:
             lambda: EvalMonitor(ordered=False)
         )
         self.on_event = on_event
+        self.obs = resolve_obs(obs, run_id=Path(root).name)
         self.stats = ServiceStats()
         self._tenants: dict[str, TenantRecord] = {}
         self._tenants_by_uid: dict[int, TenantRecord] = {}
@@ -225,7 +237,26 @@ class OptimizationService:
         self._base_key = jax.random.key(self.seed)
 
     # -- events -------------------------------------------------------------
-    def _event(self, msg: str, *, warn: bool = False) -> None:
+    def _event(
+        self,
+        msg: str,
+        *,
+        warn: bool = False,
+        category: str = "service",
+        tenant_id: str | None = None,
+        **payload: Any,
+    ) -> None:
+        """One service event: onto the obs bus (typed, severity intact —
+        same bugfix contract as ``ResilientRunner._event``), then through
+        the legacy string callback / warning."""
+        if self.obs is not None:
+            self.obs.event(
+                category,
+                msg,
+                severity="warning" if warn else "info",
+                tenant_id=tenant_id,
+                **payload,
+            )
         if self.on_event is not None:
             self.on_event(msg)
         elif warn:
@@ -233,7 +264,18 @@ class OptimizationService:
 
     def _note(self, record: TenantRecord, msg: str, *, warn: bool = False) -> None:
         record.events.append(msg)
-        self._event(f"tenant {record.spec.tenant_id}: {msg}", warn=warn)
+        self._event(
+            f"tenant {record.spec.tenant_id}: {msg}",
+            warn=warn,
+            category="tenant",
+            tenant_id=record.spec.tenant_id,
+            uid=record.uid,
+        )
+
+    # -- metrics ------------------------------------------------------------
+    def _inc(self, name: str, help: str = "", n: float = 1, **labels: Any) -> None:
+        if self.obs is not None:
+            self.obs.counter(name, help, **labels).inc(n)
 
     # -- admission control --------------------------------------------------
     def submit(self, spec: TenantSpec) -> TenantRecord:
@@ -245,6 +287,9 @@ class OptimizationService:
         :meth:`forget` first; a QUEUED/RUNNING id is a collision.
         """
         self.stats.submitted += 1
+        self._inc(
+            "evox_service_submitted_total", "Tenant submissions received."
+        )
         existing = self._tenants.get(spec.tenant_id)
         if existing is not None and existing.status in (
             TenantStatus.QUEUED,
@@ -296,6 +341,10 @@ class OptimizationService:
             existing.status = TenantStatus.QUEUED
             record = existing
             self.stats.readmissions += 1
+            self._inc(
+                "evox_service_readmissions_total",
+                "Evicted/quarantined tenants re-queued.",
+            )
             self._note(record, "re-queued for readmission")
         else:
             uid = spec.uid if spec.uid is not None else self._next_uid
@@ -317,9 +366,16 @@ class OptimizationService:
 
     def _reject(self, spec: TenantSpec, reason: str, detail: str):
         self.stats.rejections.append((spec.tenant_id, reason))
+        self._inc(
+            "evox_service_rejections_total",
+            "Submissions refused, by structured reason.",
+            reason=reason,
+        )
         self._event(
             f"rejected tenant {spec.tenant_id!r} ({reason}): {detail}",
             warn=True,
+            tenant_id=spec.tenant_id,
+            reason=reason,
         )
         raise AdmissionError(
             f"submission of tenant {spec.tenant_id!r} refused "
@@ -364,6 +420,11 @@ class OptimizationService:
         self._templates.pop((record.bucket, record.uid), None)
         self._tenants_by_uid.pop(record.uid, None)
         del self._tenants[tenant_id]
+        if self.obs is not None:
+            # Retire the tenant's metric series with its record: tenant
+            # churn must not grow the registry (and every snapshot /
+            # heartbeat payload) without bound.
+            self.obs.registry.remove_labeled("tenant_id", tenant_id)
 
     # -- checkpoint namespaces ----------------------------------------------
     def namespace(self, tenant_id: str) -> Path:
@@ -417,6 +478,10 @@ class OptimizationService:
             return
         record.segments_since_checkpoint = 0
         self.stats.checkpoints_written += 1
+        self._inc(
+            "evox_service_checkpoints_written_total",
+            "Tenant-namespace checkpoints published.",
+        )
 
     # -- tenant state construction -------------------------------------------
     def _tenant_key(self, uid: int) -> jax.Array:
@@ -570,6 +635,16 @@ class OptimizationService:
                     record.result = jax.device_get(state)
                     self.stats.admitted += 1
                     self.stats.completed += 1
+                    self._inc(
+                        "evox_service_admitted_total",
+                        "Tenants admitted to a lane (or completed at "
+                        "admission).",
+                    )
+                    self._inc(
+                        "evox_tenant_completed_total",
+                        "Tenant runs completed.",
+                        tenant_id=record.spec.tenant_id,
+                    )
                     self._note(
                         record,
                         f"resumed at generation {generations}, already at "
@@ -596,6 +671,10 @@ class OptimizationService:
             record.status = TenantStatus.RUNNING
             record.segments_since_checkpoint = 0
             self.stats.admitted += 1
+            self._inc(
+                "evox_service_admitted_total",
+                "Tenants admitted to a lane (or completed at admission).",
+            )
             self._note(
                 record,
                 f"admitted to lane {record.lane} at generation "
@@ -625,6 +704,10 @@ class OptimizationService:
         record.lane = None
         record.status = TenantStatus.EVICTED
         self.stats.evictions += 1
+        self._inc(
+            "evox_service_evictions_total",
+            "Tenants evicted to their checkpoint namespace.",
+        )
         self._note(record, "evicted (checkpointed; lane freed)")
 
     def _handle_preemption(self) -> None:
@@ -653,10 +736,16 @@ class OptimizationService:
             record.status = TenantStatus.EVICTED
             self._note(record, f"preempted ({reason}); lane freed")
         self.stats.preemptions += 1
+        self._inc(
+            "evox_service_preemptions_total",
+            "Service-wide graceful preemption stops.",
+        )
         self._event(
             f"preempted ({reason}); emergency checkpoints published for "
             f"every running tenant",
             warn=True,
+            category="preemption",
+            reason=reason,
         )
         raise Preempted(
             f"service preempted ({reason}); every running tenant's "
@@ -680,6 +769,10 @@ class OptimizationService:
                 continue
             telemetry = bucket.pack.run_segment(self.segment_steps)
             self.stats.segments_run += 1
+            self._inc(
+                "evox_service_segments_total",
+                "Packed fused segments dispatched.",
+            )
             stepped_any = True
             self._boundary(bucket, telemetry)
         # Late admissions: lanes freed by this round's retirements.
@@ -740,6 +833,13 @@ class OptimizationService:
             record = self._record_by_uid(uid)
             record.generations += int(executed[lane])
             record.segments_since_checkpoint += 1
+            if executed[lane]:
+                self._inc(
+                    "evox_tenant_generations_total",
+                    "Generations completed, per tenant.",
+                    n=int(executed[lane]),
+                    tenant_id=record.spec.tenant_id,
+                )
             if sinks and record.monitor is not None:
                 record.monitor.ingest_sinks(
                     meta_pairs, sinks, np.asarray(telemetry["executed"]),
@@ -747,6 +847,11 @@ class OptimizationService:
                 )
             if bool(stopped[lane]) and int(executed[lane]) < self.segment_steps:
                 self.stats.early_stops += 1
+                self._inc(
+                    "evox_tenant_early_stops_total",
+                    "In-scan lane freezes, per tenant.",
+                    tenant_id=record.spec.tenant_id,
+                )
                 self._note(
                     record,
                     f"in-scan early stop at generation "
@@ -782,6 +887,11 @@ class OptimizationService:
         bucket.pack.release(record.lane)
         record.lane = None
         self.stats.completed += 1
+        self._inc(
+            "evox_tenant_completed_total",
+            "Tenant runs completed.",
+            tenant_id=record.spec.tenant_id,
+        )
         self._note(
             record,
             f"completed at generation {record.generations} (lane freed)",
@@ -818,6 +928,11 @@ class OptimizationService:
                     record.monitor.truncate_history(generations)
                 self.health.reset_lane(record.uid)
                 self.stats.restarts += 1
+                self._inc(
+                    "evox_tenant_restarts_total",
+                    "Rollback restarts burned, per tenant.",
+                    tenant_id=record.spec.tenant_id,
+                )
                 self._note(
                     record,
                     f"restart #{record.restarts} (rollback to generation "
@@ -828,6 +943,11 @@ class OptimizationService:
         bucket.pack.set_frozen(record.lane, True)
         record.status = TenantStatus.QUARANTINED
         self.stats.quarantines += 1
+        self._inc(
+            "evox_tenant_quarantines_total",
+            "Lane freezes after a spent restart budget, per tenant.",
+            tenant_id=record.spec.tenant_id,
+        )
         self._checkpoint_tenant(
             record, bucket.pack.lane_state(record.lane)
         )

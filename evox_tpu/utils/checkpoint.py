@@ -705,6 +705,14 @@ class AsyncCheckpointWriter:
         thread for each failed write.
     :param idle_timeout: seconds of no work after which the worker thread
         exits (it restarts on demand).
+    :param registry: optional metrics registry (duck-typed
+        :class:`~evox_tpu.obs.MetricsRegistry`): each durable publish
+        increments ``evox_checkpoint_publishes_total`` and observes its
+        write seconds into ``evox_checkpoint_write_seconds``, each
+        failure increments ``evox_checkpoint_publish_failures_total``,
+        and every second :meth:`submit`/:meth:`barrier` keeps the caller
+        blocked lands in ``evox_checkpoint_block_seconds_total`` — the
+        writer's side of the observability plane's checkpoint story.
     """
 
     def __init__(
@@ -714,11 +722,13 @@ class AsyncCheckpointWriter:
         durable: bool = True,
         on_error: Callable[[Path, BaseException], None] | None = None,
         idle_timeout: float = 5.0,
+        registry: Any | None = None,
     ):
         self._store = store if store is not None else _DEFAULT_STORE
         self._durable = bool(durable)
         self._on_error = on_error
         self._idle_timeout = float(idle_timeout)
+        self._registry = registry
         self._cv = threading.Condition()
         self._job: tuple | None = None
         self._busy = False
@@ -764,6 +774,7 @@ class AsyncCheckpointWriter:
                 self._job = None
                 self._busy = True
             path, state, generation, metadata, on_published = job
+            t0 = time.perf_counter()
             try:
                 save_state(
                     path,
@@ -774,10 +785,23 @@ class AsyncCheckpointWriter:
                     durable=self._durable,
                 )
                 self.writes_completed += 1
+                self._metric(
+                    "evox_checkpoint_publishes_total",
+                    "Checkpoints durably published by the async writer.",
+                )
+                self._observe(
+                    "evox_checkpoint_write_seconds",
+                    time.perf_counter() - t0,
+                    "Serialize+digest+durable-publish seconds per write.",
+                )
                 if on_published is not None:
                     on_published()
             except BaseException as e:  # noqa: BLE001 - reported, not raised
                 self._errors.append((Path(path), e))
+                self._metric(
+                    "evox_checkpoint_publish_failures_total",
+                    "Checkpoint writes that failed on the writer thread.",
+                )
                 if self._on_error is not None:
                     try:
                         self._on_error(Path(path), e)
@@ -787,6 +811,25 @@ class AsyncCheckpointWriter:
                 with self._cv:
                     self._busy = False
                     self._cv.notify_all()
+
+    # -- metrics -----------------------------------------------------------
+    def _metric(self, name: str, help: str = "", amount: float = 1.0) -> None:
+        """Registry feed, failure-isolated: a broken registry must never
+        take down the write path it observes."""
+        if self._registry is None:
+            return
+        try:
+            self._registry.counter(name, help).inc(amount)
+        except Exception:  # pragma: no cover - broken registry
+            pass
+
+    def _observe(self, name: str, value: float, help: str = "") -> None:
+        if self._registry is None:
+            return
+        try:
+            self._registry.histogram(name, help).observe(value)
+        except Exception:  # pragma: no cover - broken registry
+            pass
 
     # -- caller side -------------------------------------------------------
     def submit(
@@ -803,11 +846,17 @@ class AsyncCheckpointWriter:
         returns without waiting for this write."""
         if self._closed:
             raise RuntimeError("AsyncCheckpointWriter is closed")
+        t0 = time.perf_counter()
         with self._cv:
             while self._job is not None or self._busy:
                 self._cv.wait()
             self._job = (Path(path), state, generation, metadata, on_published)
             self._cv.notify_all()
+        self._metric(
+            "evox_checkpoint_block_seconds_total",
+            "Seconds callers spent blocked on submit/barrier waits.",
+            amount=time.perf_counter() - t0,
+        )
         # AFTER the enqueue: a worker that idled out between our liveness
         # check and the enqueue would otherwise strand the job.
         self._ensure_thread()
@@ -819,15 +868,25 @@ class AsyncCheckpointWriter:
         if not self._closed and self._job is not None:
             self._ensure_thread()  # belt-and-braces against a stranded job
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._cv:
-            while self._job is not None or self._busy:
-                remaining = (
-                    None if deadline is None else deadline - time.monotonic()
-                )
-                if remaining is not None and remaining <= 0:
-                    return False
-                self._cv.wait(remaining)
-        return True
+        t0 = time.perf_counter()
+        try:
+            with self._cv:
+                while self._job is not None or self._busy:
+                    remaining = (
+                        None
+                        if deadline is None
+                        else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    self._cv.wait(remaining)
+            return True
+        finally:
+            self._metric(
+                "evox_checkpoint_block_seconds_total",
+                "Seconds callers spent blocked on submit/barrier waits.",
+                amount=time.perf_counter() - t0,
+            )
 
     def pop_errors(self) -> list[tuple[Path, BaseException]]:
         """Drain and return ``(path, exception)`` records of failed writes

@@ -52,6 +52,20 @@
 #                               per-tenant gen/s (artifact under
 #                               bench_artifacts/).  Runs under a HARD
 #                               wall-clock timeout like --multihost.
+#   ./run_tests.sh --obs        observability lane: the obs-plane suite
+#                               (event-bus ordering + JSONL rotation,
+#                               registry snapshot vs a real faulty run's
+#                               RunStats, Chrome-trace well-formedness,
+#                               per-tenant metric labels, instrumented-vs-
+#                               uninstrumented bit-identity), then a full
+#                               graftlint sweep (no obs call site may sit
+#                               in compiled scope — GL002 stays clean),
+#                               then the overhead gate: a fully-
+#                               instrumented fused run must keep ≥98% of
+#                               uninstrumented gen/s on the PSO Ackley
+#                               config (artifact under bench_artifacts/).
+#                               Runs under a HARD wall-clock timeout like
+#                               --multihost.
 #   ./run_tests.sh --multihost  multi-host fleet lane: the fast multihost
 #                               suite (FleetTopology/bootstrap/heartbeat/
 #                               verdict plumbing, single-writer checkpoint
@@ -113,6 +127,18 @@ if [ "$1" = "--service" ]; then
     "${CPU_ENV[@]}" python -m pytest \
     tests/test_service.py tests/test_preemption.py -q "$@" || exit 1
   exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_service.py
+fi
+if [ "$1" = "--obs" ]; then
+  shift
+  # Hard timeout (SIGKILL escalation), same pattern as --multihost: the
+  # chaos test delivers a real SIGTERM; a wedged run must fail loudly.
+  OBS_TIMEOUT="${EVOX_TPU_OBS_TIMEOUT:-900}"
+  timeout -k 30 "$OBS_TIMEOUT" \
+    "${CPU_ENV[@]}" python -m pytest tests/test_obs.py -q "$@" || exit 1
+  # No observability call site may land inside compiled scope: the full
+  # graftlint sweep (GL002 et al.) must stay clean against its baselines.
+  python -m tools.graftlint || exit 1
+  exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_obs_overhead.py
 fi
 if [ "$1" = "--multihost" ]; then
   shift

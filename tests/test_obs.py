@@ -1,0 +1,750 @@
+"""Observability-plane tests: event bus, metrics registry, tracing, and
+the cross-cutting contract that instrumentation never perturbs a run.
+
+The headline is the **chaos accounting acceptance**: one faulty
+``ResilientRunner`` run (NaN burst → quarantine, in-state corruption →
+health rollback, injected ENOSPC → checkpoint write failure, real SIGTERM
+→ graceful preemption) must leave a single JSONL event stream and a
+Prometheus snapshot that together account for every ``RunStats`` counter
+with matching values.  Around it: event-bus ordering and sink mechanics
+(ring buffer, JSONL rotation, legacy callback adapter), registry
+snapshot/exposition semantics, Chrome-trace well-formedness, per-tenant
+metric labels on a packed 4-tenant service run, per-segment timing
+capture, the ``_event(warn=True)`` severity-loss regression, and
+bit-identity of an instrumented vs uninstrumented fused run.
+"""
+
+import json
+import os
+import signal
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.algorithms import PSO
+from evox_tpu.obs import (
+    OBS_SCHEMA_VERSION,
+    CallbackSink,
+    EventBus,
+    JsonlFileSink,
+    MetricsRegistry,
+    Observability,
+    RingBufferSink,
+    Tracer,
+    default_registry,
+    reset_default_registry,
+)
+from evox_tpu.parallel.multihost import HostHeartbeat
+from evox_tpu.problems.numerical import Sphere
+from evox_tpu.resilience import (
+    FaultyProblem,
+    FaultyStore,
+    HealthProbe,
+    Preempted,
+    ResilientRunner,
+    RollbackToCheckpoint,
+)
+from evox_tpu.service import OptimizationService, TenantSpec, TenantStatus
+from evox_tpu.utils.checkpoint import AsyncCheckpointWriter
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+from tools.graftlint import CompileSentinel
+
+DIM = 6
+POP = 8
+LB = jnp.full((DIM,), -5.0)
+UB = jnp.full((DIM,), 5.0)
+
+
+@pytest.fixture
+def key():
+    return jax.random.key(0)
+
+
+def _wf(problem=None, monitor=None):
+    return StdWorkflow(
+        PSO(POP, LB, UB),
+        problem if problem is not None else Sphere(),
+        monitor=monitor,
+    )
+
+
+def _flat(state):
+    out = []
+    for leaf in jax.tree_util.tree_leaves(state):
+        if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key
+        ):
+            out.append(np.asarray(jax.random.key_data(leaf)))
+        else:
+            out.append(np.asarray(leaf))
+    return out
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+# ---------------------------------------------------------------------------
+# event bus + sinks
+# ---------------------------------------------------------------------------
+
+
+def test_event_fields_and_sequence():
+    bus = EventBus(run_id="r1")
+    ring = bus.add_sink(RingBufferSink(8))
+    e0 = bus.publish("runner", "first")
+    e1 = bus.publish(
+        "health", "second", severity="warning", tenant_id="t0", generation=3
+    )
+    assert (e0.seq, e1.seq) == (0, 1)
+    assert e1.t_mono >= e0.t_mono
+    assert e0.run_id == "r1" and e0.severity == "info"
+    assert e1.category == "health" and e1.tenant_id == "t0"
+    assert e1.payload == {"generation": 3}
+    assert [e.seq for e in ring.events()] == [0, 1]
+    with pytest.raises(ValueError, match="severity"):
+        bus.publish("runner", "bad", severity="loud")
+
+
+def test_event_bus_ordering_across_threads():
+    """seq is strictly increasing and every sink sees the same publish
+    order, even under concurrent publishers (the async-writer thread
+    publishes checkpoint events interleaved with main-loop events)."""
+    bus = EventBus()
+    ring = bus.add_sink(RingBufferSink(4096))
+
+    def worker(tag):
+        for i in range(200):
+            bus.publish("t", f"{tag}-{i}")
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in ("a", "b", "c")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    seqs = [e.seq for e in ring.events()]
+    assert len(seqs) == 600
+    assert seqs == sorted(seqs) == list(range(600))
+
+
+def test_ring_buffer_caps_at_capacity():
+    bus = EventBus()
+    ring = bus.add_sink(RingBufferSink(5))
+    for i in range(12):
+        bus.publish("t", str(i))
+    assert len(ring) == 5
+    assert [e.message for e in ring.events()] == ["7", "8", "9", "10", "11"]
+
+
+def test_jsonl_sink_rotation(tmp_path):
+    path = tmp_path / "events.jsonl"
+    bus = EventBus()
+    sink = bus.add_sink(JsonlFileSink(path, max_bytes=2000, keep=2))
+    for i in range(60):
+        bus.publish("t", f"event number {i}", index=i)
+    sink.close()
+    files = sink.files()
+    assert path in files and len(files) > 1  # rotated at least once
+    assert len(files) <= 3  # live + keep
+    records = []
+    for f in reversed(files):  # oldest rotation first
+        for rec in _read_jsonl(f):  # every line must parse cleanly
+            records.append(rec)
+    assert all(r["schema"] == OBS_SCHEMA_VERSION for r in records)
+    seqs = [r["seq"] for r in records]
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == 59  # the newest record survived the rotations
+    # The oldest records fell off the end of the retention window.
+    assert len(records) < 60
+
+
+def test_callback_sink_severity_floor():
+    lines, warn_lines = [], []
+    bus = EventBus()
+    bus.add_sink(CallbackSink(lines.append))
+    bus.add_sink(CallbackSink(warn_lines.append, min_severity="warning"))
+    bus.publish("t", "routine")
+    bus.publish("t", "broken", severity="warning")
+    assert lines == ["routine", "broken"]
+    assert warn_lines == ["broken"]
+
+
+def test_reentrant_sink_publish_does_not_deadlock():
+    """A forwarding sink that publishes back into the bus (a legacy
+    callback wired to re-log) must produce a nested event, not a
+    deadlock (regression: publish used to hold a non-reentrant lock
+    across sink emits)."""
+    bus = EventBus()
+    ring = bus.add_sink(RingBufferSink(16))
+
+    class Forwarder:
+        def emit(self, event):
+            if event.category != "fwd":  # don't recurse forever
+                bus.publish("fwd", f"saw {event.message}")
+
+    bus.add_sink(Forwarder())
+    bus.publish("t", "hello")
+    messages = {e.message for e in ring.events()}
+    assert messages == {"hello", "saw hello"}
+
+
+def test_broken_sink_is_detached_not_fatal():
+    class Broken:
+        def emit(self, event):
+            raise RuntimeError("disk gone")
+
+    bus = EventBus()
+    ring = bus.add_sink(RingBufferSink(8))
+    bus.add_sink(Broken())
+    bus.publish("t", "one")  # must not raise
+    bus.publish("t", "two")
+    messages = [e.message for e in ring.events()]
+    assert "one" in messages and "two" in messages
+    assert any("detached broken event sink" in m for m in messages)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", "Jobs.", kind="a")
+    c.inc()
+    c.inc(2)
+    assert reg.counter("jobs_total", kind="a") is c  # memoized handle
+    reg.counter("jobs_total", kind="b").inc(5)
+    reg.gauge("depth").set(3.5)
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(99.0)
+    snap = reg.snapshot()
+    assert snap['jobs_total{kind="a"}'] == 3
+    assert snap['jobs_total{kind="b"}'] == 5
+    assert snap["depth"] == 3.5
+    assert snap['lat_seconds_bucket{le="0.1"}'] == 1
+    assert snap['lat_seconds_bucket{le="1.0"}'] == 2
+    assert snap['lat_seconds_bucket{le="+Inf"}'] == 3
+    assert snap["lat_seconds_count"] == 3
+    assert snap["lat_seconds_sum"] == pytest.approx(99.55)
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("jobs_total")
+    # Re-requesting a memoized histogram with different buckets is loud,
+    # never a silent handle with the wrong distribution — but omitting
+    # buckets means "whatever the series has" (framework call sites pass
+    # none, so they compose with user-customized registrations).
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("lat_seconds", buckets=(0.5, 5.0))
+    assert reg.histogram("lat_seconds", buckets=(0.1, 1.0)) is h
+    assert reg.histogram("lat_seconds") is h
+
+
+def test_prometheus_nonfinite_values():
+    reg = MetricsRegistry()
+    reg.gauge("best").set(float("inf"))
+    reg.gauge("worst").set(float("-inf"))
+    reg.gauge("broken").set(float("nan"))
+    text = reg.to_prometheus()  # must not raise
+    assert "best +Inf" in text
+    assert "worst -Inf" in text
+    assert "broken NaN" in text
+
+
+def test_remove_labeled_retires_series():
+    reg = MetricsRegistry()
+    reg.counter("t_total", tenant_id="a").inc()
+    reg.counter("t_total", tenant_id="b").inc()
+    reg.gauge("g", tenant_id="a").set(1)
+    reg.counter("global_total").inc()
+    assert reg.remove_labeled("tenant_id", "a") == 2
+    snap = reg.snapshot()
+    assert 't_total{tenant_id="a"}' not in snap
+    assert snap['t_total{tenant_id="b"}'] == 1
+    assert snap["global_total"] == 1
+
+
+def test_prometheus_exposition(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("x_total", "Things.", a="q\"uo").inc(2)
+    reg.histogram("h_seconds", buckets=(0.5, 2.0)).observe(1.0)
+    text = reg.to_prometheus()
+    assert "# TYPE x_total counter" in text
+    assert "# HELP x_total Things." in text
+    assert 'x_total{a="q\\"uo"} 2' in text
+    assert f"evox_obs_schema_version {OBS_SCHEMA_VERSION}" in text
+    # Histogram buckets must appear in ascending le order, +Inf last.
+    lines = [l for l in text.splitlines() if l.startswith("h_seconds_bucket")]
+    assert lines == [
+        'h_seconds_bucket{le="0.5"} 0',
+        'h_seconds_bucket{le="2.0"} 1',
+        'h_seconds_bucket{le="+Inf"} 1',
+    ]
+    out = reg.write_prometheus(tmp_path / "metrics" / "snap.prom")
+    assert out.read_text() == text
+    assert not list(out.parent.glob("*.tmp.*"))  # atomic publish, no litter
+
+
+def test_heartbeat_payload_drops_buckets():
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc()
+    reg.histogram("h_seconds").observe(0.2)
+    payload = reg.heartbeat_payload()
+    assert payload["c_total"] == 1
+    assert payload["h_seconds_count"] == 1
+    assert payload["h_seconds_sum"] == pytest.approx(0.2)
+    assert not any("bucket" in k for k in payload)
+
+
+def test_default_registry_is_process_local_and_resettable():
+    reg = reset_default_registry()
+    assert default_registry() is reg
+    reg.counter("t_total").inc()
+    fresh = reset_default_registry()
+    assert default_registry() is fresh
+    assert "t_total" not in fresh.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_spans_and_chrome_trace(tmp_path):
+    tracer = Tracer()
+    with tracer.span("outer", phase="x"):
+        with tracer.span("inner"):
+            pass
+    names = [s.name for s in tracer.spans()]
+    assert names == ["inner", "outer"]  # completion order
+    inner, outer = tracer.spans()
+    # Containment is what the trace viewer nests by.
+    assert outer.ts_us <= inner.ts_us
+    assert outer.ts_us + outer.dur_us >= inner.ts_us + inner.dur_us
+    path = tracer.write(tmp_path / "trace.json")
+    doc = json.load(open(path))  # well-formed by construction
+    assert doc["otherData"]["schema"] == OBS_SCHEMA_VERSION
+    events = doc["traceEvents"]
+    assert {e["name"] for e in events} == {"inner", "outer"}
+    assert all(
+        e["ph"] == "X" and "ts" in e and "dur" in e and "tid" in e
+        for e in events
+    )
+    assert events[1]["args"] == {"phase": "x"}
+
+
+# ---------------------------------------------------------------------------
+# runner integration
+# ---------------------------------------------------------------------------
+
+
+def _obs(tmp_path, tracer=None):
+    return Observability(
+        registry=MetricsRegistry(),
+        tracer=tracer,
+        events_path=tmp_path / "events.jsonl",
+        run_id="test",
+    )
+
+
+def test_event_warn_reaches_callback_and_bus(tmp_path, key):
+    """Regression (ISSUE 9 satellite): with ``on_event`` set, a
+    warn-severity event used to reach only the callback as a bare string
+    — the severity was dropped.  It must now land on BOTH, with severity
+    intact on the bus."""
+    lines = []
+    obs = _obs(tmp_path)
+    runner = ResilientRunner(
+        _wf(), tmp_path / "ck", on_event=lines.append, obs=obs
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warnings.warn would fail
+        runner._event("something broke", warn=True)
+    assert lines == ["something broke"]
+    warn_events = [
+        e for e in obs.ring.events() if e.severity == "warning"
+    ]
+    assert [e.message for e in warn_events] == ["something broke"]
+    # Without a callback the legacy warning still fires AND the bus keeps
+    # the severity.
+    runner2 = ResilientRunner(_wf(), tmp_path / "ck2", obs=obs)
+    with pytest.warns(UserWarning, match="also broke"):
+        runner2._event("also broke", warn=True)
+    assert obs.ring.events()[-1].severity == "warning"
+
+
+def test_segment_timings_recorded(tmp_path, key):
+    wf = _wf(monitor=EvalMonitor())
+    runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=4)
+    runner.run(wf.init(key), 13)
+    timings = runner.stats.segment_timings
+    # init + three 4-gen segments (5, 9, 13).
+    assert [t.generation for t in timings] == [1, 5, 9, 13]
+    # First occurrence of each program shape compiles; repeats must not.
+    assert timings[0].compile_seconds > 0  # init program
+    assert timings[1].compile_seconds > 0  # the 4-gen segment program
+    assert timings[2].compile_seconds == 0.0
+    assert timings[3].compile_seconds == 0.0
+    assert all(t.execute_seconds > 0 for t in timings)
+    assert all(t.checkpoint_block_seconds >= 0 for t in timings)
+
+
+def test_chaos_run_accounts_for_every_stat(tmp_path, key):
+    """ACCEPTANCE: NaN burst (quarantine) + in-state corruption (health
+    rollback) + ENOSPC on one checkpoint save + real SIGTERM preemption,
+    all in one run — the JSONL stream and the Prometheus snapshot must
+    account for every RunStats counter with matching values."""
+    schedule = dict(
+        nan_generations=[4],
+        nan_rows=3,
+        corrupt_generations=[6],
+        corrupt_times=1,
+        sigterm_generations=[10],
+        sigterm_times=1,
+    )
+    store = FaultyStore(enospc_saves=[2])
+    mon = EvalMonitor(full_fit_history=False)
+    wf = _wf(FaultyProblem(Sphere(), **schedule), monitor=mon)
+    obs = _obs(tmp_path, tracer=Tracer())
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(),
+        restart=RollbackToCheckpoint(),
+        preemption=True,
+        store=store,
+        obs=obs,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with pytest.raises(Preempted):
+            runner.run(wf.init(key), 18)
+    stats = runner.stats
+    # The chaos actually happened.
+    assert len(stats.restarts) == 1
+    assert stats.checkpoint_write_failures >= 1
+    assert stats.preempted
+
+    snap = obs.registry.snapshot()
+    # Every RunStats counter is accounted for, value for value.
+    expected = {
+        "evox_runner_generations_total": stats.completed_generations,
+        "evox_runner_segments_total": stats.segments_run,
+        "evox_runner_retries_total": stats.retries,
+        "evox_runner_watchdog_timeouts_total": stats.watchdog_timeouts,
+        "evox_runner_cpu_fallbacks_total": stats.cpu_fallbacks,
+        "evox_runner_restarts_total": len(stats.restarts),
+        "evox_runner_health_checks_total": stats.health_checks,
+        "evox_runner_unhealthy_probes_total": stats.unhealthy_probes,
+        "evox_runner_early_stops_total": stats.early_stops,
+        "evox_runner_checkpoints_written_total": stats.checkpoints_written,
+        "evox_runner_checkpoint_write_failures_total": (
+            stats.checkpoint_write_failures
+        ),
+        "evox_runner_checkpoint_skips_total": len(stats.checkpoint_skips),
+        "evox_runner_checkpoint_quarantines_total": sum(
+            1 for s in stats.checkpoint_skips if s.quarantined
+        ),
+        "evox_runner_preemptions_total": 1,
+    }
+    for name, value in expected.items():
+        assert snap.get(name, 0) == value, name
+    # Monitor in-state counters rode out as run-labeled gauges (gauges
+    # are last-write-wins: concurrent runners must not clobber each
+    # other's series).
+    mon_label = '{run_id="test"}'
+    assert snap[f"evox_monitor_num_nonfinite{mon_label}"] >= 3  # NaN rows
+    assert snap[f"evox_monitor_num_restarts{mon_label}"] == 1
+    assert snap[f"evox_monitor_num_preemptions{mon_label}"] == 1
+    assert snap["evox_runner_checkpoint_block_seconds_total"] == (
+        pytest.approx(stats.checkpoint_block_seconds)
+    )
+
+    # The Prometheus exposition carries the same values.
+    prom_path = obs.registry.write_prometheus(tmp_path / "metrics.prom")
+    prom = {}
+    for line in prom_path.read_text().splitlines():
+        if line and not line.startswith("#"):
+            series, value = line.rsplit(" ", 1)
+            prom[series] = float(value)
+    for name, value in expected.items():
+        assert prom.get(name, 0) == value, name
+
+    # One JSONL stream tells the same story, in publish order.
+    obs.jsonl.close()
+    records = _read_jsonl(tmp_path / "events.jsonl")
+    assert [r["seq"] for r in records] == sorted(r["seq"] for r in records)
+    assert all(r["run_id"] == "test" for r in records)
+    by_cat = {}
+    for r in records:
+        by_cat.setdefault(r["category"], []).append(r)
+    restart_events = by_cat.get("restart", [])
+    assert len(restart_events) == len(stats.restarts)
+    assert restart_events[0]["payload"]["policy"] == "rollback"
+    assert restart_events[0]["severity"] == "warning"
+    assert len(by_cat.get("preemption", [])) == 1
+    failures = [
+        r
+        for r in by_cat.get("checkpoint", [])
+        if r["severity"] == "warning" and "failed" in r["message"]
+    ]
+    assert len(failures) == stats.checkpoint_write_failures
+
+    # The trace saw the boundary phases of a faulted run.
+    span_names = {s.name for s in obs.tracer.spans()}
+    assert {
+        "run",
+        "aot-compile",
+        "execute",
+        "checkpoint-submit",
+        "health-probe",
+    } <= span_names
+
+    # Resume epilogue: corrupt the newest checkpoint's bytes — the rerun
+    # quarantines it (the metric follows), falls back, and completes.
+    newest = max(
+        (tmp_path / "ck").glob("ckpt_*.npz"), key=lambda p: p.name
+    )
+    raw = bytearray(newest.read_bytes())
+    raw[len(raw) // 2] ^= 0x40
+    newest.write_bytes(raw)
+    runner2 = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        health=HealthProbe(),
+        restart=RollbackToCheckpoint(),
+        preemption=True,
+        obs=Observability(
+            registry=obs.registry, bus=obs.bus, run_id="test"
+        ),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        runner2.run(wf.init(key), 18)
+    quarantined = sum(
+        1 for s in runner2.stats.checkpoint_skips if s.quarantined
+    )
+    assert quarantined >= 1
+    snap2 = obs.registry.snapshot()
+    assert snap2["evox_runner_checkpoint_quarantines_total"] == (
+        expected["evox_runner_checkpoint_quarantines_total"] + quarantined
+    )
+    # The corrupted newest file was the emergency checkpoint; the rerun
+    # fell back to the ordinary boundary checkpoint before it.
+    assert runner2.stats.resumed_from_generation == 10
+    assert runner2.stats.completed_generations == 18
+
+
+def test_instrumented_vs_uninstrumented_bit_identity(tmp_path, key):
+    """Observability must never perturb the program: a fully-instrumented
+    fused run and an ``obs=False`` run of the same configuration produce
+    bit-identical final states (monitor history included)."""
+    finals = {}
+    histories = {}
+    for tag in ("instrumented", "bare"):
+        mon = EvalMonitor(full_fit_history=True)
+        wf = _wf(monitor=mon)
+        obs = (
+            Observability(
+                registry=MetricsRegistry(),
+                tracer=Tracer(),
+                events_path=tmp_path / f"{tag}.jsonl",
+            )
+            if tag == "instrumented"
+            else False
+        )
+        runner = ResilientRunner(
+            wf, tmp_path / tag, checkpoint_every=4, obs=obs
+        )
+        finals[tag] = runner.run(wf.init(key), 11)
+        histories[tag] = [np.asarray(f) for f in mon.fitness_history]
+    for a, b in zip(_flat(finals["instrumented"]), _flat(finals["bare"])):
+        np.testing.assert_array_equal(a, b)
+    assert len(histories["instrumented"]) == len(histories["bare"])
+    for a, b in zip(histories["instrumented"], histories["bare"]):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("segment", [0, 1])
+def test_profiler_window_around_nth_segment(tmp_path, key, segment):
+    """Segment 0 of a fresh run is the init segment — the window must
+    fire there too (regression: the init attempt used to be unwrapped,
+    so profile_segment=0 silently never fired)."""
+    tracer = Tracer(
+        profile_segment=segment, profile_dir=tmp_path / "prof"
+    )
+    wf = _wf()
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=3,
+        obs=Observability(registry=MetricsRegistry(), tracer=tracer),
+    )
+    runner.run(wf.init(key), 8)
+    assert tracer.profiled_segments == [segment]
+    # jax.profiler.trace produced its artifact directory.
+    produced = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(tmp_path / "prof")
+        for f in files
+    ]
+    assert produced
+
+
+# ---------------------------------------------------------------------------
+# service integration: per-tenant labels
+# ---------------------------------------------------------------------------
+
+
+def test_service_per_tenant_metric_labels(tmp_path):
+    reg = MetricsRegistry()
+    obs = Observability(
+        registry=reg, events_path=tmp_path / "svc.jsonl", run_id="svc"
+    )
+    svc = OptimizationService(
+        tmp_path / "root",
+        lanes_per_pack=4,
+        segment_steps=4,
+        seed=0,
+        obs=obs,
+    )
+    tenant_ids = [f"t{i}" for i in range(4)]
+    for tid in tenant_ids:
+        svc.submit(TenantSpec(tid, PSO(POP, LB, UB), Sphere(), n_steps=8))
+    svc.run()
+    snap = reg.snapshot()
+    for tid in tenant_ids:
+        assert svc.tenant(tid).status is TenantStatus.COMPLETED
+        label = f'{{tenant_id="{tid}"}}'
+        assert snap[f"evox_tenant_generations_total{label}"] == 8
+        assert snap[f"evox_tenant_completed_total{label}"] == 1
+    assert snap["evox_service_submitted_total"] == 4
+    assert snap["evox_service_admitted_total"] == 4
+    assert snap["evox_service_segments_total"] >= 2
+    # Retiring a tenant record retires its metric series (tenant churn
+    # must not grow the registry without bound).
+    svc.forget("t0")
+    snap_after = reg.snapshot()
+    assert not any('tenant_id="t0"' in k for k in snap_after)
+    assert snap_after['evox_tenant_generations_total{tenant_id="t1"}'] == 8
+    # Tenant events carry the tenant identity on the bus.
+    obs.jsonl.close()
+    records = _read_jsonl(tmp_path / "svc.jsonl")
+    tenant_records = [r for r in records if r["category"] == "tenant"]
+    assert {r["tenant_id"] for r in tenant_records} == set(tenant_ids)
+
+
+def test_service_rejection_reason_labels(tmp_path):
+    reg = MetricsRegistry()
+    svc = OptimizationService(
+        tmp_path / "root",
+        lanes_per_pack=1,
+        segment_steps=2,
+        max_queue=1,
+        obs=Observability(registry=reg),
+    )
+    svc.submit(TenantSpec("a", PSO(POP, LB, UB), Sphere(), n_steps=2))
+    from evox_tpu.service import AdmissionError
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with pytest.raises(AdmissionError):
+            svc.submit(
+                TenantSpec("b", PSO(POP, LB, UB), Sphere(), n_steps=2)
+            )
+    snap = reg.snapshot()
+    assert snap['evox_service_rejections_total{reason="queue-full"}'] == 1
+
+
+# ---------------------------------------------------------------------------
+# writer / heartbeat / compile-sentinel feeds
+# ---------------------------------------------------------------------------
+
+
+def test_async_writer_feeds_registry(tmp_path, key):
+    from evox_tpu.core import State
+
+    reg = MetricsRegistry()
+    state = State(x=jnp.arange(4.0))
+    writer = AsyncCheckpointWriter(registry=reg)
+    writer.submit(tmp_path / "a.npz", state, generation=1)
+    writer.barrier()
+    snap = reg.snapshot()
+    assert snap["evox_checkpoint_publishes_total"] == 1
+    assert snap["evox_checkpoint_write_seconds_count"] == 1
+    assert snap["evox_checkpoint_block_seconds_total"] >= 0
+    failing = AsyncCheckpointWriter(
+        registry=reg, store=FaultyStore(enospc_saves=[0])
+    )
+    failing.submit(tmp_path / "b.npz", state, generation=2)
+    failing.barrier()
+    assert (
+        reg.snapshot()["evox_checkpoint_publish_failures_total"] == 1
+    )
+    writer.close()
+    failing.close()
+
+
+def test_heartbeat_carries_registry_payload(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("evox_runner_retries_total").inc(3)
+    hb = HostHeartbeat(tmp_path, 0, metrics=reg)
+    hb.beat(generation=5)
+    beat = json.loads(hb.path.read_text())
+    assert beat["generation"] == 5
+    assert beat["metrics"]["evox_runner_retries_total"] == 3
+
+
+def test_compile_sentinel_feeds_registry(key):
+    reg = MetricsRegistry()
+
+    def total():
+        return sum(
+            v
+            for k, v in reg.snapshot().items()
+            if k.startswith("evox_compile_total")
+        )
+
+    sentinel = CompileSentinel(registry=reg)
+    with sentinel:
+        jax.block_until_ready(jax.jit(lambda x: x * 2.0)(jnp.ones(3)))
+    assert sentinel.count() >= 1
+    assert total() == sentinel.count()
+    # Re-entering the same sentinel must not re-count the first scope.
+    with sentinel:
+        pass
+    assert total() == sentinel.count()
+
+
+def test_jsonl_sink_reprs_unserializable_payload(tmp_path):
+    bus = EventBus()
+    sink = bus.add_sink(JsonlFileSink(tmp_path / "e.jsonl"))
+    bus.publish("t", "odd payload", weird=object(), fine=3)
+    sink.close()
+    (rec,) = _read_jsonl(tmp_path / "e.jsonl")
+    assert rec["payload"]["fine"] == 3
+    assert rec["payload"]["weird"].startswith("<object object")
+
+
+# ---------------------------------------------------------------------------
+# preemption guard cleanup (the chaos test installs real handlers)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _restore_sigterm():
+    before = signal.getsignal(signal.SIGTERM)
+    yield
+    signal.signal(signal.SIGTERM, before)

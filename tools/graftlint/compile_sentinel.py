@@ -78,10 +78,22 @@ class _CaptureHandler(logging.Handler):
 
 
 class CompileSentinel:
-    """Context manager recording every XLA compilation in its scope."""
+    """Context manager recording every XLA compilation in its scope.
 
-    def __init__(self) -> None:
+    ``registry`` (optional, duck-typed
+    :class:`evox_tpu.obs.MetricsRegistry`) feeds the observability
+    plane: on scope exit every recorded compilation increments
+    ``evox_compile_total{fn="<name>"}`` — so compile counts share the
+    metric namespace with runtime telemetry and a gate's trip is visible
+    in the same Prometheus snapshot as the run it happened in.  Kept
+    duck-typed so this tools-side module never imports the framework."""
+
+    def __init__(self, registry=None) -> None:
         self.events: list[CompileEvent] = []
+        self._registry = registry
+        # Events already fed to the registry: a sentinel re-entered for a
+        # second scope must not re-count the first scope's compilations.
+        self._counted = 0
 
     def __enter__(self) -> "CompileSentinel":
         self._handler = _CaptureHandler(self.events)
@@ -104,6 +116,17 @@ class CompileSentinel:
             lg.setLevel(level)
             lg.propagate = propagate
         self._log_ctx.__exit__(*exc_info)
+        if self._registry is not None:
+            try:
+                for event in self.events[self._counted :]:
+                    self._registry.counter(
+                        "evox_compile_total",
+                        "XLA compilations observed by CompileSentinel.",
+                        fn=event.name,
+                    ).inc()
+            except Exception:  # registry trouble must not mask the scope
+                pass
+        self._counted = len(self.events)
 
     # -- queries ------------------------------------------------------------
     def names(self) -> list[str]:
