@@ -64,27 +64,16 @@ def _log(msg: str) -> None:
 
 
 def _load_obs():
-    """The obs plane's metrics/version modules, loaded by file path as a
-    standalone package: the parent process NEVER imports ``evox_tpu`` (a
-    transitive jax import that initializes a backend would re-introduce
-    exactly the hung-relay failure mode this harness quarantines in
-    subprocesses), and ``evox_tpu/obs`` is deliberately import-light
-    (stdlib-only at import time) to make this loadable."""
-    import importlib.util
+    """The obs plane, loaded by file path as a standalone package: the
+    parent process NEVER imports ``evox_tpu`` (a transitive jax import
+    that initializes a backend would re-introduce exactly the hung-relay
+    failure mode this harness quarantines in subprocesses).  One shared
+    loader (``tools/obs_loader.py``) serves every jax-free entry point."""
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    from tools.obs_loader import load_obs
 
-    pkg_dir = os.path.join(_REPO_ROOT, "evox_tpu", "obs")
-    name = "_bench_obs"
-    if name in sys.modules:
-        return sys.modules[name]
-    spec = importlib.util.spec_from_file_location(
-        name,
-        os.path.join(pkg_dir, "__init__.py"),
-        submodule_search_locations=[pkg_dir],
-    )
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules[name] = mod
-    spec.loader.exec_module(mod)
-    return mod
+    return load_obs("_bench_obs")
 
 
 # ---------------------------------------------------------------------------
@@ -93,19 +82,25 @@ def _load_obs():
 # ---------------------------------------------------------------------------
 
 
-def _dump_compiled(compiled, profile_dir: str) -> None:
+def _dump_compiled(compiled, profile_dir: str, n_steps: int | None = None) -> None:
     """The "torch._dynamo.explain" role: dump the optimized HLO, plus XLA's
-    own cost model (flops / bytes accessed) for roofline math.  Shared by
-    every profiled config so the dump contents cannot drift per config."""
+    own cost model (flops / bytes accessed / memory analysis) for roofline
+    math.  Shared by every profiled config so the dump contents cannot
+    drift per config — and the cost/memory capture itself is
+    ``obs.xla.write_cost_analysis``, the SAME code the resilient runner
+    uses for its ``evox_segment_*`` gauges (one definition, artifact
+    format unchanged; ``n_steps`` rides in fused whole-run profiles so
+    the roofline math can normalize to per-generation)."""
     os.makedirs(profile_dir, exist_ok=True)
     with open(os.path.join(profile_dir, "step_hlo.txt"), "w") as f:
         f.write(compiled.as_text())
-    try:
-        cost = compiled.cost_analysis()
-        with open(os.path.join(profile_dir, "cost_analysis.json"), "w") as f:
-            json.dump({k: v for k, v in sorted(cost.items())}, f, indent=1)
-    except Exception as e:  # cost model coverage varies by backend
-        _log(f"cost_analysis unavailable: {e!r}")
+    cost = _load_obs().xla.write_cost_analysis(
+        compiled,
+        profile_dir,
+        extra=None if n_steps is None else {"n_steps": n_steps},
+    )
+    if cost is None:  # cost model coverage varies by backend
+        _log("cost_analysis unavailable on this backend")
 
 
 def _timed_steps(
@@ -314,15 +309,13 @@ def _timed_fused(wf, n_steps: int, metric: str, profile_dir=None) -> dict:
         compiled = run.lower(state).compile()
         with open(os.path.join(profile_dir, "run_hlo.txt"), "w") as f:
             f.write(compiled.as_text())
-        try:
-            cost = compiled.cost_analysis()
-            with open(os.path.join(profile_dir, "cost_analysis.json"), "w") as f:
-                # Whole-program costs; divide by n_steps for per-generation.
-                json.dump(
-                    {"n_steps": n_steps, **dict(sorted(cost.items()))}, f, indent=1
-                )
-        except Exception as e:
-            _log(f"cost_analysis unavailable: {e!r}")
+        # Whole-program costs; n_steps rides in the artifact so
+        # roofline_from_cost can normalize to per-generation.  One
+        # writer (obs.xla) for fused and per-step profiles alike.
+        if _load_obs().xla.write_cost_analysis(
+            compiled, profile_dir, extra={"n_steps": n_steps}
+        ) is None:
+            _log("cost_analysis unavailable on this backend")
     jax.block_until_ready(run(state))  # compile + warm-up run (donates state)
     state = fresh_state()
     t0 = time.perf_counter()

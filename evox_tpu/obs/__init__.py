@@ -13,20 +13,32 @@ Three pillars, one import:
   ``HostHeartbeat(metrics=registry)``.
 * **Tracing** (:mod:`~evox_tpu.obs.trace`) — host-side segment spans
   (aot-compile / execute / telemetry flush / checkpoint submit+barrier /
-  fleet barrier / health probe) exported as Chrome-trace/Perfetto JSON,
-  plus an opt-in ``jax.profiler.trace`` window around the Nth segment.
+  fleet barrier / health probe) plus counter tracks (device memory,
+  generations/sec) exported as Chrome-trace/Perfetto JSON, plus an
+  opt-in ``jax.profiler.trace`` window around the Nth segment.
+* **Flight recorder** (:mod:`~evox_tpu.obs.flight`) — per-generation
+  algorithm-internal signals batched out of the fused segment scan,
+  ring-buffered on host, dumped as schema-stamped postmortem bundles on
+  health restarts / early stops / preemptions / quarantine storms.
+* **Program introspection** (:mod:`~evox_tpu.obs.xla`) — XLA
+  cost/memory analysis captured per AOT-compiled segment program, live
+  device-memory gauges, and the shared achieved-vs-peak roofline math.
 
-The :class:`Observability` facade bundles all three; instrumented
-subsystems take it as a single ``obs=`` parameter.  Every exported
-artifact carries :data:`OBS_SCHEMA_VERSION`.
+The :class:`Observability` facade bundles them; instrumented subsystems
+take it as a single ``obs=`` parameter.  Every exported artifact
+carries :data:`OBS_SCHEMA_VERSION`.
 
 **Contract:** all instrumentation is strictly host-side at segment
-boundaries — the fused ``lax.scan`` hot path is untouched (graftlint
-GL002 sweeps the call sites; ``tools/bench_obs_overhead.py`` gates the
-wall-clock cost at ≤2%; ``tests/test_obs.py`` pins bit-identity of
-instrumented vs uninstrumented runs).
+boundaries — the one in-program feature, the flight recorder's signals,
+rides as pure ``lax.scan`` *outputs* with a bit-identical carry
+(graftlint GL002 sweeps the call sites; ``tools/bench_obs_overhead.py``
+gates throughput with two floors — plane-only ≥98% [identical program],
+flight-on ≥85% on CPU [a different compiled program; ~3% by XLA's cost
+model]; ``tests/test_obs.py`` + ``tests/test_flight.py`` pin
+bit-identity of instrumented vs uninstrumented runs).
 """
 
+from . import xla
 from .events import (
     CallbackSink,
     Event,
@@ -34,6 +46,7 @@ from .events import (
     JsonlFileSink,
     RingBufferSink,
 )
+from .flight import FlightRecorder, finalize_row, flight_signals
 from .metrics import (
     Counter,
     Gauge,
@@ -43,7 +56,7 @@ from .metrics import (
     reset_default_registry,
 )
 from .plane import Observability
-from .trace import Span, Tracer
+from .trace import CounterSample, Span, Tracer
 from .version import OBS_SCHEMA_VERSION
 
 __all__ = [
@@ -60,6 +73,11 @@ __all__ = [
     "default_registry",
     "reset_default_registry",
     "Span",
+    "CounterSample",
     "Tracer",
     "Observability",
+    "FlightRecorder",
+    "finalize_row",
+    "flight_signals",
+    "xla",
 ]

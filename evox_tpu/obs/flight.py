@@ -1,0 +1,494 @@
+"""Device-side flight recorder: per-generation signals, postmortem bundles.
+
+PR 9's obs plane stops at the segment boundary: when a health probe
+triggers a rollback, or an in-scan early stop freezes a poisoned state,
+the event stream says *that* it happened but not *what the population was
+doing* in the generations before.  This module is the black box.
+
+Two halves:
+
+* :func:`flight_signals` — a pure, jittable ``state -> {signal: scalar}``
+  extraction of the algorithm-internal per-generation signals (best/mean/
+  worst fitness, population diversity, ES step size, velocity norms, the
+  monitor's cumulative quarantine counters).  ``StdWorkflow``'s fused
+  segment program evaluates it on every generation's stepped state and
+  batches the scalars out as additional telemetry — the same
+  ``lax.scan``-output mechanism ``best_fitness`` already rides, so the
+  hot path gains **zero host callbacks** and vmapped packs
+  (:class:`~evox_tpu.service.TenantPack`) get the signals per lane.
+
+* :class:`FlightRecorder` — a host-side bounded ring of the most recent
+  generations' signal rows, fed once per segment at the telemetry flush.
+  Attached to the :class:`~evox_tpu.obs.EventBus` as a sink, it dumps a
+  structured **postmortem bundle** (``manifest.json`` + ``flight.jsonl``,
+  schema-stamped with :data:`OBS_SCHEMA_VERSION`) whenever a trigger
+  event fires — a health restart, an unhealthy-state warning / in-scan
+  early stop, a preemption, a tenant-lifecycle warning — or when its own
+  quarantine-storm detector sees the window's quarantine count jump.
+
+Kept stdlib-only at import time (jax is imported lazily inside
+:func:`flight_signals`): ``bench.py``'s backend-free parent loads the
+``obs`` package by file path.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Any, Mapping, Union
+
+from .version import OBS_SCHEMA_VERSION
+
+__all__ = ["FlightRecorder", "finalize_row", "flight_signals"]
+
+# Bus categories that can trip a postmortem dump.  "health" and "tenant"
+# additionally require warning severity (routine tenant lifecycle lines —
+# admission, completion — are info and must not dump).
+TRIGGER_CATEGORIES = ("restart", "preemption", "health", "tenant")
+
+# The 2-D signals (pop_diversity, velocity_norm) leave the compiled
+# program as RAW whole-tensor moment sums (``_pop_sum``/``_pop_sumsq``/
+# ``_velocity_sumsq`` + their static counts) and are finished into
+# semantic values on the host (:func:`finalize_row`).  That split is
+# load-bearing (measured on CPU XLA at the PSO 1024×100 gate config):
+# a bare full-array-to-scalar reduction fuses into the producer loop the
+# step already runs (≈0 extra FLOPs — the ≥98% throughput gate) and
+# leaves the scan carry bit-identical, while EVERY richer in-program
+# shape tried — partial reductions (``axis=0``), dot-shaped column sums,
+# a single-element SLICE of a carry array (+2M FLOPs/segment of producer
+# remat for reading pop[0,0]), even combining the two raw sums into one
+# variance expression — either shifts the carry by ulps or duplicates
+# compute.  The price: per-dimension statistics are out — the flight
+# series carries whole-tensor spread/RMS trajectories, and the health
+# probe's *gating* scan keeps the per-dimension centered forms at
+# segment boundaries.
+
+
+def flight_signals(state: Any, raw: bool = False) -> dict[str, Any]:
+    """Pure ``state -> {signal: scalar}`` per-generation signal extraction.
+
+    Jittable; all branching is on the *structure* of ``state`` (static
+    under jit), so the emitted key set is stable per workflow
+    configuration.  With ``raw=True`` — the form the fused segment
+    program batches out — the 2-D signals are left as underscore-
+    prefixed moment sums for :func:`finalize_row` to finish on the host
+    (the in-program expression constraint; see the module comment).
+    Signals, each present only when the state supports it:
+
+    * ``best_fitness`` / ``mean_fitness`` / ``worst_fitness`` — this
+      generation's fitness extrema and mean (minimizing frame), from
+      ``algorithm.fit`` or, for algorithms that keep no fitness leaf,
+      the monitor's ``latest_fitness``;
+    * ``pop_diversity`` — whole-tensor std of ``algorithm.pop`` (every
+      element against the global mean) — a collapse *trajectory*: it
+      vanishes exactly when the population contracts to a point.  Not
+      the per-dimension max the health probe gates on
+      (:func:`~evox_tpu.resilience.health.scan_state` keeps that, at
+      boundaries): per-dimension statistics need partial reductions,
+      which perturb the scan carry (see the module comment);
+    * ``step_size_min`` / ``step_size_max`` — extrema of the ES ``sigma``
+      leaf (a scalar CMA-ES step size reports min == max);
+    * ``velocity_norm`` — the sup (L∞) norm of a PSO-family ``velocity``
+      leaf: the swarm's largest velocity-component magnitude, the
+      freeze-(→0)-or-blow-up trajectory.  L∞ rather than L2 because
+      min/max reductions fuse into the velocity producer for free while
+      a sum-of-squares pass does not (module comment);
+    * ``num_nonfinite`` / ``num_shard_quarantines`` — the monitor's
+      cumulative quarantine counters (the storm detector's input).
+
+    Evaluated *inside* the fused segment scan on each stepped state: only
+    ``jnp`` reductions, never a host sync (graftlint GL002 scope).
+    """
+    import jax.numpy as jnp
+
+    from ..resilience.health import _subtree
+
+    out: dict[str, Any] = {}
+    algo = _subtree(state, "algorithm")
+    algo = algo if algo is not None else state
+    fit = _subtree(algo, "fit")
+    if fit is None:
+        mon = _subtree(state, "monitor")
+        fit = _subtree(mon, "latest_fitness") if mon is not None else None
+    if (
+        fit is not None
+        and getattr(fit, "ndim", 0) == 1
+        and getattr(fit, "size", 0) > 0
+        and jnp.issubdtype(fit.dtype, jnp.floating)
+    ):
+        out["best_fitness"] = jnp.min(fit)
+        out["mean_fitness"] = jnp.mean(fit)
+        out["worst_fitness"] = jnp.max(fit)
+    pop = _subtree(algo, "pop")
+    if (
+        pop is not None
+        and getattr(pop, "ndim", 0) == 2
+        and jnp.issubdtype(pop.dtype, jnp.floating)
+    ):
+        # Whole-tensor E[x²]−E[x]² from full-to-scalar sums — raw mode
+        # ships the bare sums (the only carry-exact, ≈free in-program
+        # shape; module comment) and finalize_row finishes them; the
+        # standalone mode computes the value in place.  The shortcut
+        # cancels catastrophically only at vanishing spreads, where a
+        # diagnostic series clamped to 0 is still the right story.
+        if raw:
+            out["_pop_sum"] = jnp.sum(pop)
+            out["_pop_sumsq"] = jnp.sum(pop * pop)
+            out["_pop_count"] = jnp.asarray(float(pop.size), pop.dtype)
+        else:
+            count = pop.size
+            mean = jnp.sum(pop) / count
+            var = jnp.maximum(
+                jnp.sum(pop * pop) / count - mean * mean, 0.0
+            )
+            out["pop_diversity"] = jnp.sqrt(var)
+    sigma = _subtree(algo, "sigma")
+    if (
+        sigma is not None
+        and hasattr(sigma, "dtype")
+        and jnp.issubdtype(sigma.dtype, jnp.floating)
+    ):
+        out["step_size_min"] = jnp.min(sigma)
+        out["step_size_max"] = jnp.max(sigma)
+    velocity = _subtree(algo, "velocity")
+    if (
+        velocity is not None
+        and getattr(velocity, "ndim", 0) == 2
+        and jnp.issubdtype(velocity.dtype, jnp.floating)
+    ):
+        # Sup-norm via bare min/max full reductions — the only velocity
+        # moments that fuse for free (an elementwise square before the
+        # reduction blocks fusion into the producer loop: +2.3M FLOPs
+        # per 25-gen segment at the gate config); raw mode ships the two
+        # extrema, the host takes the larger magnitude.
+        if raw:
+            out["_velocity_min"] = jnp.min(velocity)
+            out["_velocity_max"] = jnp.max(velocity)
+        else:
+            out["velocity_norm"] = jnp.maximum(
+                -jnp.min(velocity), jnp.max(velocity)
+            )
+    mon = _subtree(state, "monitor")
+    if mon is not None:
+        for key in ("num_nonfinite", "num_shard_quarantines"):
+            if key in mon:
+                out[key] = mon[key]
+    return out
+
+
+def finalize_row(row: dict[str, float]) -> dict[str, float]:
+    """Finish one host-side signal row: derive the semantic 2-D signals
+    (``pop_diversity``, ``velocity_norm``) from the raw moment sums the
+    compiled segment ships (``flight_signals(raw=True)``), dropping the
+    underscore-prefixed intermediates.  Pure float math — rows already
+    holding the semantic keys pass through unchanged."""
+    out = {k: v for k, v in row.items() if not k.startswith("_")}
+    count = row.get("_pop_count", 0.0)
+    if count and "_pop_sumsq" in row:
+        mean = row["_pop_sum"] / count
+        var = max(row["_pop_sumsq"] / count - mean * mean, 0.0)
+        out["pop_diversity"] = var**0.5
+    if "_velocity_min" in row and "_velocity_max" in row:
+        out["velocity_norm"] = max(
+            -row["_velocity_min"], row["_velocity_max"]
+        )
+    return out
+
+
+class FlightRecorder:
+    """Host-side ring buffer of per-generation flight rows + bundle dumper.
+
+    Usage (supervised — the intended path)::
+
+        recorder = FlightRecorder("postmortems", window=128)
+        obs = Observability(flight=recorder)
+        runner = ResilientRunner(wf, "ckpts/run", health=probe,
+                                 restart=RollbackToCheckpoint(), obs=obs)
+        runner.run(state, n_steps)   # a health rollback dumps a bundle
+        recorder.bundles             # -> [Path(...)/postmortem_00000_restart]
+
+    The recorder is fed once per fused segment (the runner's telemetry
+    flush calls :meth:`record_rows` with the batched signal arrays) and
+    subscribes to the event bus as a sink: trigger events — restart,
+    preemption, health/tenant warnings — dump the current window as a
+    postmortem bundle.  Rows never cross the host boundary more than once
+    and nothing here runs in compiled scope.
+
+    A bundle is a directory ``postmortem_<seq>_<kind>/`` under ``dir``::
+
+        manifest.json   # schema, kind, run/tenant identity, generation
+                        # span, signal names, the trigger event (when one
+                        # fired), written LAST — its presence marks the
+                        # bundle complete
+        flight.jsonl    # one JSON object per generation row, ascending
+
+    :param dir: directory bundles are dumped into (created on demand).
+    :param window: ring capacity in generations (the "last K generations"
+        a postmortem can explain).
+    :param quarantine_storm: dump with ``kind="quarantine-storm"`` when
+        the cumulative ``num_nonfinite`` counter grows by at least this
+        many individuals within the window; ``None`` (default) disables
+        the detector.
+    :param tenant_id: filter — only trigger events carrying this
+        ``tenant_id`` dump (service-wide preemptions always do).  ``None``
+        accepts every trigger; :meth:`for_tenant` builds filtered clones.
+    :param run_id: identity stamped into every manifest (an
+        :class:`~evox_tpu.obs.Observability` plane fills it in when the
+        recorder is attached without one).
+    """
+
+    def __init__(
+        self,
+        dir: Union[str, Path],
+        *,
+        window: int = 256,
+        quarantine_storm: int | None = None,
+        tenant_id: str | None = None,
+        run_id: str | None = None,
+    ):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if quarantine_storm is not None and quarantine_storm < 1:
+            raise ValueError(
+                f"quarantine_storm must be >= 1 (or None to disable), got "
+                f"{quarantine_storm}"
+            )
+        self.dir = Path(dir)
+        self.window = int(window)
+        self.quarantine_storm = (
+            None if quarantine_storm is None else int(quarantine_storm)
+        )
+        self.tenant_id = tenant_id
+        self.run_id = run_id
+        self._lock = threading.Lock()
+        self._rows: collections.deque[dict[str, float]] = collections.deque(
+            maxlen=self.window
+        )
+        # Continue the bundle numbering past anything already on disk: a
+        # readmitted tenant id (or a rerun over the same directory) must
+        # never clobber an earlier incarnation's crash evidence.
+        self._seq = self._next_seq()
+        # Per-kind dedup cursor over the INGEST counter (not generation
+        # numbers): a storm dump must not swallow the restart dump the
+        # SAME boundary fires a moment later, and the same kind
+        # re-triggering with no new rows adds nothing — but a rollback
+        # REPLAYS earlier generations, so "newest generation didn't
+        # advance" must not suppress the bundle of a second, divergent
+        # failure (the replayed rows are new content).
+        self._ingests = 0
+        self._dumped: dict[str, int] = {}
+        # Storm latch: a sustained burst keeps the window's quarantine
+        # growth above the threshold for many segments — dump when the
+        # storm STARTS, stay silent while it continues, re-arm once the
+        # window shows it ended.
+        self._storm_active = False
+        self.bundles: list[Path] = []
+
+    def _next_seq(self) -> int:
+        """First unused bundle sequence number in ``dir`` (0 for a fresh
+        directory): numbering always continues past existing bundles."""
+        try:
+            names = [
+                p.name
+                for p in self.dir.iterdir()
+                if p.name.startswith("postmortem_")
+            ]
+        except OSError:
+            return 0
+        highest = -1
+        for name in names:
+            parts = name.split("_")
+            if len(parts) >= 2 and parts[1].isdigit():
+                highest = max(highest, int(parts[1]))
+        return highest + 1
+
+    def for_tenant(self, tenant_id: str) -> "FlightRecorder":
+        """A per-tenant clone: same window/storm config, bundles under
+        ``dir/<tenant_id>/``, trigger events filtered to the tenant.  The
+        multi-tenant service builds one per admitted tenant so each lane's
+        series dumps into its own namespace."""
+        return FlightRecorder(
+            self.dir / str(tenant_id),
+            window=self.window,
+            quarantine_storm=self.quarantine_storm,
+            tenant_id=str(tenant_id),
+            run_id=self.run_id,
+        )
+
+    # -- feeding ------------------------------------------------------------
+    def record_rows(
+        self,
+        signals: Mapping[str, Any],
+        executed: int,
+        start_generation: int,
+        lane: int | None = None,
+    ) -> None:
+        """Append one segment's batched signal rows to the ring.
+
+        :param signals: ``{name: array}`` with a leading ``(n_steps,)``
+            axis — or ``(n_lanes, n_steps, ...)`` for a vmapped pack, in
+            which case ``lane`` selects the row to ingest (the per-tenant
+            demux, mirroring ``EvalMonitor.ingest_sinks(lane=...)``).
+        :param executed: generations that actually ran (rows past it are
+            early-stop padding and are dropped).
+        :param start_generation: generation count *before* the segment —
+            row ``g`` is generation ``start_generation + 1 + g``.
+        """
+        executed = int(executed)
+        with self._lock:
+            if executed > 0:
+                self._ingests += 1
+            for g in range(executed):
+                row: dict[str, float] = {}
+                for name, arr in signals.items():
+                    value = arr[lane][g] if lane is not None else arr[g]
+                    row[str(name)] = float(value)
+                # Raw moment sums -> semantic signals, on the host (the
+                # compiled program must not combine them; module comment).
+                row = finalize_row(row)
+                row["generation"] = int(start_generation) + 1 + g
+                self._rows.append(row)
+        self._check_storm()
+
+    def rows(self) -> list[dict[str, float]]:
+        """Copy of the current ring contents (oldest first)."""
+        with self._lock:
+            return [dict(r) for r in self._rows]
+
+    def latest_generation(self) -> int | None:
+        with self._lock:
+            return int(self._rows[-1]["generation"]) if self._rows else None
+
+    def _check_storm(self) -> None:
+        if self.quarantine_storm is None:
+            return
+        with self._lock:
+            counts = [
+                r["num_nonfinite"] for r in self._rows if "num_nonfinite" in r
+            ]
+        if not counts:
+            return
+        # num_nonfinite is cumulative: growth across the window is the
+        # storm size.  Latch while it stays above the threshold so one
+        # sustained burst produces one bundle (the one that shows the
+        # onset), re-arming once the window shows the storm over.
+        grown = counts[-1] - counts[0]
+        if grown >= self.quarantine_storm:
+            if not self._storm_active:
+                self._storm_active = True
+                self.dump(
+                    "quarantine-storm",
+                    detail={
+                        "quarantined_in_window": grown,
+                        "threshold": self.quarantine_storm,
+                    },
+                )
+        else:
+            self._storm_active = False
+
+    # -- the bus-sink trigger ------------------------------------------------
+    def emit(self, event: Any) -> None:
+        """EventBus sink protocol: dump on trigger events.
+
+        * ``restart`` / ``preemption`` — always (a preemption is every
+          tenant's trigger, so the tenant filter does not apply to it);
+        * ``health`` / ``tenant`` — warning severity or worse only, and
+          (for a tenant-filtered recorder) only the matching tenant.
+
+        Runs under the bus's publish lock like every sink; the write is
+        bounded by the ring (``window`` rows of a few floats — tens of
+        KB), and a failed write degrades to ``None`` instead of raising
+        (the bus detaches sinks that raise).
+        """
+        category = getattr(event, "category", None)
+        if category not in TRIGGER_CATEGORIES:
+            return
+        severity = getattr(event, "severity", "info")
+        if category in ("health", "tenant") and severity not in (
+            "warning",
+            "error",
+        ):
+            return
+        if (
+            self.tenant_id is not None
+            and category != "preemption"
+            and getattr(event, "tenant_id", None) != self.tenant_id
+        ):
+            return
+        self.dump(category, event=event)
+
+    # -- dumping ------------------------------------------------------------
+    def dump(
+        self,
+        kind: str,
+        *,
+        event: Any = None,
+        detail: Mapping[str, Any] | None = None,
+        force: bool = False,
+    ) -> Path | None:
+        """Write the current window as one postmortem bundle; returns its
+        directory, or ``None`` when there is nothing new to dump (empty
+        ring, or no rows recorded since the same ``kind`` last dumped —
+        replayed post-rollback rows count as new content;
+        ``force=True`` overrides the dedup) — or when the write itself
+        failed (``OSError``): a full disk must never raise out of a bus
+        sink (the bus would detach the recorder for good), and the dedup
+        cursor only commits on success, so the NEXT trigger retries."""
+        with self._lock:
+            rows = [dict(r) for r in self._rows]
+            if not rows:
+                return None
+            newest = int(rows[-1]["generation"])
+            if not force and self._dumped.get(kind) == self._ingests:
+                return None
+            # Reserve the sequence number up front (concurrent dumps must
+            # never share a bundle name); a failed write leaves a gap in
+            # the numbering, which is harmless.
+            seq = self._seq
+            self._seq += 1
+        safe_kind = "".join(
+            c if c.isalnum() or c in "._-" else "-" for c in str(kind)
+        )
+        bundle = self.dir / f"postmortem_{seq:05d}_{safe_kind}"
+        signal_names = sorted(
+            {name for row in rows for name in row if name != "generation"}
+        )
+        manifest: dict[str, Any] = {
+            "schema": OBS_SCHEMA_VERSION,
+            "kind": str(kind),
+            "created_wall": time.time(),
+            "run_id": self.run_id,
+            "tenant_id": self.tenant_id,
+            "window": self.window,
+            "rows": len(rows),
+            "first_generation": int(rows[0]["generation"]),
+            "last_generation": newest,
+            "signals": signal_names,
+            "flight_file": "flight.jsonl",
+            "trigger": (
+                event.to_json() if hasattr(event, "to_json") else None
+            ),
+        }
+        if detail:
+            manifest["detail"] = dict(detail)
+        try:
+            bundle.mkdir(parents=True, exist_ok=True)
+            with open(bundle / "flight.jsonl", "w") as f:
+                for row in rows:
+                    f.write(json.dumps(row) + "\n")
+            # Manifest last: its presence marks the bundle complete, so a
+            # reader never consumes a half-written dump.
+            with open(bundle / "manifest.json", "w") as f:
+                json.dump(manifest, f, indent=1, default=repr)
+                f.write("\n")
+        except OSError:
+            return None
+        # Commit the dedup cursor only after a durable bundle exists —
+        # a failed write must stay retryable.
+        with self._lock:
+            self._dumped[kind] = self._ingests
+            self.bundles.append(bundle)
+        return bundle

@@ -18,6 +18,7 @@ import contextlib
 from typing import Any, Union
 
 from .events import CallbackSink, EventBus, JsonlFileSink, RingBufferSink
+from .flight import FlightRecorder
 from .metrics import MetricsRegistry, default_registry
 from .trace import Tracer
 
@@ -44,6 +45,13 @@ class Observability:
     :param events_path: convenience — when set, a
         :class:`~evox_tpu.obs.JsonlFileSink` at this path is attached to
         the bus (private or passed).
+    :param flight: optional :class:`~evox_tpu.obs.FlightRecorder` — the
+        device-side flight recorder.  Attaching it here (1) turns on the
+        per-generation flight telemetry in every instrumented runner's
+        fused segments, (2) subscribes the recorder to the bus so health
+        restarts / early stops / preemptions / tenant warnings dump
+        postmortem bundles, and (3) stamps the plane's ``run_id`` into
+        its manifests.
     """
 
     def __init__(
@@ -55,6 +63,7 @@ class Observability:
         run_id: str | None = None,
         ring: int = 512,
         events_path: Any | None = None,
+        flight: FlightRecorder | None = None,
     ):
         self.ring: RingBufferSink | None = None
         if bus is None:
@@ -68,6 +77,11 @@ class Observability:
         self.jsonl: JsonlFileSink | None = None
         if events_path is not None:
             self.jsonl = bus.add_sink(JsonlFileSink(events_path))
+        self.flight: FlightRecorder | None = flight
+        if flight is not None:
+            if flight.run_id is None:
+                flight.run_id = self.run_id
+            bus.add_sink(flight)
 
     # -- events --------------------------------------------------------------
     def event(
@@ -117,6 +131,13 @@ class Observability:
     def record_span(self, name: str, start: float, end: float, **args: Any) -> None:
         if self.tracer is not None:
             self.tracer.record(name, start, end, **args)
+
+    def record_counter(self, name: str, **values: Any) -> None:
+        """One counter-track sample (``ph:"C"``) when the plane carries a
+        tracer; a no-op otherwise — boundary call sites pass optional
+        device stats verbatim."""
+        if self.tracer is not None:
+            self.tracer.counter(name, **values)
 
     def maybe_profile(self, segment_index: int):
         if self.tracer is None:

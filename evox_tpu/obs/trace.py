@@ -35,7 +35,7 @@ from typing import Any, Iterator, Union
 
 from .version import OBS_SCHEMA_VERSION
 
-__all__ = ["Span", "Tracer"]
+__all__ = ["CounterSample", "Span", "Tracer"]
 
 
 @dataclass(frozen=True)
@@ -47,6 +47,19 @@ class Span:
     dur_us: float
     tid: int
     args: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class CounterSample:
+    """One point on a Chrome-trace counter track (``ph:"C"``): Perfetto
+    renders each ``values`` series as a stacked area under the span
+    timeline — the live memory / throughput tracks the runner feeds at
+    segment boundaries."""
+
+    name: str
+    ts_us: float
+    tid: int
+    values: dict[str, float] = field(default_factory=dict)
 
 
 class Tracer:
@@ -73,6 +86,7 @@ class Tracer:
         self.profile_dir = Path(profile_dir) if profile_dir else Path("profile_trace")
         self._lock = threading.Lock()
         self._spans: list[Span] = []
+        self._counters: list[CounterSample] = []
         # Wall anchor: perf_counter gives monotonic high-resolution spans;
         # the anchor lets a reader line the trace up with event t_wall.
         self._t0 = time.perf_counter()
@@ -111,6 +125,33 @@ class Tracer:
         with self._lock:
             return list(self._spans)
 
+    def counter(self, name: str, **values: float) -> None:
+        """Record one counter-track sample (``ph:"C"``) at "now": device
+        memory in use, generations/sec — numeric series Perfetto draws as
+        live tracks under the segment timeline.  Non-numeric/None values
+        are dropped so call sites can pass optional stats verbatim."""
+        clean = {}
+        for key, value in values.items():
+            try:
+                if value is not None:
+                    clean[key] = float(value)
+            except (TypeError, ValueError):
+                continue
+        if not clean:
+            return
+        sample = CounterSample(
+            name=name,
+            ts_us=(time.perf_counter() - self._t0) * 1e6,
+            tid=threading.get_ident(),
+            values=clean,
+        )
+        with self._lock:
+            self._counters.append(sample)
+
+    def counters(self) -> list[CounterSample]:
+        with self._lock:
+            return list(self._counters)
+
     # -- the profiler window -------------------------------------------------
     def maybe_profile(self, segment_index: int):
         """A ``jax.profiler.trace`` context when ``segment_index`` is the
@@ -143,6 +184,17 @@ class Tracer:
                 "args": span.args,
             }
             for span in self.spans()
+        ]
+        events += [
+            {
+                "name": sample.name,
+                "ph": "C",
+                "ts": sample.ts_us,
+                "pid": pid,
+                "tid": sample.tid,
+                "args": sample.values,
+            }
+            for sample in self.counters()
         ]
         return {
             "traceEvents": events,

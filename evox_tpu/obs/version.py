@@ -13,6 +13,9 @@ path.
 
 from __future__ import annotations
 
-OBS_SCHEMA_VERSION = 1
+# 2: flight-recorder postmortem bundles (manifest.json + flight.jsonl),
+#    evox_segment_* / evox_device_* / evox_roofline_* gauges, Chrome-trace
+#    counter tracks (ph:"C"), memory_analysis.json beside cost_analysis.json.
+OBS_SCHEMA_VERSION = 2
 
 __all__ = ["OBS_SCHEMA_VERSION"]

@@ -1,0 +1,356 @@
+"""Compiled-program introspection: XLA cost/memory analysis, rooflines.
+
+The framework AOT-compiles thousands of fused segment programs
+(:meth:`ResilientRunner._get_executable`) and ``bench.py --profile`` dumps
+one-off cost profiles — but until this module the two paths had separate
+writers and the roofline math lived in a CLI script.  One definition of
+each, shared by all three consumers:
+
+* **capture** — :func:`program_costs` / :func:`program_memory` /
+  :func:`program_analysis` read ``compiled.cost_analysis()`` and
+  ``compiled.memory_analysis()`` off a jax AOT-compiled executable,
+  degrading to ``None`` where a backend exposes no cost model (CPU
+  plugins vary by version);
+* **publication** — :func:`publish_program_gauges` lands
+  ``evox_segment_flops/bytes_accessed/peak_hbm_bytes{fn=...}`` gauges in
+  a :class:`~evox_tpu.obs.MetricsRegistry`;
+  :func:`publish_device_memory_gauges` snapshots live
+  ``device.memory_stats()`` (graceful ``None`` on CPU) into
+  ``evox_device_*`` gauges;
+* **roofline** — :func:`roofline` / :func:`roofline_from_cost` are the
+  achieved-vs-peak math ``tools/roofline.py`` prints (that script is now
+  a thin shim over this module) and the runner derives in-process at
+  segment boundaries (``evox_roofline_*`` gauges);
+* **artifacts** — :func:`write_cost_analysis` is the one writer behind
+  ``bench_artifacts/profile_*/cost_analysis.json`` (format unchanged:
+  XLA's raw cost dict, key-sorted, with extra keys like ``n_steps``
+  first) plus a new schema-stamped ``memory_analysis.json`` beside it.
+
+Kept stdlib-only at import time (jax is only imported lazily, and only
+for live-device queries): ``tools/roofline.py`` and ``bench.py``'s
+backend-free parent load the ``obs`` package by file path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Mapping
+
+from .version import OBS_SCHEMA_VERSION
+
+__all__ = [
+    "DEFAULT_HBM_PEAK_GBPS",
+    "DEFAULT_FLOP_PEAK_TFLOPS",
+    "program_costs",
+    "program_memory",
+    "program_analysis",
+    "write_cost_analysis",
+    "device_memory_stats",
+    "publish_program_gauges",
+    "publish_device_memory_gauges",
+    "publish_roofline_gauges",
+    "roofline",
+    "roofline_from_cost",
+]
+
+# Chip peaks the roofline math defaults to — the v5 lite attachment this
+# repo's TPU sweeps tunnel to (819 GB/s HBM; ~197 bf16 TFLOP/s, halve for
+# f32).  Override per deployment via the environment or per call.
+DEFAULT_HBM_PEAK_GBPS = float(os.environ.get("EVOX_TPU_HBM_PEAK_GBPS", 819.0))
+DEFAULT_FLOP_PEAK_TFLOPS = float(
+    os.environ.get("EVOX_TPU_FLOP_PEAK_TFLOPS", 197.0)
+)
+
+# memory_analysis() attribute names (jax CompiledMemoryStats) worth
+# keeping; peak HBM is derived below.
+_MEMORY_FIELDS = (
+    "generated_code_size_in_bytes",
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "alias_size_in_bytes",
+    "temp_size_in_bytes",
+)
+
+
+def program_costs(compiled: Any) -> dict[str, float] | None:
+    """XLA's own cost model for one AOT-compiled executable —
+    ``compiled.cost_analysis()`` as a plain dict (``flops``,
+    ``bytes accessed``, per-op breakdown keys), or ``None`` where the
+    backend exposes none.  Never raises: cost-model coverage varies by
+    backend and jax version, and introspection must not fail a run."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):  # older jax: one dict per device
+        cost = cost[0] if cost else None
+    if not cost:
+        return None
+    return dict(cost)
+
+
+def program_memory(compiled: Any) -> dict[str, float] | None:
+    """``compiled.memory_analysis()`` flattened to a dict, with
+    ``peak_hbm_bytes`` derived as arguments + outputs + temporaries +
+    generated code − aliased bytes (the executable's device-memory
+    high-water mark, the quantity an HBM-capacity planner needs).
+    ``None`` where the backend exposes no memory analysis."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    out: dict[str, float] = {}
+    for name in _MEMORY_FIELDS:
+        value = getattr(mem, name, None)
+        if value is not None:
+            try:
+                out[name] = float(value)
+            except (TypeError, ValueError):
+                continue
+    if not out:
+        return None
+    out["peak_hbm_bytes"] = (
+        out.get("argument_size_in_bytes", 0.0)
+        + out.get("output_size_in_bytes", 0.0)
+        + out.get("temp_size_in_bytes", 0.0)
+        + out.get("generated_code_size_in_bytes", 0.0)
+        - out.get("alias_size_in_bytes", 0.0)
+    )
+    return out
+
+
+def program_analysis(compiled: Any) -> dict[str, float]:
+    """The compact whole-program summary the runner publishes per
+    compiled segment: ``flops``, ``bytes_accessed``, ``transcendentals``
+    (when the cost model reports them) and ``peak_hbm_bytes`` (when the
+    memory analysis does).  ``{}`` when the backend exposes neither —
+    callers skip gracefully."""
+    out: dict[str, float] = {}
+    cost = program_costs(compiled)
+    if cost:
+        for raw, name in (
+            ("flops", "flops"),
+            ("bytes accessed", "bytes_accessed"),
+            ("transcendentals", "transcendentals"),
+        ):
+            value = cost.get(raw)
+            if value is not None:
+                out[name] = float(value)
+    mem = program_memory(compiled)
+    if mem:
+        out["peak_hbm_bytes"] = float(mem["peak_hbm_bytes"])
+    return out
+
+
+def write_cost_analysis(
+    compiled: Any,
+    profile_dir: str,
+    extra: Mapping[str, Any] | None = None,
+) -> dict[str, float] | None:
+    """The one ``cost_analysis.json`` writer behind ``bench.py --profile``
+    (previously two divergent inline copies).  Artifact format unchanged:
+    XLA's raw cost dict, key-sorted, with ``extra`` keys (``n_steps`` for
+    fused whole-run profiles) leading.  Additionally writes a
+    schema-stamped ``memory_analysis.json`` when the backend exposes
+    memory analysis.  Returns the raw cost dict (``None`` when the
+    backend has no cost model — nothing is written for that half).
+    Artifact I/O failures (full / read-only ``bench_artifacts``) are
+    swallowed like the pre-unification bench writer's were: a profile
+    dump must never kill the timing run it decorates."""
+    cost = program_costs(compiled)
+    mem = program_memory(compiled)
+    try:
+        os.makedirs(profile_dir, exist_ok=True)
+        if cost is not None:
+            payload = {
+                **(dict(extra) if extra else {}),
+                **dict(sorted(cost.items())),
+            }
+            with open(
+                os.path.join(profile_dir, "cost_analysis.json"), "w"
+            ) as f:
+                json.dump(payload, f, indent=1)
+        if mem is not None:
+            with open(
+                os.path.join(profile_dir, "memory_analysis.json"), "w"
+            ) as f:
+                json.dump({"schema": OBS_SCHEMA_VERSION, **mem}, f, indent=1)
+    except OSError:
+        pass
+    return cost
+
+
+def device_memory_stats(device: Any = None) -> dict[str, float] | None:
+    """Live ``device.memory_stats()`` (first local device by default) as a
+    numeric dict — ``bytes_in_use``, ``peak_bytes_in_use``,
+    ``bytes_limit`` on TPU/GPU backends.  ``None`` on backends without
+    allocator stats (CPU) or when no backend is initialized; never
+    raises, never *initializes* a backend that something else has not
+    already paid for."""
+    try:
+        import jax
+
+        if device is None:
+            if not jax._src.xla_bridge._backends:  # noqa: SLF001 - probe
+                return None
+            device = jax.local_devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {
+        k: float(v) for k, v in stats.items() if isinstance(v, (int, float))
+    }
+    return out or None
+
+
+def publish_program_gauges(
+    registry: Any, fn: str, analysis: Mapping[str, float]
+) -> None:
+    """Land one compiled program's cost/memory summary as
+    ``evox_segment_*{fn=...}`` gauges (no-op for an empty analysis — CPU
+    backends without a cost model skip gracefully)."""
+    if not analysis:
+        return
+    gauges = (
+        ("flops", "evox_segment_flops", "XLA-modeled FLOPs per compiled segment program."),
+        (
+            "bytes_accessed",
+            "evox_segment_bytes_accessed",
+            "XLA-modeled HBM bytes accessed per compiled segment program.",
+        ),
+        (
+            "transcendentals",
+            "evox_segment_transcendentals",
+            "XLA-modeled transcendental ops per compiled segment program.",
+        ),
+        (
+            "peak_hbm_bytes",
+            "evox_segment_peak_hbm_bytes",
+            "Derived peak device-memory bytes of a compiled segment program.",
+        ),
+    )
+    for key, name, help in gauges:
+        if key in analysis:
+            registry.gauge(name, help, fn=fn).set(float(analysis[key]))
+
+
+def publish_device_memory_gauges(
+    registry: Any, device: Any = None
+) -> dict[str, float] | None:
+    """Snapshot live device allocator stats into ``evox_device_*`` gauges;
+    returns the stats dict (``None`` on stat-less backends — nothing is
+    published)."""
+    stats = device_memory_stats(device)
+    if not stats:
+        return None
+    for key, name, help in (
+        ("bytes_in_use", "evox_device_bytes_in_use", "Live device HBM bytes in use."),
+        (
+            "peak_bytes_in_use",
+            "evox_device_peak_bytes_in_use",
+            "Peak device HBM bytes in use since process start.",
+        ),
+        ("bytes_limit", "evox_device_bytes_limit", "Device HBM capacity bytes."),
+    ):
+        if key in stats:
+            registry.gauge(name, help).set(stats[key])
+    return stats
+
+
+def roofline(
+    *,
+    flops_per_gen: float,
+    bytes_per_gen: float,
+    gen_per_sec: float,
+    hbm_gbps: float | None = None,
+    peak_tflops: float | None = None,
+) -> dict[str, Any]:
+    """Achieved-vs-peak roofline for one program shape at a measured
+    throughput — THE definition ``tools/roofline.py`` prints and the
+    runner publishes as ``evox_roofline_*`` gauges (key set matches the
+    historical CLI output, so ``profile_*/roofline.json`` artifacts keep
+    their schema)."""
+    hbm_gbps = DEFAULT_HBM_PEAK_GBPS if hbm_gbps is None else float(hbm_gbps)
+    peak_tflops = (
+        DEFAULT_FLOP_PEAK_TFLOPS if peak_tflops is None else float(peak_tflops)
+    )
+    gbps = bytes_per_gen * gen_per_sec / 1e9
+    tflops = flops_per_gen * gen_per_sec / 1e12
+    return {
+        "bytes_per_gen": bytes_per_gen,
+        "flops_per_gen": flops_per_gen,
+        "achieved_GBps": round(gbps, 1),
+        "pct_of_hbm_peak": round(100 * gbps / hbm_gbps, 1),
+        "achieved_TFLOPs": round(tflops, 2),
+        "pct_of_flop_peak": round(100 * tflops / peak_tflops, 1),
+        "arithmetic_intensity_flops_per_byte": round(
+            flops_per_gen / bytes_per_gen, 3
+        )
+        if bytes_per_gen
+        else None,
+        "bound": (
+            "memory"
+            if bytes_per_gen
+            and (gbps / hbm_gbps) > (tflops / peak_tflops)
+            else "compute"
+        ),
+    }
+
+
+def roofline_from_cost(
+    cost: Mapping[str, Any],
+    gen_per_sec: float,
+    *,
+    hbm_gbps: float | None = None,
+    peak_tflops: float | None = None,
+) -> dict[str, Any]:
+    """:func:`roofline` over a raw ``cost_analysis.json`` dict.  Fused
+    whole-run profiles carry whole-program costs plus the generation
+    count (``n_steps``, written by ``bench._timed_fused``) — normalized
+    to per-generation here so fused and per-step profiles read alike."""
+    n_steps = cost.get("n_steps") or 1
+    return roofline(
+        flops_per_gen=float(cost.get("flops", 0.0)) / n_steps,
+        bytes_per_gen=float(cost.get("bytes accessed", 0.0)) / n_steps,
+        gen_per_sec=gen_per_sec,
+        hbm_gbps=hbm_gbps,
+        peak_tflops=peak_tflops,
+    )
+
+
+def publish_roofline_gauges(
+    registry: Any, fn: str, result: Mapping[str, Any]
+) -> None:
+    """Land an in-process roofline verdict as ``evox_roofline_*{fn=...}``
+    gauges (achieved GB/s and TFLOP/s plus percent-of-peak — the live
+    counterpart of a ``profile_*/roofline.json`` artifact)."""
+    for key, name, help in (
+        (
+            "achieved_GBps",
+            "evox_roofline_achieved_gbps",
+            "Achieved HBM GB/s of the live segment program.",
+        ),
+        (
+            "pct_of_hbm_peak",
+            "evox_roofline_pct_of_hbm_peak",
+            "Achieved HBM bandwidth as a percent of the chip peak.",
+        ),
+        (
+            "achieved_TFLOPs",
+            "evox_roofline_achieved_tflops",
+            "Achieved TFLOP/s of the live segment program.",
+        ),
+        (
+            "pct_of_flop_peak",
+            "evox_roofline_pct_of_flop_peak",
+            "Achieved FLOP throughput as a percent of the chip peak.",
+        ),
+    ):
+        value = result.get(key)
+        if value is not None:
+            registry.gauge(name, help, fn=fn).set(float(value))

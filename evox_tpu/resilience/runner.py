@@ -635,13 +635,23 @@ class ResilientRunner:
             plane carries a :class:`~evox_tpu.obs.Tracer`) host-side
             spans per boundary phase plus an opt-in
             ``jax.profiler.trace`` window around the Nth segment.
+            A plane carrying a :class:`~evox_tpu.obs.FlightRecorder`
+            additionally switches on the per-generation flight
+            telemetry in the fused segments (ring-fed at every
+            telemetry flush; postmortem bundles dump on restart /
+            early-stop / preemption / quarantine-storm events), and
+            every AOT compile publishes its XLA cost/memory verdict
+            (``evox_segment_*`` gauges) with live device-memory,
+            throughput, and roofline gauges at segment boundaries.
             ``None`` (default) builds a plane on the process-local
             default registry with an in-memory event ring; ``False``
             disables instrumentation entirely.  All instrumentation is
-            strictly host-side at segment boundaries — the compiled
-            programs are identical with and without it
-            (``tests/test_obs.py`` pins bit-identity,
-            ``tools/bench_obs_overhead.py`` gates the wall-clock cost).
+            strictly host-side at segment boundaries — the flight
+            signals are pure scan outputs — and the evolving state is
+            identical with and without it (``tests/test_obs.py`` and
+            ``tests/test_flight.py`` pin bit-identity,
+            ``tools/bench_obs_overhead.py`` gates the wall-clock cost
+            with the flight recorder on).
         """
         if checkpoint_every < 1:
             raise ValueError(
@@ -761,6 +771,10 @@ class ResilientRunner:
         # signature): compiled OUTSIDE the watchdog so cold-compile latency
         # never counts against the execution deadline.
         self._exec_cache: dict = {}
+        # XLA's cost/memory verdict per compiled program shape, keyed by
+        # (which, chunk): captured at AOT-compile time (obs/xla.py),
+        # consumed at segment boundaries for the in-process roofline.
+        self._program_analysis: dict = {}
 
     # -- program shapes ----------------------------------------------------
     def _fused_cfg(self):
@@ -783,6 +797,13 @@ class ResilientRunner:
                 health=self.health,
                 metrics=False,
                 stop_on_unhealthy=self.fused_early_stop,
+                # A FlightRecorder on the obs plane switches on the
+                # per-generation flight telemetry: extra scan outputs,
+                # zero host callbacks, carry untouched (bit-identity is
+                # pinned in tests/test_flight.py).
+                flight=(
+                    self.obs is not None and self.obs.flight is not None
+                ),
             )
         return self._segment_cfg
 
@@ -942,6 +963,63 @@ class ResilientRunner:
                         "EvalMonitor in-state counter (boundary snapshot).",
                         **labels,
                     ).set(float(jax.device_get(mon[key])))
+
+    def _publish_introspection(
+        self, which: str, chunk: int | None, stepped: int
+    ) -> None:
+        """Segment-boundary device/program introspection (strictly
+        host-side): live ``device.memory_stats()`` as ``evox_device_*``
+        gauges (graceful no-op on stat-less CPU backends) plus Chrome-
+        trace counter tracks (``ph:"C"`` — Perfetto draws live memory and
+        generations/sec under the span timeline), and — when the AOT
+        compile captured an XLA cost model for this program shape — the
+        achieved-vs-peak roofline gauges, in-process (the live
+        counterpart of ``tools/roofline.py``)."""
+        if self.obs is None:
+            return
+        from ..obs import xla as obs_xla
+
+        # Explicit device: the boundary runs right after a segment, so a
+        # backend is guaranteed live — no need for obs.xla's no-init
+        # probe of jax internals (which a jax upgrade could silence).
+        stats = obs_xla.publish_device_memory_gauges(
+            self.obs.registry, jax.local_devices()[0]
+        )
+        if stats:
+            self.obs.record_counter(
+                "device-memory",
+                bytes_in_use=stats.get("bytes_in_use"),
+                peak_bytes_in_use=stats.get("peak_bytes_in_use"),
+            )
+        seconds = self._last_exec_seconds
+        gps = stepped / seconds if seconds > 0 and stepped else 0.0
+        if gps:
+            self.obs.record_counter("throughput", gens_per_sec=gps)
+            labels = (
+                {"run_id": self.obs.run_id}
+                if self.obs.run_id is not None
+                else {}
+            )
+            self.obs.gauge(
+                "evox_runner_gens_per_sec",
+                "Blocked-execution generations/sec of the latest segment.",
+                **labels,
+            ).set(gps)
+        analysis = self._program_analysis.get((which, chunk))
+        if analysis and gps and stepped:
+            # Whole-program cost over the generations the scan covers —
+            # per-generation normalization mirrors roofline_from_cost's
+            # n_steps handling for fused whole-run profiles.
+            per_gen = max(int(chunk) if chunk else 1, 1)
+            result = obs_xla.roofline(
+                flops_per_gen=analysis.get("flops", 0.0) / per_gen,
+                bytes_per_gen=analysis.get("bytes_accessed", 0.0) / per_gen,
+                gen_per_sec=gps,
+            )
+            label = which if chunk is None else f"{which}[{chunk}]"
+            obs_xla.publish_roofline_gauges(
+                self.obs.registry, label, result
+            )
 
     # -- checkpointing -----------------------------------------------------
     def _ckpt_path(self, generation: int) -> Path:
@@ -1451,8 +1529,22 @@ class ResilientRunner:
         t1 = time.perf_counter()
         self._last_compile_seconds += t1 - t0
         if self.obs is not None:
+            # Program introspection at the only moment it is free: the
+            # compiled executable is in hand exactly once per program
+            # shape.  cost_analysis()/memory_analysis() degrade to an
+            # empty analysis on backends without a cost model — gauges
+            # are skipped, the roofline below never fires, nothing
+            # raises.
+            from ..obs import xla as obs_xla
+
+            analysis = obs_xla.program_analysis(exe)
+            label = which if chunk is None else f"{which}[{chunk}]"
+            self._program_analysis[(which, chunk)] = analysis
+            obs_xla.publish_program_gauges(
+                self.obs.registry, label, analysis
+            )
             self.obs.record_span(
-                "aot-compile", t0, t1, which=which, chunk=chunk
+                "aot-compile", t0, t1, which=which, chunk=chunk, **analysis
             )
             self.obs.counter(
                 "evox_runner_compiles_total",
@@ -1935,6 +2027,7 @@ class ResilientRunner:
             self._write_checkpoint(state, done)
             self._record_segment_timing(done, blocked0)
             self._publish_metrics(state)
+            self._publish_introspection("init", None, 1)
             self._beat(done)
             probed = False
         while True:
@@ -2001,6 +2094,7 @@ class ResilientRunner:
             self._write_checkpoint(state, done)
             self._record_segment_timing(done, blocked0)
             self._publish_metrics(state)
+            self._publish_introspection("segment", chunk, stepped)
             self._beat(done)
             probed = False
         return state
@@ -2038,6 +2132,18 @@ class ResilientRunner:
             host = jax.device_get(self._gather_state(telemetry))
             self.workflow.flush_telemetry(host)
         executed = int(host["executed"])
+        if (
+            self.obs is not None
+            and self.obs.flight is not None
+            and "flight" in host
+        ):
+            # Feed the black box BEFORE any boundary verdict fires: the
+            # restart/early-stop/preemption events published below and by
+            # _health_boundary trigger the recorder's bundle dump, which
+            # must see this segment's rows.
+            self.obs.flight.record_rows(
+                host["flight"], executed, start_generation=done
+            )
         if bool(host["stopped"]) and executed < chunk:
             self.stats.early_stops += 1
             self._event(
@@ -2046,5 +2152,9 @@ class ResilientRunner:
                 f"remaining {chunk - executed} generation(s) of the "
                 f"segment were frozen no-ops",
                 warn=True,
+                category="health",
+                generation=done + executed,
+                kind="early_stop",
+                frozen_generations=chunk - executed,
             )
         return state, executed

@@ -94,6 +94,10 @@ class TenantPack:
     :param early_stop: carry the per-lane unhealthy-state early stop
         in-scan (default True — a poisoned tenant freezes the moment it
         degenerates instead of compounding to the boundary).
+    :param flight: batch the flight recorder's per-generation signals
+        (:func:`evox_tpu.obs.flight_signals`) out of the vmapped segment
+        as ``telemetry["flight"]`` with a leading lane axis — the service
+        demuxes one row per tenant, exactly like the history sinks.
     """
 
     def __init__(
@@ -103,6 +107,7 @@ class TenantPack:
         *,
         health: Any | None = None,
         early_stop: bool = True,
+        flight: bool = False,
     ):
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
@@ -124,6 +129,7 @@ class TenantPack:
             stop_on_unhealthy=bool(early_stop),
             barrier=False,
             lane_freeze=True,
+            flight=bool(flight),
         )
         self._states: State | None = None
         self._frozen = np.ones((self.lanes,), dtype=bool)

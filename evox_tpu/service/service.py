@@ -358,6 +358,14 @@ class OptimizationService:
             record = TenantRecord(
                 spec=spec, uid=uid, monitor=self.monitor_factory()
             )
+            if self.obs is not None and self.obs.flight is not None:
+                # One flight recorder per tenant, bundles under the
+                # plane recorder's dir in the tenant's own namespace;
+                # subscribed to the bus so this tenant's warning events
+                # (restart, quarantine, early stop, preemption) dump its
+                # own last-K-generation window.
+                record.flight = self.obs.flight.for_tenant(spec.tenant_id)
+                self.obs.bus.add_sink(record.flight)
             self._tenants[spec.tenant_id] = record
             self._tenants_by_uid[uid] = record
             self._note(record, f"queued (uid {uid})")
@@ -420,6 +428,11 @@ class OptimizationService:
         self._templates.pop((record.bucket, record.uid), None)
         self._tenants_by_uid.pop(record.uid, None)
         del self._tenants[tenant_id]
+        if record.flight is not None and self.obs is not None:
+            # Detach the tenant's postmortem trigger with its record —
+            # a forgotten tenant's recorder must not keep dumping on a
+            # reused tenant id's events.
+            self.obs.bus.remove_sink(record.flight)
         if self.obs is not None:
             # Retire the tenant's metric series with its record: tenant
             # churn must not grow the registry (and every snapshot /
@@ -588,6 +601,9 @@ class OptimizationService:
                 self.lanes_per_pack,
                 health=self.health,
                 early_stop=self.early_stop,
+                flight=(
+                    self.obs is not None and self.obs.flight is not None
+                ),
             )
             bucket = _Bucket(
                 key=bkey, workflow=workflow, pack=pack, monitor=monitor
@@ -843,6 +859,24 @@ class OptimizationService:
             if sinks and record.monitor is not None:
                 record.monitor.ingest_sinks(
                     meta_pairs, sinks, np.asarray(telemetry["executed"]),
+                    lane=lane,
+                )
+            if (
+                record.flight is not None
+                and "flight" in telemetry
+                and executed[lane]
+            ):
+                # Lane-demuxed flight feed, BEFORE the verdicts below:
+                # a restart/quarantine note must dump a window that
+                # includes this segment's rows.  record.generations was
+                # already advanced, so the segment started executed[lane]
+                # generations earlier.
+                record.flight.record_rows(
+                    telemetry["flight"],
+                    int(executed[lane]),
+                    start_generation=(
+                        record.generations - int(executed[lane])
+                    ),
                     lane=lane,
                 )
             if bool(stopped[lane]) and int(executed[lane]) < self.segment_steps:

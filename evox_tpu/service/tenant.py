@@ -125,6 +125,10 @@ class TenantRecord:
     events: list[str] = field(default_factory=list)
     monitor: Any | None = None
     result: Any | None = None
+    # Per-tenant flight recorder (``FlightRecorder.for_tenant``): fed from
+    # the pack's lane-demuxed flight telemetry, dumps postmortem bundles
+    # into the tenant's own namespace on tenant-warning bus events.
+    flight: Any | None = None
 
 
 def _hash_value(h: "hashlib._Hash", value: Any) -> None:

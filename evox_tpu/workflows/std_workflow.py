@@ -81,6 +81,13 @@ class SegmentConfig(NamedTuple):
     # stop flag *initialized* from the input instead of False.  Eviction
     # therefore never re-compiles: the mask is data, not program.
     lane_freeze: bool = False
+    # Flight recorder: evaluate ``obs.flight_signals`` on every
+    # generation's stepped state and batch the scalars out as additional
+    # telemetry (``telemetry["flight"]``) — scan *outputs* only, so the
+    # evolving carry stays bit-identical to the flight-off program (the
+    # same contract as the ``best_fitness`` channel; pinned per algorithm
+    # in tests/test_flight.py).
+    flight: bool = False
 
 
 class StdWorkflow(Workflow):
@@ -516,6 +523,7 @@ class StdWorkflow(Workflow):
         health: Any | None = None,
         barrier: bool = True,
         lane_freeze: bool = False,
+        flight: bool = False,
     ) -> SegmentConfig:
         """Build the :class:`SegmentConfig` for :meth:`run_segment`.
 
@@ -552,6 +560,11 @@ class StdWorkflow(Workflow):
             normalized to ``False`` whenever ``lane_freeze`` is set (a
             config claiming barrier semantics the program cannot deliver
             would be a lie in the cache key).
+        :param flight: batch the flight recorder's per-generation signal
+            rows (:func:`evox_tpu.obs.flight_signals` of each stepped
+            state) out of the compiled segment as
+            ``telemetry["flight"]`` — additional scan outputs, zero host
+            callbacks, carry untouched (see :class:`SegmentConfig`).
         """
         barrier = bool(barrier) and not lane_freeze
         if health is not None:
@@ -569,6 +582,7 @@ class StdWorkflow(Workflow):
                 stop_on_unhealthy=bool(stop_on_unhealthy),
                 barrier=bool(barrier),
                 lane_freeze=bool(lane_freeze),
+                flight=bool(flight),
             )
         return SegmentConfig(
             capture_history=bool(capture_history),
@@ -580,6 +594,7 @@ class StdWorkflow(Workflow):
             stop_on_unhealthy=bool(stop_on_unhealthy),
             barrier=bool(barrier),
             lane_freeze=bool(lane_freeze),
+            flight=bool(flight),
         )
 
     def _traced_capture_step(
@@ -667,6 +682,26 @@ class StdWorkflow(Workflow):
                 best = _best_fitness_expr(new_st, algo)
                 if best is not None:
                     out["best_fitness"] = best
+                if cfg.flight:
+                    # Flight-recorder signals ride as additional scan
+                    # OUTPUTS (pure jnp reductions over the stepped state,
+                    # batched per generation) — the carry itself must stay
+                    # untouched, which is what keeps a flight-on run
+                    # bit-identical to a flight-off one.  That constrains
+                    # the expressions, not just the mechanism: partial
+                    # reductions, slices of carry arrays, and combined
+                    # moment arithmetic all shift the carry by ulps or
+                    # duplicate compute (and an ``optimization_barrier``
+                    # cannot pin it — the CPU pipeline expands barriers
+                    # before fusion, at ~10% wall cost), so the program
+                    # ships raw full-to-scalar moment sums
+                    # (``flight_signals(raw=True)``) and the recorder
+                    # finishes them host-side — measured carry-exact on
+                    # CPU XLA for PSO/OpenES/CMA-ES at both test and
+                    # gate shapes (tests/test_flight.py pins it).
+                    from ..obs.flight import flight_signals
+
+                    out["flight"] = flight_signals(new_st, raw=True)
                 return new_st, out
 
             def scan_metrics(st: State):
@@ -846,6 +881,8 @@ class StdWorkflow(Workflow):
             }
             if "best_fitness" in outs:
                 telemetry["best_fitness"] = outs["best_fitness"]
+            if "flight" in outs:
+                telemetry["flight"] = outs["flight"]
             if cfg.metrics:
                 telemetry["metrics"] = scan_metrics(final)
             # Static site identities for flush_telemetry, embedded as a
@@ -873,6 +910,7 @@ class StdWorkflow(Workflow):
         health: Any | None = None,
         barrier: bool = True,
         frozen: jax.Array | None = None,
+        flight: bool = False,
     ) -> tuple[State, State]:
         """Run ``n_steps`` generations as ONE compiled ``lax.scan`` segment
         with the resilience features carried *inside* the program, and
@@ -911,6 +949,10 @@ class StdWorkflow(Workflow):
                                     always self-describe their sinks)
             best_fitness  (n,)    — per-generation best (minimizing
                                     frame), when the state exposes one
+            flight        dict    — with ``flight=True``, the flight
+                                    recorder's per-generation signal
+                                    batches ({name: (n,) array}; see
+                                    :func:`evox_tpu.obs.flight_signals`)
             metrics       dict    — scan_state() of the final state
 
         Host-side work belongs at the segment boundary: call
@@ -939,6 +981,7 @@ class StdWorkflow(Workflow):
             health=health,
             barrier=barrier,
             lane_freeze=frozen is not None,
+            flight=flight,
         )
         if self._segment_jit is None:
             self._segment_jit = jax.jit(

@@ -57,13 +57,24 @@
 #                               registry snapshot vs a real faulty run's
 #                               RunStats, Chrome-trace well-formedness,
 #                               per-tenant metric labels, instrumented-vs-
-#                               uninstrumented bit-identity), then a full
-#                               graftlint sweep (no obs call site may sit
-#                               in compiled scope — GL002 stays clean),
-#                               then the overhead gate: a fully-
-#                               instrumented fused run must keep ≥98% of
-#                               uninstrumented gen/s on the PSO Ackley
-#                               config (artifact under bench_artifacts/).
+#                               uninstrumented bit-identity), the flight-
+#                               recorder suite (bit-identity with the
+#                               per-generation telemetry on, postmortem
+#                               bundle schema, rollback/storm triggers,
+#                               per-tenant demux), the XLA-introspection
+#                               + bench-history analytics suites, then a
+#                               full graftlint sweep (no obs call site may
+#                               sit in compiled scope — GL002 stays
+#                               clean), the bench-history regression
+#                               check in report-only mode (CPU boxes hold
+#                               no TPU-anchored rows to gate), and the
+#                               two-floor overhead gate: plane-only
+#                               instrumentation (identical program) must
+#                               keep ≥98% of uninstrumented gen/s, the
+#                               FULLY instrumented run — flight recorder
+#                               on, a different compiled program — ≥85%
+#                               on the PSO Ackley config (artifact under
+#                               bench_artifacts/).
 #                               Runs under a HARD wall-clock timeout like
 #                               --multihost.
 #   ./run_tests.sh --multihost  multi-host fleet lane: the fast multihost
@@ -132,12 +143,17 @@ if [ "$1" = "--obs" ]; then
   shift
   # Hard timeout (SIGKILL escalation), same pattern as --multihost: the
   # chaos test delivers a real SIGTERM; a wedged run must fail loudly.
-  OBS_TIMEOUT="${EVOX_TPU_OBS_TIMEOUT:-900}"
+  OBS_TIMEOUT="${EVOX_TPU_OBS_TIMEOUT:-1500}"
   timeout -k 30 "$OBS_TIMEOUT" \
-    "${CPU_ENV[@]}" python -m pytest tests/test_obs.py -q "$@" || exit 1
+    "${CPU_ENV[@]}" python -m pytest \
+    tests/test_obs.py tests/test_flight.py tests/test_bench_history.py \
+    -q "$@" || exit 1
   # No observability call site may land inside compiled scope: the full
   # graftlint sweep (GL002 et al.) must stay clean against its baselines.
   python -m tools.graftlint || exit 1
+  # Perf-regression analytics, report-only: a CPU container holds no
+  # TPU-anchored rows to gate, but the join must stay runnable.
+  python tools/check_bench_history.py --report-only || exit 1
   exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_obs_overhead.py
 fi
 if [ "$1" = "--multihost" ]; then

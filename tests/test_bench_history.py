@@ -1,0 +1,234 @@
+"""Perf-regression analytics tests (``tools/check_bench_history.py``).
+
+Synthetic history + artifact fixtures prove the detector's contract:
+beyond-spread drops exit nonzero (the acceptance fixture), values inside
+the recorded spread pass, CPU artifacts are never judged against
+TPU-anchored baselines, CPU-provisional entries report without gating,
+``n_processes`` mismatches are refused as comparisons, the no-spread
+margin fallback fires, and the Prometheus snapshot carries every
+comparison.  The tool runs as a subprocess (its real CLI entry) — no jax
+import anywhere in its process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_bench_history.py")
+
+METRIC = "PSO generations/sec/chip (synthetic fixture)"
+
+
+def write_fixture(tmp_path, *, entry, artifact):
+    history = tmp_path / "history.json"
+    artifacts = tmp_path / "artifacts"
+    artifacts.mkdir(exist_ok=True)
+    history.write_text(json.dumps({METRIC: entry}))
+    (artifacts / "fixture.cpu.json").write_text(json.dumps(artifact))
+    return history, artifacts
+
+
+def run_tool(history, artifacts, *extra):
+    proc = subprocess.run(
+        [
+            sys.executable,
+            TOOL,
+            "--history",
+            str(history),
+            "--artifacts",
+            str(artifacts),
+            "--json",
+            *extra,
+        ],
+        capture_output=True,
+        text=True,
+    )
+    out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+    return proc.returncode, out, proc.stderr
+
+
+def tpu_entry(**over):
+    entry = {
+        "baseline": 105.0,
+        "platform": "tpu",
+        "spread": [100.0, 110.0],
+        "n_runs": 3,
+    }
+    entry.update(over)
+    return entry
+
+
+def measurement(value, platform="tpu", **over):
+    m = {"metric": METRIC, "value": value, "platform": platform}
+    m.update(over)
+    return m
+
+
+def test_beyond_spread_regression_exits_nonzero(tmp_path):
+    """ACCEPTANCE: a value below the baseline's recorded spread minimum
+    against a TPU-anchored entry fails the gate."""
+    rc, out, _ = run_tool(
+        *write_fixture(
+            tmp_path, entry=tpu_entry(), artifact=measurement(80.0)
+        )
+    )
+    assert rc != 0
+    (row,) = out["rows"]
+    assert row["status"] == "regression"
+    assert row["floor_kind"] == "beyond-spread"
+    assert row["floor"] == 100.0
+    assert row["anchored"] is True
+
+
+def test_zero_value_is_a_regression_not_a_skip(tmp_path):
+    """A measured 0.0 is the most catastrophic drop representable — it
+    must be flagged, never classified as 'no-value' (falsy-zero bug)."""
+    rc, out, _ = run_tool(
+        *write_fixture(
+            tmp_path, entry=tpu_entry(), artifact=measurement(0.0)
+        )
+    )
+    assert rc != 0
+    assert out["rows"][0]["status"] == "regression"
+
+
+def test_within_spread_passes(tmp_path):
+    rc, out, _ = run_tool(
+        *write_fixture(
+            tmp_path, entry=tpu_entry(), artifact=measurement(101.0)
+        )
+    )
+    assert rc == 0
+    assert out["rows"][0]["status"] == "ok"
+
+
+def test_cpu_artifact_never_judged_against_tpu_baseline(tmp_path):
+    """A CPU dev-box artifact showing 1% of the TPU number is a platform
+    difference, not a regression."""
+    rc, out, _ = run_tool(
+        *write_fixture(
+            tmp_path,
+            entry=tpu_entry(),
+            artifact=measurement(1.0, platform="cpu"),
+        )
+    )
+    assert rc == 0
+    assert out["rows"][0]["status"] == "cross-platform"
+
+
+def test_cpu_provisional_entry_reports_without_gating(tmp_path):
+    """CPU-provisional baselines (indicative_only, awaiting a TPU
+    re-anchor) report regressions but never gate — unless --strict."""
+    fixture = write_fixture(
+        tmp_path,
+        entry={
+            "baseline": 100.0,
+            "platform": "cpu",
+            "indicative_only": True,
+            "spread": [95.0, 104.0],
+        },
+        artifact=measurement(50.0, platform="cpu"),
+    )
+    rc, out, _ = run_tool(*fixture)
+    assert rc == 0
+    assert out["rows"][0]["status"] == "regression"
+    assert out["rows"][0]["anchored"] is False
+    rc_strict, _, _ = run_tool(*fixture, "--strict")
+    assert rc_strict != 0
+
+
+def test_n_processes_never_conflated(tmp_path):
+    """A multi-host baseline must not judge a single-host artifact of the
+    same config (per-chip numbers mean different things across DCN)."""
+    rc, out, _ = run_tool(
+        *write_fixture(
+            tmp_path,
+            entry=tpu_entry(n_processes=8),
+            artifact=measurement(10.0, n_processes=1),
+        )
+    )
+    assert rc == 0
+    (row,) = out["rows"]
+    assert row["status"] == "process-count-mismatch"
+    assert row["entry_n_processes"] == 8
+    assert row["artifact_n_processes"] == 1
+
+
+def test_margin_fallback_without_spread(tmp_path):
+    entry = tpu_entry()
+    del entry["spread"]
+    history, artifacts = write_fixture(
+        tmp_path, entry=entry, artifact=measurement(95.0)
+    )
+    rc, out, _ = run_tool(history, artifacts)
+    assert rc == 0  # 95 > 105 * 0.9 = 94.5
+    assert out["rows"][0]["floor_kind"] == "beyond-margin"
+    (artifacts / "fixture.cpu.json").write_text(
+        json.dumps(measurement(80.0))
+    )
+    rc, out, _ = run_tool(history, artifacts)
+    assert rc != 0  # 80 < 94.5
+
+
+def test_report_only_always_exits_zero(tmp_path):
+    rc, out, _ = run_tool(
+        *write_fixture(
+            tmp_path, entry=tpu_entry(), artifact=measurement(80.0)
+        ),
+        "--report-only",
+    )
+    assert rc == 0
+    assert out["rows"][0]["status"] == "regression"
+
+
+def test_prometheus_snapshot_written(tmp_path):
+    history, artifacts = write_fixture(
+        tmp_path, entry=tpu_entry(), artifact=measurement(80.0)
+    )
+    prom = tmp_path / "check.prom"
+    rc, _, _ = run_tool(history, artifacts, "--prom-out", str(prom))
+    assert rc != 0
+    text = prom.read_text()
+    assert "# TYPE evox_bench_check_regression gauge" in text
+    samples = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            series, value = line.rsplit(" ", 1)
+            samples[series] = float(value)
+    label = f'{{metric="{METRIC}"}}'
+    assert samples[f"evox_bench_check_regression{label}"] == 1.0
+    assert samples[f"evox_bench_check_value{label}"] == 80.0
+    assert samples[f"evox_bench_check_floor{label}"] == 100.0
+    assert samples[f"evox_bench_check_anchored{label}"] == 1.0
+    assert samples["evox_obs_schema_version"] >= 2
+
+
+def test_live_repo_join_runs_clean():
+    """The real BENCH_HISTORY.json + bench_artifacts/ join must stay
+    runnable (the CI wiring), in report-only mode on this CPU box."""
+    proc = subprocess.run(
+        [sys.executable, TOOL, "--report-only", "--prom-out", "none"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "compared" in proc.stdout
+
+
+@pytest.mark.parametrize("bad", ["missing", "garbage"])
+def test_unreadable_history_is_a_loud_error(tmp_path, bad):
+    history = tmp_path / "history.json"
+    if bad == "garbage":
+        history.write_text("{not json")
+    proc = subprocess.run(
+        [sys.executable, TOOL, "--history", str(history)],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 2
+    assert "cannot read history" in proc.stderr

@@ -1,0 +1,488 @@
+"""Flight-recorder tests: bit-identity, postmortem bundles, triggers.
+
+The two headline contracts (ISSUE 10 acceptance):
+
+* **bit-identity** — a run with the flight recorder enabled (per-
+  generation signals batched out of the fused scan) is bit-identical to
+  the same run with it disabled: final state, monitor history, and the
+  final checkpoint's per-leaf digests, for PSO / OpenES / CMA-ES solo
+  runs and for packed service runs;
+* **the black box** — an induced health rollback (NaN burst via
+  ``FaultyProblem``) dumps a postmortem bundle whose per-generation
+  diversity/σ/fitness series covers the last-K-generation window before
+  the restart, ``json.load``-clean with every referenced generation
+  present.
+
+Around them: signal-extraction structure per algorithm family, the
+ring-buffer window bound, the quarantine-storm and preemption triggers,
+per-kind dump dedup, and the per-tenant demux + bundle namespaces of a
+packed service run.
+"""
+
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.algorithms import PSO
+from evox_tpu.algorithms.so.es_variants import CMAES, OpenES
+from evox_tpu.obs import (
+    OBS_SCHEMA_VERSION,
+    FlightRecorder,
+    MetricsRegistry,
+    Observability,
+    flight_signals,
+)
+from evox_tpu.problems.numerical import Ackley, Sphere
+from evox_tpu.resilience import (
+    FaultyProblem,
+    HealthProbe,
+    Preempted,
+    ResilientRunner,
+    RollbackToCheckpoint,
+)
+from evox_tpu.service import OptimizationService, TenantSpec, TenantStatus
+from evox_tpu.utils.checkpoint import read_manifest
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+DIM = 6
+POP = 8
+LB = jnp.full((DIM,), -5.0)
+UB = jnp.full((DIM,), 5.0)
+
+
+@pytest.fixture
+def key():
+    return jax.random.key(0)
+
+
+def _npify(x):
+    if isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key
+    ):
+        return np.asarray(jax.random.key_data(x))
+    return np.asarray(x)
+
+
+def assert_states_equal(a, b, context=""):
+    leaves_a = jax.tree_util.tree_leaves_with_path(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    for (path, la), lb_ in zip(leaves_a, leaves_b):
+        assert np.array_equal(_npify(la), _npify(lb_)), (
+            f"{context}: leaf {jax.tree_util.keystr(path)} differs"
+        )
+
+
+def _algorithms():
+    return {
+        "pso": lambda: PSO(POP, LB, UB),
+        "openes": lambda: OpenES(
+            pop_size=POP,
+            center_init=jnp.full((DIM,), 3.0),
+            learning_rate=0.1,
+            noise_stdev=0.1,
+            optimizer="adam",
+        ),
+        "cmaes": lambda: CMAES(jnp.zeros(DIM), 1.0, pop_size=POP),
+    }
+
+
+def _run(tmp_path, tag, algo_factory, *, flight, key, n_steps=11,
+         problem=None, checkpoint_every=4, **runner_kwargs):
+    mon = EvalMonitor(full_fit_history=True)
+    wf = StdWorkflow(
+        algo_factory(), problem if problem is not None else Sphere(),
+        monitor=mon,
+    )
+    if flight:
+        obs = Observability(
+            registry=MetricsRegistry(),
+            flight=FlightRecorder(tmp_path / tag / "pm", window=64),
+            run_id=tag,
+        )
+    else:
+        obs = False
+    runner = ResilientRunner(
+        wf, tmp_path / tag, checkpoint_every=checkpoint_every, obs=obs,
+        **runner_kwargs
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        final = runner.run(wf.init(key), n_steps)
+    return final, mon, runner
+
+
+def _newest_digests(ckpt_dir):
+    newest = sorted(p for p in ckpt_dir.glob("ckpt_*.npz"))[-1]
+    return newest.name, read_manifest(newest)["leaf_digests"]
+
+
+# ---------------------------------------------------------------------------
+# signal extraction
+# ---------------------------------------------------------------------------
+
+
+def test_flight_signals_structure_pso(key):
+    wf = StdWorkflow(PSO(POP, LB, UB), Sphere(), monitor=EvalMonitor())
+    state = jax.jit(wf.init_step)(wf.init(key))
+    sig = jax.jit(flight_signals)(state)
+    for name in (
+        "best_fitness",
+        "mean_fitness",
+        "worst_fitness",
+        "pop_diversity",
+        "velocity_norm",
+        "num_nonfinite",
+    ):
+        assert name in sig, name
+    assert "step_size_min" not in sig  # PSO has no sigma leaf
+    assert float(sig["best_fitness"]) <= float(sig["mean_fitness"])
+    assert float(sig["mean_fitness"]) <= float(sig["worst_fitness"])
+    assert float(sig["pop_diversity"]) > 0
+
+
+def test_flight_signals_structure_cmaes(key):
+    wf = StdWorkflow(
+        CMAES(jnp.zeros(DIM), 1.0, pop_size=POP), Sphere(),
+        monitor=EvalMonitor(),
+    )
+    state = jax.jit(wf.init_step)(wf.init(key))
+    sig = jax.jit(flight_signals)(state)
+    assert "step_size_min" in sig and "step_size_max" in sig
+    # Scalar CMA-ES step size: extrema coincide.
+    assert float(sig["step_size_min"]) == float(sig["step_size_max"])
+    assert float(sig["step_size_min"]) > 0
+
+
+def test_segment_telemetry_carries_flight_batches(key):
+    from evox_tpu.obs import finalize_row
+
+    wf = StdWorkflow(PSO(POP, LB, UB), Sphere(), monitor=EvalMonitor())
+    state = jax.jit(wf.init_step)(wf.init(key))
+    _, telemetry = wf.run_segment(state, 5, flight=True)
+    assert "flight" in telemetry
+    flight = telemetry["flight"]
+    # In-program the 2-D signals travel as raw moment sums (the only
+    # carry-exact shape); 1-D signals are already semantic.
+    for name in ("best_fitness", "_pop_sum", "_pop_sumsq", "_velocity_max"):
+        assert np.asarray(flight[name]).shape == (5,), name
+    # finalize_row turns one generation's raw row into semantic signals.
+    row = finalize_row(
+        {str(k): float(np.asarray(v)[0]) for k, v in flight.items()}
+    )
+    assert row["pop_diversity"] > 0
+    assert row["velocity_norm"] >= 0
+    assert not any(k.startswith("_") for k in row)
+    # ... matching the standalone (semantic) extraction of the same state
+    # up to the whole-tensor-moment rounding of the two paths.
+    # And without the flag the telemetry shape is unchanged.
+    _, bare = wf.run_segment(state, 5, flight=False)
+    assert "flight" not in bare
+
+
+# ---------------------------------------------------------------------------
+# bit-identity (acceptance)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("algo", sorted(_algorithms()))
+def test_bit_identity_solo(tmp_path, key, algo):
+    """Flight recorder on vs off: final state, full monitor history, and
+    the final checkpoint's per-leaf digests are the same bits."""
+    factory = _algorithms()[algo]
+    final_on, mon_on, runner_on = _run(
+        tmp_path, f"{algo}-on", factory, flight=True, key=key
+    )
+    final_off, mon_off, _ = _run(
+        tmp_path, f"{algo}-off", factory, flight=False, key=key
+    )
+    assert_states_equal(final_on, final_off, context=algo)
+    hist_on = [np.asarray(f) for f in mon_on.fitness_history]
+    hist_off = [np.asarray(f) for f in mon_off.fitness_history]
+    assert len(hist_on) == len(hist_off) and len(hist_on) > 0
+    for a, b in zip(hist_on, hist_off):
+        np.testing.assert_array_equal(a, b)
+    name_on, dig_on = _newest_digests(tmp_path / f"{algo}-on")
+    name_off, dig_off = _newest_digests(tmp_path / f"{algo}-off")
+    assert name_on == name_off
+    assert dig_on == dig_off
+    # And the recorder actually saw the run (window rows, gens 2..11:
+    # the init generation and single-gen ragged tails run outside the
+    # fused telemetry path).
+    rows = runner_on.obs.flight.rows()
+    assert rows and rows[-1]["generation"] >= 9
+
+
+def test_rollback_bit_identity_with_faults(tmp_path, key):
+    """The induced-rollback run itself (NaN burst -> health restart) is
+    bit-identical with the flight recorder on and off."""
+
+    def problem():
+        # The corrupt canary lands on the LAST eval of a 3-generation
+        # segment (evals 4..6 make up gens 5..7), so the boundary probe
+        # sees it — the test_obs chaos recipe.
+        return FaultyProblem(
+            Sphere(), corrupt_generations=[6], corrupt_times=1
+        )
+
+    finals = {}
+    for tag in ("on", "off"):
+        finals[tag], _, runner = _run(
+            tmp_path,
+            f"flt-{tag}",
+            _algorithms()["pso"],
+            flight=tag == "on",
+            key=key,
+            n_steps=18,
+            checkpoint_every=3,
+            problem=problem(),
+            health=HealthProbe(),
+            restart=RollbackToCheckpoint(),
+        )
+        assert len(runner.stats.restarts) == 1
+    assert_states_equal(finals["on"], finals["off"], context="rollback")
+
+
+# ---------------------------------------------------------------------------
+# the black box (acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_health_rollback_dumps_postmortem_bundle(tmp_path, key):
+    """An induced health rollback dumps a bundle whose per-generation
+    fitness/diversity series covers the window before the restart, with
+    every referenced generation present and every file json-clean."""
+    _, _, runner = _run(
+        tmp_path,
+        "pm",
+        _algorithms()["pso"],
+        flight=True,
+        key=key,
+        n_steps=18,
+        checkpoint_every=3,
+        problem=FaultyProblem(
+            Sphere(), corrupt_generations=[6], corrupt_times=1
+        ),
+        health=HealthProbe(),
+        restart=RollbackToCheckpoint(),
+    )
+    assert len(runner.stats.restarts) == 1
+    restart_gen = runner.stats.restarts[0].generation
+    recorder = runner.obs.flight
+    bundles = [b for b in recorder.bundles if "restart" in b.name]
+    assert len(bundles) == 1
+    bundle = bundles[0]
+
+    manifest = json.load(open(bundle / "manifest.json"))  # json-clean
+    assert manifest["schema"] == OBS_SCHEMA_VERSION
+    assert manifest["kind"] == "restart"
+    assert manifest["run_id"] == "pm"
+    assert manifest["trigger"]["category"] == "restart"
+    rows = [
+        json.loads(line) for line in open(bundle / "flight.jsonl")
+    ]  # json-clean
+    assert len(rows) == manifest["rows"]
+    gens = [r["generation"] for r in rows]
+    # Contiguous coverage: every generation in the manifest's span is
+    # present (fused segments cover gens 2..restart boundary — the init
+    # generation runs outside the scan).
+    assert gens == list(
+        range(manifest["first_generation"], manifest["last_generation"] + 1)
+    )
+    assert manifest["first_generation"] == 2
+    # ... and the window reaches the restart boundary: the last rows ARE
+    # the generations right before the rollback.
+    assert manifest["last_generation"] == restart_gen
+    for name in ("best_fitness", "pop_diversity", "num_nonfinite"):
+        assert name in manifest["signals"]
+        assert all(name in r for r in rows)
+
+
+def test_window_bound_and_dedup(tmp_path, key):
+    recorder = FlightRecorder(tmp_path / "pm", window=5)
+    for seg in range(3):  # 3 segments x 4 gens
+        recorder.record_rows(
+            {"best_fitness": np.arange(4, dtype=np.float64)},
+            4,
+            start_generation=seg * 4,
+        )
+    rows = recorder.rows()
+    assert len(rows) == 5  # bounded
+    assert [r["generation"] for r in rows] == [8, 9, 10, 11, 12]
+    assert recorder.latest_generation() == 12
+    # Dedup: same kind with no new rows dumps once; a different kind (or
+    # force) still dumps.
+    assert recorder.dump("restart") is not None
+    assert recorder.dump("restart") is None
+    assert recorder.dump("health") is not None
+    assert recorder.dump("restart", force=True) is not None
+    # A rollback REPLAYS earlier generations: new rows whose generation
+    # numbers do not advance are still new content — the second
+    # (divergent) failure within the restart budget must get its bundle.
+    recorder.record_rows(
+        {"best_fitness": np.arange(4, dtype=np.float64)},
+        4,
+        start_generation=6,  # replay of gens 7..10 — newest stays 12
+    )
+    assert recorder.latest_generation() == 10
+    assert recorder.dump("restart") is not None
+
+
+def test_bundle_numbering_survives_recorder_recreation(tmp_path):
+    """A readmitted tenant id builds a fresh recorder over the SAME
+    namespace directory — numbering must continue past the earlier
+    incarnation's bundles, never clobber them."""
+    first = FlightRecorder(tmp_path / "pm", window=4)
+    first.record_rows({"best_fitness": np.ones(2)}, 2, start_generation=0)
+    bundle0 = first.dump("restart")
+    assert bundle0 is not None and "_00000_" in bundle0.name
+    second = FlightRecorder(tmp_path / "pm", window=4)
+    second.record_rows({"best_fitness": np.zeros(2)}, 2, start_generation=0)
+    bundle1 = second.dump("restart")
+    assert bundle1 is not None and "_00001_" in bundle1.name
+    # The first incarnation's evidence is intact.
+    assert json.load(open(bundle0 / "manifest.json"))["rows"] == 2
+    assert bundle0.exists() and bundle1.exists()
+
+
+def test_quarantine_storm_trigger(tmp_path, key):
+    """A sustained NaN burst (quarantined in-scan, no health restart)
+    trips the recorder's own storm detector — one bundle, not one per
+    segment."""
+    recorder = FlightRecorder(tmp_path / "pm", window=32, quarantine_storm=8)
+    obs = Observability(
+        registry=MetricsRegistry(), flight=recorder, run_id="storm"
+    )
+    mon = EvalMonitor()
+    wf = StdWorkflow(
+        PSO(POP, LB, UB),
+        FaultyProblem(
+            Sphere(), nan_generations=tuple(range(4, 40)), nan_rows=POP
+        ),
+        monitor=mon,
+    )
+    runner = ResilientRunner(wf, tmp_path / "ck", checkpoint_every=4, obs=obs)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        runner.run(wf.init(key), 16)
+    storm = [b for b in recorder.bundles if "quarantine-storm" in b.name]
+    assert len(storm) == 1
+    manifest = json.load(open(storm[0] / "manifest.json"))
+    assert manifest["kind"] == "quarantine-storm"
+    assert manifest["detail"]["quarantined_in_window"] >= 8
+
+
+def test_preemption_dumps_bundle(tmp_path, key):
+    recorder = FlightRecorder(tmp_path / "pm", window=32)
+    obs = Observability(
+        registry=MetricsRegistry(), flight=recorder, run_id="pre"
+    )
+    mon = EvalMonitor()
+    wf = StdWorkflow(
+        PSO(POP, LB, UB),
+        FaultyProblem(Sphere(), sigterm_generations=[9], sigterm_times=1),
+        monitor=mon,
+    )
+    runner = ResilientRunner(
+        wf, tmp_path / "ck", checkpoint_every=4, preemption=True, obs=obs
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(Preempted):
+            runner.run(wf.init(key), 20)
+    kinds = [b.name.split("_")[-1] for b in recorder.bundles]
+    assert "preemption" in kinds
+
+
+# ---------------------------------------------------------------------------
+# packed service: per-tenant demux + namespaced bundles
+# ---------------------------------------------------------------------------
+
+
+def _service(root, *, flight_dir=None, lanes=4):
+    if flight_dir is not None:
+        obs = Observability(
+            registry=MetricsRegistry(),
+            flight=FlightRecorder(flight_dir, window=64),
+            run_id="svc",
+        )
+    else:
+        obs = False
+    return OptimizationService(
+        root,
+        lanes_per_pack=lanes,
+        segment_steps=4,
+        seed=0,
+        health=HealthProbe(stagnation_window=2, stagnation_tol=0.0),
+        max_restarts=1,
+        obs=obs,
+    )
+
+
+LANE_FAULTS = {
+    1: {"plateau_from": 2, "plateau_floor": 50.0},
+}
+
+
+def _spec(name, uid, n_steps=17):
+    return TenantSpec(
+        name,
+        PSO(POP, LB, UB),
+        FaultyProblem(Ackley(), lane_faults=LANE_FAULTS),
+        n_steps=n_steps,
+        uid=uid,
+    )
+
+
+def test_service_per_tenant_flight_and_bit_identity(tmp_path):
+    """Packed-service acceptance: the flight recorder demuxes per lane —
+    the stagnating tenant's restart and quarantine dump bundles into ITS
+    namespace, the healthy cotenant dumps nothing — and the healthy
+    tenant's result is bit-identical to a flight-off service run."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        bare = _service(tmp_path / "bare")
+        bare.submit(_spec("tenant-T", 0))
+        bare.submit(_spec("stagnator", 1))
+        bare.run()
+
+        svc = _service(tmp_path / "flt", flight_dir=tmp_path / "pm")
+        svc.submit(_spec("tenant-T", 0))
+        svc.submit(_spec("stagnator", 1))
+        svc.run()
+
+    assert svc.tenant("tenant-T").status is TenantStatus.COMPLETED
+    assert svc.tenant("stagnator").status is TenantStatus.QUARANTINED
+    assert svc.tenant("stagnator").restarts == 1
+    assert bare.tenant("tenant-T").status is TenantStatus.COMPLETED
+
+    # Bit-identity: the packed program with flight telemetry produces the
+    # same bits as the flight-off pack.
+    assert_states_equal(
+        svc.result("tenant-T"), bare.result("tenant-T"), context="packed"
+    )
+
+    # Per-tenant rows: the stagnator's series flatlines at the plateau
+    # floor (its first row predates the plateau's onset) while
+    # tenant-T's keeps improving — the demux is real.
+    t_rows = svc.tenant("tenant-T").flight.rows()
+    s_rows = svc.tenant("stagnator").flight.rows()
+    assert t_rows and s_rows
+    assert min(r["best_fitness"] for r in t_rows) < 49.0
+    assert all(r["best_fitness"] >= 49.99 for r in s_rows[1:])
+    assert len({round(r["best_fitness"], 6) for r in s_rows[1:]}) == 1
+
+    # Bundles land in the stagnator's own namespace; the healthy tenant
+    # dumps nothing.
+    s_bundles = svc.tenant("stagnator").flight.bundles
+    assert s_bundles
+    assert all("stagnator" in str(b) for b in s_bundles)
+    for bundle in s_bundles:
+        manifest = json.load(open(bundle / "manifest.json"))
+        assert manifest["tenant_id"] == "stagnator"
+        assert manifest["kind"] == "tenant"
+    assert svc.tenant("tenant-T").flight.bundles == []

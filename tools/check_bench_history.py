@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Spread-aware perf-regression analytics over the bench history.
+
+``BENCH_HISTORY.json`` records, per metric, the anchored baseline plus the
+measurement conditions that make it comparable: platform, multi-run
+``spread`` (min/max), ``n_processes``, and the ``indicative_only`` flag on
+CPU-provisional entries awaiting a TPU re-anchor.  This tool joins that
+store against the *current* measurements in ``bench_artifacts/*.json``
+(every bench child writes one) and flags drops that cannot be noise:
+
+* **beyond-spread** — the measured value fell below the baseline's
+  recorded multi-run minimum (the noise band the sweep itself measured);
+* **beyond-margin** — no spread was recorded (single-run baseline), so
+  the fallback floor is ``baseline * (1 - margin)``.
+
+Comparability is enforced, never papered over:
+
+* a CPU artifact is NEVER judged against a TPU-anchored baseline (and
+  vice versa) — cross-platform rows are reported as skipped;
+* ``indicative_only`` (CPU-provisional) baselines report but never gate;
+* ``n_processes`` must match — single-host and ``jax.distributed``
+  multi-host measurements of one config are different quantities (the
+  all-gather crosses DCN) and are refused as a comparison, loudly.
+
+Exit status: nonzero iff a regression was flagged against a TPU-anchored
+baseline (``--strict`` gates CPU-vs-CPU rows too; ``--report-only``
+always exits 0 — the CI wiring on CPU boxes).  A Prometheus snapshot of
+every comparison (``evox_bench_check_*`` gauges) is written atomically
+for scrape-based dashboards.
+
+Wired into ``tools/run_tpu_sweep.sh`` (after the sweep re-anchors) and
+``./run_tests.sh --obs`` (report-only: CPU containers have no anchored
+rows to gate).
+
+Usage::
+
+    python tools/check_bench_history.py                  # repo defaults
+    python tools/check_bench_history.py --report-only    # CI on CPU
+    python tools/check_bench_history.py --history H.json --artifacts DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# The obs package by file path (import-light by contract): this tool runs
+# in sweep shells and CI parents that must never import ``evox_tpu`` (and
+# with it jax + a backend).  One shared loader for every such entry point.
+from tools.obs_loader import load_obs  # noqa: E402 - path bootstrap first
+
+
+def load_measurements(artifact_dir: str) -> list[dict]:
+    """Every current bench measurement: top-level ``*.json`` artifacts
+    carrying ``metric``/``value``/``platform`` (overhead gates, probe
+    verdicts, and profile directories are naturally excluded)."""
+    out = []
+    if not os.path.isdir(artifact_dir):
+        return out
+    for name in sorted(os.listdir(artifact_dir)):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(artifact_dir, name)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(data, dict):
+            continue
+        if "metric" in data and "value" in data and "platform" in data:
+            data["_artifact"] = name
+            out.append(data)
+    return out
+
+
+def compare(entry: dict, measurement: dict, *, margin: float) -> dict:
+    """One baseline-vs-current comparison row.
+
+    ``status``: ``ok`` / ``regression`` / one of the structured skip
+    reasons (``cross-platform``, ``process-count-mismatch``,
+    ``no-value``).  ``anchored`` is True only for TPU-anchored,
+    non-provisional baselines — the rows the exit code gates on."""
+    row = {
+        "metric": measurement["metric"],
+        "artifact": measurement.get("_artifact"),
+        "value": measurement.get("value"),
+        "baseline": entry.get("baseline"),
+        "platform": entry.get("platform"),
+        "anchored": (
+            entry.get("platform") == "tpu"
+            and not entry.get("indicative_only")
+        ),
+        "floor": None,
+        "floor_kind": None,
+        "status": "ok",
+    }
+    # `is None`, NOT falsy: a measured 0.0 is the most catastrophic drop
+    # representable and must flow into the floor comparison below, never
+    # be skipped as "no value".
+    if measurement.get("value") is None:
+        row["status"] = "no-value"
+        return row
+    if measurement.get("platform") != entry.get("platform"):
+        # A CPU dev-box artifact must never be judged against a
+        # TPU-anchored number (nor the reverse).
+        row["status"] = "cross-platform"
+        return row
+    if int(measurement.get("n_processes", 1)) != int(
+        entry.get("n_processes", 1)
+    ):
+        # Never conflate single-host and jax.distributed measurements of
+        # one config: per-chip numbers mean something different when the
+        # all-gather crosses DCN.
+        row["status"] = "process-count-mismatch"
+        row["entry_n_processes"] = int(entry.get("n_processes", 1))
+        row["artifact_n_processes"] = int(measurement.get("n_processes", 1))
+        return row
+    spread = entry.get("spread")
+    if spread and len(spread) == 2 and spread[0]:
+        row["floor"] = float(spread[0])
+        row["floor_kind"] = "beyond-spread"
+    else:
+        row["floor"] = float(entry["baseline"]) * (1.0 - margin)
+        row["floor_kind"] = "beyond-margin"
+    if float(measurement["value"]) < row["floor"]:
+        row["status"] = "regression"
+    return row
+
+
+def publish_prometheus(obs, rows: list[dict], path: str) -> None:
+    """Every comparison as ``evox_bench_check_*{metric=...}`` gauges in an
+    atomically-published Prometheus textfile (schema-version gauge rides
+    along via the registry's exposition)."""
+    registry = obs.MetricsRegistry()
+    for row in rows:
+        labels = {"metric": row["metric"]}
+        if row["value"] is not None:
+            registry.gauge(
+                "evox_bench_check_value",
+                "Current bench measurement under regression check.",
+                **labels,
+            ).set(float(row["value"]))
+        if row["baseline"] is not None:
+            registry.gauge(
+                "evox_bench_check_baseline",
+                "Anchored baseline the measurement is judged against.",
+                **labels,
+            ).set(float(row["baseline"]))
+        if row["floor"] is not None:
+            registry.gauge(
+                "evox_bench_check_floor",
+                "Regression floor (recorded spread minimum, or "
+                "baseline*(1-margin) without one).",
+                **labels,
+            ).set(float(row["floor"]))
+        registry.gauge(
+            "evox_bench_check_regression",
+            "1 when the measurement fell below the floor (comparable "
+            "rows only).",
+            **labels,
+        ).set(1.0 if row["status"] == "regression" else 0.0)
+        registry.gauge(
+            "evox_bench_check_anchored",
+            "1 when the baseline is TPU-anchored (the gated rows).",
+            **labels,
+        ).set(1.0 if row["anchored"] else 0.0)
+    registry.write_prometheus(path)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(
+        description="Spread-aware bench-history regression gate."
+    )
+    p.add_argument(
+        "--history", default=os.path.join(_REPO, "BENCH_HISTORY.json")
+    )
+    p.add_argument(
+        "--artifacts", default=os.path.join(_REPO, "bench_artifacts")
+    )
+    p.add_argument(
+        "--margin", type=float, default=0.10,
+        help="fallback floor fraction for baselines without a recorded "
+        "spread (default 0.10 = flag >10%% drops)",
+    )
+    p.add_argument(
+        "--prom-out", default=None,
+        help="Prometheus textfile path (default "
+        "<artifacts>/bench_check.prom; 'none' disables)",
+    )
+    p.add_argument(
+        "--report-only", action="store_true",
+        help="always exit 0 (CI wiring on CPU boxes with no anchored rows)",
+    )
+    p.add_argument(
+        "--strict", action="store_true",
+        help="gate CPU-vs-CPU comparisons too, not only TPU-anchored ones",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    args = p.parse_args()
+
+    try:
+        with open(args.history) as f:
+            history = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read history {args.history}: {e}", file=sys.stderr)
+        return 2
+
+    measurements = load_measurements(args.artifacts)
+    rows = []
+    for m in measurements:
+        entry = history.get(m["metric"])
+        if entry is None:
+            continue
+        rows.append(compare(entry, m, margin=args.margin))
+
+    prom_out = args.prom_out
+    if prom_out is None:
+        prom_out = os.path.join(args.artifacts, "bench_check.prom")
+    if prom_out != "none" and rows:
+        publish_prometheus(load_obs(), rows, prom_out)
+
+    regressions = [r for r in rows if r["status"] == "regression"]
+    gating = [
+        r for r in regressions if r["anchored"] or args.strict
+    ]
+    if args.json:
+        json.dump(
+            {
+                "rows": rows,
+                "regressions": len(regressions),
+                "gating": len(gating),
+            },
+            sys.stdout,
+            indent=1,
+        )
+        print()
+    else:
+        for r in sorted(rows, key=lambda r: (r["status"] != "regression", r["metric"])):
+            if r["status"] == "regression":
+                tag = "REGRESSION" if (r["anchored"] or args.strict) else (
+                    "regression (provisional, not gated)"
+                )
+                print(
+                    f"{tag}: {r['metric']}\n"
+                    f"  value {r['value']} < floor {r['floor']:.3f} "
+                    f"({r['floor_kind']}; baseline {r['baseline']})"
+                )
+            elif r["status"] in (
+                "cross-platform", "process-count-mismatch", "no-value"
+            ):
+                print(f"skipped ({r['status']}): {r['metric']}")
+            else:
+                print(
+                    f"ok: {r['metric']} (value {r['value']}, floor "
+                    f"{r['floor']:.3f})"
+                )
+        print(
+            f"-- {len(rows)} compared, {len(regressions)} regression(s), "
+            f"{len(gating)} gating"
+        )
+        if prom_out != "none" and rows:
+            print(f"prometheus snapshot -> {os.path.relpath(prom_out, _REPO)}")
+    if args.report_only:
+        return 0
+    return 1 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

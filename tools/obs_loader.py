@@ -1,0 +1,36 @@
+"""One file-path loader for the import-light ``evox_tpu.obs`` package.
+
+Three jax-free entry points need the obs package without importing
+``evox_tpu`` (whose transitive jax import would initialize a backend —
+exactly the hung-relay failure mode the bench harness quarantines in
+subprocesses): ``bench.py``'s parent process, ``tools/roofline.py``, and
+``tools/check_bench_history.py``.  The obs package is deliberately
+stdlib-only at import time to make this possible; this module is the ONE
+definition of the ``spec_from_file_location`` dance they all share.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_obs(name: str = "_evox_obs_filepath"):
+    """The ``evox_tpu.obs`` package loaded as a standalone package under
+    ``name`` (memoized in ``sys.modules``) — submodules (``metrics``,
+    ``xla``, ``flight``, ...) resolve through the returned module."""
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(_REPO, "evox_tpu", "obs")
+    spec = importlib.util.spec_from_file_location(
+        name,
+        os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod

@@ -1,10 +1,15 @@
-"""Roofline math from bench profile artifacts.
+"""Roofline math from bench profile artifacts — thin CLI shim.
 
-Reads a ``bench_artifacts/profile_<config>/cost_analysis.json`` (written by
-``bench.py --profile``: XLA's own per-program cost model) plus a measured
-generations/sec and prints achieved HBM bandwidth and FLOP throughput
-against the chip's peaks — the analysis VERDICT round 2 asked for
-("turn the north-star into a roofline story").
+The math itself lives in ``evox_tpu/obs/xla.py`` (:func:`roofline` /
+:func:`roofline_from_cost`): ONE definition shared by this CLI, the
+in-process ``evox_roofline_*`` gauges :class:`ResilientRunner` publishes
+at segment boundaries, and ``tools/run_tpu_sweep.sh``'s per-config
+``roofline.json`` artifacts.  Output format unchanged.
+
+Reads a ``bench_artifacts/profile_<config>/cost_analysis.json`` (written
+by ``bench.py --profile`` through ``obs.xla.write_cost_analysis``: XLA's
+own per-program cost model) plus a measured generations/sec and prints
+achieved HBM bandwidth and FLOP throughput against the chip's peaks.
 
 Usage::
 
@@ -19,17 +24,27 @@ import json
 import os
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.obs_loader import load_obs  # noqa: E402 - path bootstrap first
+
 
 def main() -> int:
+    # File-path load: this CLI runs in sweep orchestration shells that
+    # must never import ``evox_tpu`` (and with it jax + a backend).
+    obs_xla = load_obs().xla
     p = argparse.ArgumentParser()
     p.add_argument("profile_dir")
     p.add_argument("gen_per_sec", type=float)
     p.add_argument(
-        "--hbm-gbps", type=float, default=819.0,
+        "--hbm-gbps", type=float, default=obs_xla.DEFAULT_HBM_PEAK_GBPS,
         help="HBM peak GB/s (819 for the v5 lite chip this box tunnels to)",
     )
     p.add_argument(
-        "--peak-tflops", type=float, default=197.0,
+        "--peak-tflops", type=float,
+        default=obs_xla.DEFAULT_FLOP_PEAK_TFLOPS,
         help="peak TFLOP/s (v5e bf16 MXU ~197; halve for f32)",
     )
     args = p.parse_args()
@@ -37,32 +52,12 @@ def main() -> int:
     path = os.path.join(args.profile_dir, "cost_analysis.json")
     with open(path) as f:
         cost = json.load(f)
-    # Fused-driver profiles carry whole-program costs plus the generation
-    # count ("n_steps", written by bench._timed_fused) — normalize to
-    # per-generation so the roofline math matches per-step profiles.
-    n_steps = cost.get("n_steps") or 1
-    bytes_per_gen = cost.get("bytes accessed", 0.0) / n_steps
-    flops_per_gen = cost.get("flops", 0.0) / n_steps
-
-    gbps = bytes_per_gen * args.gen_per_sec / 1e9
-    tflops = flops_per_gen * args.gen_per_sec / 1e12
-    out = {
-        "bytes_per_gen": bytes_per_gen,
-        "flops_per_gen": flops_per_gen,
-        "achieved_GBps": round(gbps, 1),
-        "pct_of_hbm_peak": round(100 * gbps / args.hbm_gbps, 1),
-        "achieved_TFLOPs": round(tflops, 2),
-        "pct_of_flop_peak": round(100 * tflops / args.peak_tflops, 1),
-        "arithmetic_intensity_flops_per_byte": round(
-            flops_per_gen / bytes_per_gen, 3
-        ) if bytes_per_gen else None,
-        "bound": (
-            "memory"
-            if bytes_per_gen
-            and (gbps / args.hbm_gbps) > (tflops / args.peak_tflops)
-            else "compute"
-        ),
-    }
+    out = obs_xla.roofline_from_cost(
+        cost,
+        args.gen_per_sec,
+        hbm_gbps=args.hbm_gbps,
+        peak_tflops=args.peak_tflops,
+    )
     json.dump(out, sys.stdout, indent=1)
     print()
     return 0

@@ -84,6 +84,14 @@ echo "=== weak-scaling gate $(date -u +%H:%M:%S) ==="
 # floor or drifts >10% below the recorded baseline (ROADMAP item 4).
 python tools/check_scaling.py || echo "SCALING GATE FAILED rc=$?"
 
+echo "=== bench-history regression gate $(date -u +%H:%M:%S) ==="
+# Spread-aware drift detection BEFORE re-anchoring: every fresh artifact
+# is judged against the PREVIOUS sweep's anchored baselines (value under
+# the recorded min/max spread = a real regression, not noise).  FAILS the
+# log (not the sweep) like the scaling gate; the Prometheus snapshot
+# lands in bench_artifacts/ for dashboards.
+python tools/check_bench_history.py || echo "BENCH HISTORY GATE FAILED rc=$?"
+
 echo "=== regenerate BASELINE.md table $(date -u +%H:%M:%S) ==="
 # --rebaseline re-anchors BENCH_HISTORY.json to this sweep's multi-run
 # medians (old single-run values kept as previous_baseline) so future
