@@ -1128,6 +1128,64 @@ class FaultyStore(CheckpointStore):
                 ff.seek(offset)
                 ff.write(bytes([byte[0] ^ 0x01]))
 
+    def write_bytes(self, f, data):
+        # Raw-payload writes (the executable cache) share the archive
+        # write's fault surface: the save index was assigned by the
+        # open_temp that staged this temp file.
+        if self._current in self.slow_saves:
+            self._fire("slow")
+            time.sleep(self.slow_seconds)
+        if self._current in self.enospc_saves:
+            self._fire("enospc")
+            raise OSError(
+                errno.ENOSPC, "No space left on device (injected)"
+            )
+        if self._current in self.eio_saves:
+            self._fire("eio")
+            raise OSError(errno.EIO, "Input/output error (injected)")
+        super().write_bytes(f, data)
+
+    def append_record(self, f, data):
+        # Journal appends have no open_temp: each append consumes its own
+        # save index, so "the third journal record is torn" schedules the
+        # same way "the third checkpoint is torn" does.
+        with self._lock:
+            self._current = self.saves
+            self.saves += 1
+        if self._current in self.slow_saves:
+            self._fire("slow")
+            time.sleep(self.slow_seconds)
+        if self._current in self.enospc_saves:
+            self._fire("enospc")
+            # Model a disk that accepted part of the record before filling
+            # up: the torn prefix lands, then the OSError — exactly the
+            # tail the replay's checksum discipline must skip.
+            f.write(data[: max(1, len(data) // 3)])
+            raise OSError(
+                errno.ENOSPC, "No space left on device (injected)"
+            )
+        if self._current in self.eio_saves:
+            self._fire("eio")
+            raise OSError(errno.EIO, "Input/output error (injected)")
+        if self._current in self.torn_saves:
+            self._fire("torn")
+            torn = data[: max(1, int(len(data) * self.torn_fraction))]
+            f.write(torn)
+            return len(torn)
+        if self._current in self.flip_saves:
+            self._fire("flip")
+            offset = (
+                self.flip_offset
+                if self.flip_offset is not None
+                else len(data) // 2
+            ) % max(1, len(data))
+            data = (
+                data[:offset]
+                + bytes([data[offset] ^ 0x01])
+                + data[offset + 1 :]
+            )
+        return super().append_record(f, data)
+
     def unlink(self, path):
         self.unlinks.append(str(path))
         super().unlink(path)

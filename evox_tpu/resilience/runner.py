@@ -274,16 +274,9 @@ def _numbered_checkpoints(
     return sorted(out)
 
 
-def _quarantine_target(path: Path) -> Path:
-    """First free ``<name>.corrupt[.N]`` destination: quarantine must never
-    overwrite earlier evidence (a directory whose disk is eating
-    checkpoints can corrupt the *re-written* file of the same generation)."""
-    target = path.with_name(path.name + ".corrupt")
-    n = 1
-    while target.exists():
-        target = path.with_name(f"{path.name}.corrupt.{n}")
-        n += 1
-    return target
+# One shared never-overwrite-evidence quarantine naming rule (also used
+# by the executable cache and the request journal).
+from ..utils.checkpoint import quarantine_target as _quarantine_target  # noqa: E402,E501
 
 
 def scan_checkpoints(
@@ -451,6 +444,7 @@ class ResilientRunner:
         checkpoint_wall_interval: float | None = None,
         preemption: Union[PreemptionGuard, bool, None] = None,
         store: CheckpointStore | None = None,
+        exec_cache: Any | None = None,
         verify_resume: Union[bool, str] = True,
         fused: bool = True,
         fused_early_stop: bool = False,
@@ -707,6 +701,12 @@ class ResilientRunner:
 
             self.store = ReadOnlyCheckpointStore()
         self.heartbeat = heartbeat
+        # Persistent AOT executable cache (utils.ExecutableCache): segment
+        # programs survive the process, so a restarted run resumes without
+        # paying the cold XLA compile (the serving daemon's zero-cold-start
+        # plane, available to solo runners too).  Saves/loads are
+        # digest-guarded and failure-isolated inside the cache itself.
+        self.exec_cache = exec_cache or None
         self.obs = resolve_obs(obs, run_id=Path(checkpoint_dir).name)
         # Counters are monotone and (by default) process-shared: publish
         # per-run stats as deltas against this cursor, reset with stats.
@@ -771,6 +771,10 @@ class ResilientRunner:
         # signature): compiled OUTSIDE the watchdog so cold-compile latency
         # never counts against the execution deadline.
         self._exec_cache: dict = {}
+        # Persistent-cache identity salt (workflow static-config digest):
+        # recomputed lazily after every rebind, since a restart policy
+        # swapping the algorithm changes the compiled program.
+        self._exec_cache_identity: str | None = None
         # XLA's cost/memory verdict per compiled program shape, keyed by
         # (which, chunk): captured at AOT-compile time (obs/xla.py),
         # consumed at segment boundaries for the in-process roofline.
@@ -1519,13 +1523,57 @@ class ResilientRunner:
         # wall-interval EMA) and thrown away; keep them — they feed
         # ``stats.segment_timings``, the compile histogram, and the
         # ``aot-compile`` trace span.
+        pkey = None
+        if self.exec_cache is not None:
+            from ..utils.exec_cache import abstract_signature, compile_uncached
+
+            label = which if chunk is None else f"{which}[{chunk}]"
+            if self._forced_cpu:
+                label += "[cpu]"
+            # The abstract state signature covers shapes/dtypes but NOT
+            # the program itself: two workflows with identically-shaped
+            # states (same algorithm/pop/dim, different problem) would
+            # collide in a shared cache and the second would silently
+            # optimize the first's objective.  Salt the label with the
+            # workflow's static-configuration digest (recomputed after
+            # _rebind_workflow: restart policies swap the algorithm).
+            if self._exec_cache_identity is None:
+                from ..service.tenant import static_signature
+
+                self._exec_cache_identity = static_signature(
+                    self.workflow
+                )[:16]
+            label += f"[{self._exec_cache_identity}]"
+            pkey = (label, abstract_signature(state))
+            # A cache-destined compile must bypass jax's persistent
+            # compilation cache (a cache-served executable serializes to
+            # an undeserializable payload — see utils.exec_cache).
+            base_compile = compile_now
+            compile_now = lambda: compile_uncached(base_compile)  # noqa: E731
         t0 = time.perf_counter()
-        if self.compile_timeout is not None:
-            exe = self._with_deadline(
-                compile_now, self.compile_timeout, f"compile of {which}"
-            )
-        else:
-            exe = compile_now()
+        exe = None
+        if pkey is not None:
+            # The load deserializes onto the device — the same class of
+            # backend call the compile deadline exists to guard; a wedged
+            # backend must surface as a WatchdogTimeout, not a silent
+            # forever-hang that bypasses the watchdog contract.
+            load = lambda: self.exec_cache.load(*pkey)  # noqa: E731
+            if self.compile_timeout is not None:
+                exe = self._with_deadline(
+                    load, self.compile_timeout, "exec-cache load"
+                )
+            else:
+                exe = load()
+        loaded_from_cache = exe is not None
+        if exe is None:
+            if self.compile_timeout is not None:
+                exe = self._with_deadline(
+                    compile_now, self.compile_timeout, f"compile of {which}"
+                )
+            else:
+                exe = compile_now()
+            if pkey is not None:
+                self.exec_cache.save(*pkey, exe)
         t1 = time.perf_counter()
         self._last_compile_seconds += t1 - t0
         if self.obs is not None:
@@ -1544,16 +1592,24 @@ class ResilientRunner:
                 self.obs.registry, label, analysis
             )
             self.obs.record_span(
-                "aot-compile", t0, t1, which=which, chunk=chunk, **analysis
+                "aot-compile", t0, t1, which=which, chunk=chunk,
+                cached=loaded_from_cache, **analysis
             )
-            self.obs.counter(
-                "evox_runner_compiles_total",
-                "Cold AOT compiles paid by the runner.",
-            ).inc()
-            self.obs.histogram(
-                "evox_runner_segment_compile_seconds",
-                "AOT compile seconds per compiled segment program.",
-            ).observe(t1 - t0)
+            if loaded_from_cache:
+                self.obs.counter(
+                    "evox_runner_exec_cache_loads_total",
+                    "Segment programs loaded from the persistent "
+                    "executable cache instead of compiling.",
+                ).inc()
+            else:
+                self.obs.counter(
+                    "evox_runner_compiles_total",
+                    "Cold AOT compiles paid by the runner.",
+                ).inc()
+                self.obs.histogram(
+                    "evox_runner_segment_compile_seconds",
+                    "AOT compile seconds per compiled segment program.",
+                ).observe(t1 - t0)
 
         def call(s: State, _exe=exe, _traced=traced, _sig=sig) -> State:
             try:

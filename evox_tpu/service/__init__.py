@@ -14,10 +14,27 @@ The contract (pinned by ``tests/test_service.py``): a tenant's trajectory
 — final state, monitor counters, checkpoint content digests — is
 **bit-identical** whether it runs alone or packed beside cotenants that
 inject NaNs, stagnate, get evicted, or trigger restarts.
+
+:class:`ServiceDaemon` (PR 11) is the durable lifecycle around the
+service: every submission is journaled (:class:`RequestJournal` —
+crash-safe, checksummed, at-least-once replay), the packed segment
+programs persist across restarts (zero cold-start via
+:class:`~evox_tpu.utils.ExecutableCache`), and admission is SLO-aware
+(per-class budgets, load shedding with structured retry-after hints,
+brown-out cadence stretching) — kill the daemon at any point and a
+restart reconstructs the exact service state with no lost acknowledged
+work and no XLA compile on the hot path.
 """
 
+from .daemon import DaemonStats, ServiceDaemon, TenantClass
+from .journal import JournalDamage, JournalError, JournalRecord, RequestJournal
 from .pack import TenantPack, assign_fault_lane
-from .service import AdmissionError, OptimizationService, ServiceStats
+from .service import (
+    AdmissionError,
+    OptimizationService,
+    Rejection,
+    ServiceStats,
+)
 from .tenant import (
     TenantRecord,
     TenantSpec,
@@ -28,8 +45,16 @@ from .tenant import (
 
 __all__ = [
     "AdmissionError",
+    "DaemonStats",
+    "JournalDamage",
+    "JournalError",
+    "JournalRecord",
     "OptimizationService",
+    "Rejection",
+    "RequestJournal",
+    "ServiceDaemon",
     "ServiceStats",
+    "TenantClass",
     "TenantPack",
     "TenantRecord",
     "TenantSpec",

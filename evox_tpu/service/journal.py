@@ -1,0 +1,337 @@
+"""Crash-safe request journal — the service's durable source of truth.
+
+An :class:`~evox_tpu.service.OptimizationService` is in-memory: a daemon
+SIGKILLed between accepting a tenant and that tenant's first checkpoint
+forgets the submission ever happened.  The journal closes that hole.
+Every externally-visible state transition — submit, readmit, evict,
+retire, complete, preempt — is one **atomic, fsync'd, checksummed
+record** appended *before* the operation is acknowledged to the caller,
+so a restarted daemon reconstructs the exact set of live tenants by
+replaying the journal and letting each tenant's checkpoint namespace
+supply the values (the PR-8 resume machinery).  The guarantee is
+**at-least-once**: a crash can lose at most the one record whose append
+had not yet returned (the caller never got an ack for it and must
+retry), and replay is idempotent — duplicate records for a uid collapse
+onto the newest state.
+
+**Record format** (one JSON object per line, greppable and
+``jq``-friendly)::
+
+    {"body": {"seq": 12, "kind": "submit", "at": 1722..., "data": {...}},
+     "sha": "<sha256 of the canonical body JSON>"}
+
+``seq`` is strictly increasing; ``sha`` covers the canonically-encoded
+body, so a torn append (truncated line), a bit flip anywhere in the
+record, or a forged/reordered line all fail validation.  Appends are
+``flush`` + ``fsync`` per record (durability is the point; the record
+rate is bounded by admission, not by generations), and a failed append
+(``ENOSPC``) truncates the file back to the pre-append offset so the
+journal never grows an internally-torn middle.
+
+**Replay discipline** (:meth:`RequestJournal.replay`): records are
+validated in order; the FIRST invalid record ends the trusted prefix.
+Everything from that byte on is the **damaged tail** — it is quarantined
+to ``<journal>.corrupt[.N]`` (evidence, never deleted) and the journal
+file is truncated back to the last valid record, so subsequent appends
+extend a clean prefix.  Because every acknowledged record was fsync'd
+before its ack, the damaged tail can only contain unacknowledged (or
+post-crash garbage) bytes — the at-most-one-lost-record bound.
+
+Every *mutating* file operation — appends, the repair truncate, the
+quarantine-tail write — routes through the
+:class:`~evox_tpu.utils.CheckpointStore` seam, so
+``resilience.FaultyStore`` injects torn records, bit flips, and
+``ENOSPC`` mid-append deterministically (``tests/test_daemon.py``);
+replay's read is a plain file read, since damaged bytes are exactly what
+it exists to classify.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Union
+
+from ..utils.checkpoint import CheckpointStore, quarantine_target
+
+__all__ = ["RequestJournal", "JournalRecord", "JournalError", "JournalDamage"]
+
+
+class JournalError(RuntimeError):
+    """An append could not be made durable (or the journal has an unhealed
+    torn tail).  The operation it guarded must be treated as
+    unacknowledged — the caller retries or rejects upstream."""
+
+
+@dataclass
+class JournalRecord:
+    """One validated journal record."""
+
+    seq: int
+    kind: str
+    at: float
+    data: dict[str, Any]
+
+
+@dataclass
+class JournalDamage:
+    """What :meth:`RequestJournal.replay` found past the trusted prefix."""
+
+    offset: int  # byte offset the trusted prefix ends at
+    reason: str  # why the first rejected record failed validation
+    bytes_quarantined: int
+    quarantine_path: Path | None  # None when the tail could not be saved
+    truncated: bool  # whether the journal was cut back to the prefix
+
+
+def _canonical(body: dict[str, Any]) -> str:
+    return json.dumps(body, sort_keys=True, separators=(",", ":"))
+
+
+
+
+class RequestJournal:
+    """Append-only, checksummed, fsync-per-record journal.
+
+    :param path: journal file (created on first append).
+    :param store: the :class:`~evox_tpu.utils.CheckpointStore` appends,
+        truncations, and quarantine writes route through
+        (chaos-injectable; a read-only store refuses appends with
+        ``EROFS``).
+    :param durable: ``fsync`` after every record (default True — an
+        un-fsync'd ack is a lie).
+    """
+
+    def __init__(
+        self,
+        path: Union[str, Path],
+        *,
+        store: CheckpointStore | None = None,
+        durable: bool = True,
+    ):
+        self.path = Path(path)
+        self.store = store if store is not None else CheckpointStore()
+        self.durable = bool(durable)
+        self.next_seq = 0
+        self.records_appended = 0
+        self.append_failures = 0
+        self._f: Any | None = None
+        # Set when a failed append left bytes we could not truncate away:
+        # appending onto an unhealed torn middle would corrupt the clean
+        # prefix, so the journal refuses until replay() repairs the file.
+        self._dirty = False
+
+    # -- append -------------------------------------------------------------
+    def _open(self) -> Any:
+        if self._f is None:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._f = self.store.open_append(self.path)
+            if self.durable:
+                # A freshly-created journal's DIRECTORY ENTRY must survive
+                # power loss too: fsyncing the file alone persists data
+                # blocks a crashed filesystem may never link — replay
+                # would find no journal and every acked tenant would
+                # silently vanish.  Failure propagates: the caller's
+                # append is then unacknowledged, same as any append fault.
+                self.store.fsync_dir(self.path.parent)
+        return self._f
+
+    def append(self, kind: str, **data: Any) -> int:
+        """Durably append one record; returns its ``seq``.  Raises
+        :class:`JournalError` when the record could not be made durable —
+        the caller must NOT ack the operation it guards."""
+        if self._dirty:
+            raise JournalError(
+                f"journal {self.path} has an unhealed torn tail from a "
+                f"failed append; replay() repairs it"
+            )
+        body = {
+            "seq": self.next_seq,
+            "kind": str(kind),
+            "at": time.time(),
+            "data": data,
+        }
+        body_json = _canonical(body)
+        sha = hashlib.sha256(body_json.encode()).hexdigest()
+        line = (
+            '{"body":' + body_json + ',"sha":"' + sha + '"}\n'
+        ).encode()
+        try:
+            f = self._open()
+        except OSError as e:
+            # A read-only store (non-primary fleet process) or a vanished
+            # directory: the operation is unacknowledged either way.
+            self.append_failures += 1
+            raise JournalError(
+                f"journal {self.path} could not be opened for append "
+                f"({type(e).__name__}: {e}); the operation is "
+                f"unacknowledged"
+            ) from e
+        offset = f.tell()
+        try:
+            written = self.store.append_record(f, line)
+            f.flush()
+            if self.durable:
+                os.fsync(f.fileno())
+        except (OSError, RuntimeError) as e:
+            self.append_failures += 1
+            self._heal(f, offset)
+            raise JournalError(
+                f"journal append of {kind!r} record failed "
+                f"({type(e).__name__}: {e}); the operation is "
+                f"unacknowledged"
+            ) from e
+        if written != len(line):
+            # A store that silently wrote a short record (a lying disk):
+            # the on-disk tail is torn.  Cut it back — acking a torn
+            # record would break the at-most-one-lost-record bound.
+            self.append_failures += 1
+            self._heal(f, offset)
+            raise JournalError(
+                f"journal append of {kind!r} record was torn "
+                f"({written}/{len(line)} bytes); the operation is "
+                f"unacknowledged"
+            )
+        self.next_seq += 1
+        self.records_appended += 1
+        return body["seq"]
+
+    def _heal(self, f: Any, offset: int) -> None:
+        """Cut a failed append's partial bytes back off.  If even that
+        fails (the disk is gone), poison the journal: future appends
+        refuse instead of extending garbage."""
+        try:
+            f.flush()
+        except OSError:
+            pass
+        try:
+            os.ftruncate(f.fileno(), offset)
+        except OSError:
+            self._dirty = True
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+
+    # -- replay -------------------------------------------------------------
+    def replay(
+        self, *, quarantine: bool = True
+    ) -> tuple[list[JournalRecord], JournalDamage | None]:
+        """Validate the journal and return ``(records, damage)``.
+
+        ``records`` is the trusted prefix — every record whose checksum
+        and sequence check out, in order.  On the first invalid record the
+        rest of the file is the damaged tail: with ``quarantine=True`` it
+        is saved to ``<journal>.corrupt[.N]`` and the journal is truncated
+        back to the trusted prefix (both route through the store; a
+        read-only store leaves the file untouched and only reports).
+        ``damage`` is ``None`` for a clean journal.  Also primes
+        ``next_seq`` so subsequent appends continue the sequence."""
+        self.close()
+        try:
+            raw = self.path.read_bytes()
+        except FileNotFoundError:
+            self.next_seq = 0
+            return [], None
+        records: list[JournalRecord] = []
+        offset = 0
+        reason: str | None = None
+        expected_seq = 0
+        while offset < len(raw):
+            nl = raw.find(b"\n", offset)
+            if nl < 0:
+                reason = "truncated record (no terminating newline)"
+                break
+            line = raw[offset : nl + 1]
+            try:
+                obj = json.loads(line)
+                body = obj["body"]
+                sha = obj["sha"]
+            except (
+                json.JSONDecodeError,
+                UnicodeDecodeError,
+                KeyError,
+                TypeError,
+            ) as e:
+                reason = f"unparseable record ({type(e).__name__})"
+                break
+            actual = hashlib.sha256(_canonical(body).encode()).hexdigest()
+            if actual != sha:
+                reason = (
+                    f"checksum mismatch (recorded {str(sha)[:12]}…, "
+                    f"recomputed {actual[:12]}…) — bit flip or tamper"
+                )
+                break
+            try:
+                seq = int(body["seq"])
+                kind = str(body["kind"])
+                at = float(body.get("at", 0.0))
+                data = dict(body.get("data") or {})
+            except (KeyError, TypeError, ValueError) as e:
+                reason = f"malformed record body ({type(e).__name__})"
+                break
+            if seq != expected_seq:
+                reason = (
+                    f"sequence break (expected seq {expected_seq}, "
+                    f"found {seq}) — reordered or spliced records"
+                )
+                break
+            records.append(JournalRecord(seq=seq, kind=kind, at=at, data=data))
+            expected_seq = seq + 1
+            offset = nl + 1
+        self.next_seq = expected_seq
+        if reason is None:
+            self._dirty = False
+            return records, None
+        tail = raw[offset:]
+        qpath: Path | None = None
+        truncated = False
+        if quarantine:
+            qpath = self._quarantine_tail(tail)
+            try:
+                self.store.truncate(self.path, offset)
+                truncated = True
+            except OSError:
+                pass
+        # Appends may only resume once the damaged tail is actually gone:
+        # with quarantine=False (or a failed truncate — read-only store,
+        # vanished file) an append would extend the garbage and the NEXT
+        # replay would cut the acked record away with it, breaking the
+        # at-most-one-lost-record bound.
+        self._dirty = not truncated
+        return records, JournalDamage(
+            offset=offset,
+            reason=reason,
+            bytes_quarantined=len(tail),
+            quarantine_path=qpath,
+            truncated=truncated,
+        )
+
+    def _quarantine_tail(self, tail: bytes) -> Path | None:
+        """Save the damaged tail as evidence (atomic, via the store);
+        failure to save must not block the repair — report ``None``."""
+        target = quarantine_target(self.path)
+        try:
+            fd, tmp = self.store.open_temp(
+                self.path.parent, target.name + ".tmp."
+            )
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    self.store.write_bytes(f, tail)
+                self.store.publish(tmp, target)
+            except BaseException:
+                try:
+                    self.store.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except (OSError, RuntimeError):
+            return None
+        return target

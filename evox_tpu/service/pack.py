@@ -145,6 +145,18 @@ class TenantPack:
         self._init_meta: list = []
         self._jit_init = jax.jit(self._init_program)
         self._jit_segment = jax.jit(self._vmapped_segment, static_argnums=2)
+        # AOT executables installed by prewarm(): {n_steps: callable} for
+        # the vmapped segment, plus the single-lane init program.  When
+        # present they are dispatched instead of the jit path — a restart
+        # that pre-warmed from the persistent executable cache never pays
+        # an XLA compile for them.
+        self._aot_segment: dict[int, Any] = {}
+        self._aot_init: Any | None = None
+        # Provenance of each installed program (True = loaded from the
+        # persistent cache): a re-prewarm reporting an already-installed
+        # program must repeat where it ACTUALLY came from, not claim a
+        # cache hit for an in-process compile.
+        self._aot_from_cache: dict[Any, bool] = {}
 
     def _init_program(self, state: State):
         new_state, ys = self.workflow._traced_capture_step(
@@ -156,6 +168,126 @@ class TenantPack:
         return jax.vmap(
             lambda s, f: self.workflow._segment_program(s, n, self.cfg, f)
         )(states, frozen)
+
+    # -- zero cold-start ----------------------------------------------------
+    def prewarm(
+        self,
+        example_state: State,
+        n_steps: int | Sequence[int],
+        *,
+        cache: Any | None = None,
+        label: str = "bucket",
+    ) -> dict[str, bool]:
+        """AOT-compile the pack's programs ahead of the first admission —
+        or load them from a persistent
+        :class:`~evox_tpu.utils.ExecutableCache` without compiling at all.
+
+        ``example_state`` is one *pre-init* tenant-shaped workflow state
+        (what the service's ``_fresh_state`` builds — values are
+        irrelevant, only shapes/dtypes key the programs).  The whole pass
+        is **abstract**: post-init shapes come from ``jax.eval_shape``
+        over the init program (which also captures the init sink
+        metadata the telemetry demux needs — abstract evaluation runs no
+        device code and, unlike ``jit.lower``, emits no compile-log
+        event), and the stacked segment signature is built from
+        ``ShapeDtypeStruct`` leaves.  On a cache hit nothing is lowered
+        or compiled at all; on a miss the program is lowered, compiled
+        once, and persisted.  The loaded/compiled executables are
+        installed so :meth:`run_segment` / :meth:`init_tenant` dispatch
+        through them — on a warm restart no pack program traces OR
+        compiles (``CompileSentinel``-verified by
+        ``tools/bench_daemon.py``).
+
+        Returns ``{program_label: loaded_from_cache}``.
+        """
+        from ..utils.exec_cache import abstract_signature
+
+        lengths = (
+            [int(n_steps)]
+            if isinstance(n_steps, int)
+            else sorted({int(n) for n in n_steps})
+        )
+        results: dict[str, bool] = {}
+        init_label = f"pack_init[{label}][lanes={self.lanes}]"
+        # Abstract init pass: post-init shapes for the segment signature
+        # AND the trace-time capture of the init sink metadata (meta is
+        # identical under abstract evaluation — it records static site
+        # identities, not values).
+        post_init, _ = jax.eval_shape(self._init_program, example_state)
+        if self._aot_init is None:
+            sig = abstract_signature(example_state)
+            # Lowering happens lazily INSIDE the miss path, so a cache
+            # hit traces/compiles nothing (get_or_compile wraps the miss
+            # in compile_uncached — see utils.exec_cache).
+            compile_init = lambda: (  # noqa: E731
+                self._jit_init.lower(example_state).compile()
+            )
+            if cache is None:
+                exe, hit = compile_init(), False
+            else:
+                exe, hit = cache.get_or_compile(
+                    init_label, sig, compile_init
+                )
+            self._aot_init = exe
+            self._aot_from_cache["init"] = hit
+            results[init_label] = hit
+        else:
+            results[init_label] = self._aot_from_cache.get("init", False)
+
+        def stack_sds(leaf):
+            return jax.ShapeDtypeStruct(
+                (self.lanes,) + tuple(leaf.shape), leaf.dtype
+            )
+
+        stacked = jax.tree_util.tree_map(stack_sds, post_init)
+        frozen = jax.ShapeDtypeStruct((self.lanes,), jnp.bool_)
+        for n in lengths:
+            if n < 1:
+                raise ValueError(f"n_steps must be >= 1, got {n}")
+            seg_label = f"pack_segment[{label}][lanes={self.lanes}][n={n}]"
+            if n in self._aot_segment:
+                results[seg_label] = self._aot_from_cache.get(n, False)
+                continue
+            sig = abstract_signature(stacked, frozen)
+            compile_seg = lambda n=n: (  # noqa: E731
+                self._jit_segment.lower(stacked, frozen, n).compile()
+            )
+            if cache is None:
+                exe, hit = compile_seg(), False
+            else:
+                exe, hit = cache.get_or_compile(seg_label, sig, compile_seg)
+            self._aot_segment[n] = exe
+            self._aot_from_cache[n] = hit
+            results[seg_label] = hit
+        return results
+
+    def _dispatch_segment(
+        self, states: State, frozen: jax.Array, n: int
+    ):
+        exe = self._aot_segment.get(n)
+        if exe is None:
+            return self._jit_segment(states, frozen, n)
+        try:
+            return exe(states, frozen)
+        except (ValueError, TypeError) as e:
+            # AOT executables are strict about input placement/layout
+            # (same contract as ResilientRunner._get_executable's call
+            # wrapper); fall back to traced dispatch, which re-places.
+            if "sharding" in str(e).lower() or "layout" in str(e).lower():
+                del self._aot_segment[n]
+                return self._jit_segment(states, frozen, n)
+            raise
+
+    def _dispatch_init(self, state: State):
+        if self._aot_init is None:
+            return self._jit_init(state)
+        try:
+            return self._aot_init(state)
+        except (ValueError, TypeError) as e:
+            if "sharding" in str(e).lower() or "layout" in str(e).lower():
+                self._aot_init = None
+                return self._jit_init(state)
+            raise
 
     # -- occupancy ----------------------------------------------------------
     @property
@@ -193,7 +325,7 @@ class TenantPack:
         they feed straight into ``EvalMonitor.ingest_sinks`` (the caller
         routes them to the admitted tenant's monitor; a template build
         just drops them)."""
-        new_state, ys = self._jit_init(state)
+        new_state, ys = self._dispatch_init(state)
         sinks = [
             tuple(np.asarray(x)[None] for x in site)
             for site in jax.device_get(ys)
@@ -276,7 +408,7 @@ class TenantPack:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
         if self._states is None:
             raise RuntimeError("pack has no admitted tenants")
-        states, telemetry = self._jit_segment(
+        states, telemetry = self._dispatch_segment(
             self._states, jnp.asarray(self._frozen), int(n_steps)
         )
         self._states = states

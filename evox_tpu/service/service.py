@@ -71,18 +71,68 @@ from ..workflows import EvalMonitor, StdWorkflow
 from .pack import TenantPack, assign_fault_lane
 from .tenant import TenantRecord, TenantSpec, TenantStatus, bucket_key
 
-__all__ = ["OptimizationService", "AdmissionError", "ServiceStats"]
+__all__ = [
+    "OptimizationService",
+    "AdmissionError",
+    "ServiceStats",
+    "Rejection",
+]
 
 
 class AdmissionError(RuntimeError):
     """A submission was refused.  ``reason`` is the structured cause — the
-    bounded queue is full, the tenant id collides with a live tenant, or
-    the spec is unusable.  Overload rejection is the contract: beyond its
-    bounds the service refuses loudly instead of degrading everyone."""
+    bounded queue is full (``"queue-full"``), the tenant id collides with
+    a live tenant, the spec is unusable, or the serving daemon shed the
+    request under overload (``"shed"``).  Overload rejection is the
+    contract: beyond its bounds the service refuses loudly instead of
+    degrading everyone.
 
-    def __init__(self, message: str, *, reason: str):
+    :ivar reason: machine-readable reject code.
+    :ivar retry_after_segments: when set, the scheduler's estimate (in
+        segment boundaries — the service's scheduling quantum) of when
+        capacity should free up; a client that waits this many boundary
+        intervals before retrying lands on the first likely-free slot
+        instead of hammering the queue.  ``None`` for rejects that a
+        retry cannot fix (id/uid collisions)."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        reason: str,
+        retry_after_segments: int | None = None,
+    ):
         super().__init__(message)
         self.reason = reason
+        self.retry_after_segments = (
+            None if retry_after_segments is None else int(retry_after_segments)
+        )
+
+
+class Rejection(tuple):
+    """One refused submission: a ``(tenant_id, reason)`` pair (tuple-
+    compatible with every pre-existing consumer) carrying the structured
+    ``retry_after_segments`` hint as an attribute — so
+    ``stats.rejections`` records exactly what the raised
+    :class:`AdmissionError` told the caller."""
+
+    retry_after_segments: int | None
+
+    def __new__(
+        cls,
+        tenant_id: str,
+        reason: str,
+        retry_after_segments: int | None = None,
+    ):
+        self = super().__new__(cls, (tenant_id, reason))
+        self.retry_after_segments = retry_after_segments
+        return self
+
+    def __getnewargs__(self):
+        # tuple's default reduce passes the tuple CONTENTS to __new__,
+        # which does not match this signature — without this, pickling
+        # (fleet transport of ServiceStats) and deepcopy raise TypeError.
+        return (self[0], self[1], self.retry_after_segments)
 
 
 @dataclass
@@ -93,7 +143,7 @@ class ServiceStats:
     admitted: int = 0
     completed: int = 0
     segments_run: int = 0
-    rejections: list[tuple[str, str]] = field(default_factory=list)
+    rejections: list[Rejection] = field(default_factory=list)
     quarantines: int = 0
     restarts: int = 0
     evictions: int = 0
@@ -309,11 +359,13 @@ class OptimizationService:
                 f"forget() to retire the record before reusing the id",
             )
         if len(self._queue) >= self.max_queue:
+            hint = self.retry_hint_segments()
             return self._reject(
                 spec,
                 "queue-full",
                 f"admission queue is at its bound ({self.max_queue}); "
-                f"retry after tenants retire",
+                f"retry after ~{hint} segment boundaries",
+                retry_after_segments=hint,
             )
         if existing is not None:
             if spec.uid is not None and spec.uid != existing.uid:
@@ -372,8 +424,17 @@ class OptimizationService:
         self._queue.append(spec.tenant_id)
         return record
 
-    def _reject(self, spec: TenantSpec, reason: str, detail: str):
-        self.stats.rejections.append((spec.tenant_id, reason))
+    def _reject(
+        self,
+        spec: TenantSpec,
+        reason: str,
+        detail: str,
+        *,
+        retry_after_segments: int | None = None,
+    ):
+        self.stats.rejections.append(
+            Rejection(spec.tenant_id, reason, retry_after_segments)
+        )
         self._inc(
             "evox_service_rejections_total",
             "Submissions refused, by structured reason.",
@@ -389,7 +450,20 @@ class OptimizationService:
             f"submission of tenant {spec.tenant_id!r} refused "
             f"({reason}): {detail}",
             reason=reason,
+            retry_after_segments=retry_after_segments,
         )
+
+    def retry_hint_segments(self) -> int:
+        """Scheduler estimate of how many segment boundaries until a lane
+        frees: the nearest running tenant's remaining whole segments (1
+        when nothing is running — the next round admits directly).  The
+        structured ``retry_after_segments`` hint on overload rejections."""
+        remaining = [
+            -(-max(0, r.spec.n_steps - r.generations) // self.segment_steps)
+            for r in self._tenants.values()
+            if r.status is TenantStatus.RUNNING
+        ]
+        return max(1, min(remaining)) if remaining else 1
 
     # -- tenant accessors ---------------------------------------------------
     def tenant(self, tenant_id: str) -> TenantRecord:
@@ -438,6 +512,43 @@ class OptimizationService:
             # churn must not grow the registry (and every snapshot /
             # heartbeat payload) without bound.
             self.obs.registry.remove_labeled("tenant_id", tenant_id)
+
+    def withdraw(
+        self, tenant_id: str, *, to_status: TenantStatus | None = None
+    ) -> None:
+        """Remove a QUEUED tenant from the admission queue before it ever
+        occupies a lane.
+
+        With ``to_status=None`` (default) the record is dropped entirely —
+        the un-admit the serving daemon uses when a submission's journal
+        record could not be made durable (an acked-but-unjournaled tenant
+        would be silently lost by a crash).  With
+        ``to_status=TenantStatus.EVICTED`` the record is kept parked
+        (resumable from its namespace via a later :meth:`submit`) — the
+        replay path for tenants whose journaled state is "evicted"."""
+        record = self._tenants.get(tenant_id)
+        if record is None or record.status is not TenantStatus.QUEUED:
+            raise RuntimeError(
+                f"tenant {tenant_id!r} is not QUEUED"
+                + (
+                    f" (status {record.status.value})"
+                    if record is not None
+                    else " (unknown id)"
+                )
+            )
+        self._queue = [t for t in self._queue if t != tenant_id]
+        if to_status is not None:
+            record.status = to_status
+            self._note(record, f"withdrawn from queue ({to_status.value})")
+            return
+        self._templates.pop((record.bucket, record.uid), None)
+        self._tenants_by_uid.pop(record.uid, None)
+        del self._tenants[tenant_id]
+        if record.flight is not None and self.obs is not None:
+            self.obs.bus.remove_sink(record.flight)
+        if self.obs is not None:
+            self.obs.registry.remove_labeled("tenant_id", tenant_id)
+        self._note(record, "withdrawn from queue (record dropped)")
 
     # -- checkpoint namespaces ----------------------------------------------
     def namespace(self, tenant_id: str) -> Path:

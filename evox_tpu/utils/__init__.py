@@ -30,6 +30,12 @@ from .checkpoint import (
     save_state,
     verify_checkpoint,
 )
+from .exec_cache import (
+    ExecCacheStats,
+    ExecutableCache,
+    abstract_signature,
+    enable_xla_compilation_cache,
+)
 from .params_vector import ParamsAndVector
 from .vmap_ops import VmapInfo, host_op, register_vmap_op
 
@@ -59,6 +65,10 @@ __all__ = [
     "CheckpointStore",
     "ReadOnlyCheckpointStore",
     "AsyncCheckpointWriter",
+    "ExecutableCache",
+    "ExecCacheStats",
+    "abstract_signature",
+    "enable_xla_compilation_cache",
     "register_vmap_op",
     "host_op",
     "VmapInfo",

@@ -73,6 +73,7 @@ __all__ = [
     "load_state",
     "read_manifest",
     "verify_checkpoint",
+    "quarantine_target",
     "CheckpointError",
     "CheckpointCorruptError",
     "CheckpointStore",
@@ -158,6 +159,33 @@ class CheckpointStore:
         """Move a file aside (the resume scan's ``*.corrupt`` quarantine)."""
         os.replace(src, dst)
 
+    def write_bytes(self, f: Any, data: bytes) -> None:
+        """Write a raw byte payload into the open binary file object ``f``
+        (non-archive checkpoint artifacts: the persistent executable
+        cache's serialized programs).  Same fault surface as
+        :meth:`write_archive` — ``FaultyStore`` injects ``ENOSPC``/``EIO``
+        /slow-disk here too."""
+        f.write(data)
+
+    def open_append(self, path: Union[str, Path]) -> Any:
+        """Open ``path`` for appending (the service journal's record
+        stream).  Returns an open binary file object the caller owns."""
+        return open(path, "ab")
+
+    def append_record(self, f: Any, data: bytes) -> int:
+        """Append one framed journal record's bytes to the open file
+        object ``f``; returns the byte count written.  The seam the
+        journal's torn-record / bit-flip / ``ENOSPC``-mid-append chaos
+        (``FaultyStore``) injects through — each call counts as one save
+        attempt on the fault schedule."""
+        f.write(data)
+        return len(data)
+
+    def truncate(self, path: Union[str, Path], size: int) -> None:
+        """Cut ``path`` back to ``size`` bytes (the journal replay's
+        damaged-tail repair)."""
+        os.truncate(path, size)
+
 
 class ReadOnlyCheckpointStore(CheckpointStore):
     """A store that refuses every mutating operation — the non-primary side
@@ -192,6 +220,12 @@ class ReadOnlyCheckpointStore(CheckpointStore):
     def open_temp(self, directory, prefix):
         raise self._refuse("write")
 
+    def open_append(self, path):
+        raise self._refuse(f"append to {path}")
+
+    def truncate(self, path, size):
+        raise self._refuse(f"truncate of {path}")
+
     def publish(self, tmp, final):
         raise self._refuse("publish")
 
@@ -203,6 +237,20 @@ class ReadOnlyCheckpointStore(CheckpointStore):
 
 
 _DEFAULT_STORE = CheckpointStore()
+
+
+def quarantine_target(path: Path) -> Path:
+    """First free ``<name>.corrupt[.N]`` destination: quarantine must
+    never overwrite earlier evidence (a disk that is eating files can
+    corrupt the re-written file of the same name).  One definition shared
+    by the checkpoint resume scan, the executable cache, and the request
+    journal."""
+    target = path.with_name(path.name + ".corrupt")
+    n = 1
+    while target.exists():
+        target = path.with_name(f"{path.name}.corrupt.{n}")
+        n += 1
+    return target
 
 
 def _path_str(key_path) -> str:

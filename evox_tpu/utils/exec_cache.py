@@ -1,0 +1,498 @@
+"""Persistent AOT executable cache — zero cold-start restarts.
+
+A daemon restart (or a new process admitting a tenant into a known bucket)
+today pays a full XLA compile per ``(algorithm, pop, dim, segment length)``
+program shape before the first generation steps — seconds to minutes of
+cold start that the serving layer's SLO cannot absorb.  This module
+persists the compiled artifact itself: :class:`ExecutableCache` stores the
+output of ``jax.experimental.serialize_executable.serialize`` (the
+serialized XLA executable plus its input/output pytree defs) keyed by a
+digest of the *program identity* — a caller label, the abstract signature
+of the inputs (treedef + per-leaf shape/dtype), and the environment
+fingerprint (jax version, backend, device kind/count, process count).  A
+later process with the same identity loads and runs the executable without
+ever invoking the compiler; tracing (cheap) still happens so host-side
+trace artifacts (captured sink metadata) stay populated.
+
+**Nothing loaded is trusted.**  Every entry is a self-describing file —
+magic, header JSON (format, key material, payload SHA-256), payload — and
+the load path verifies all of it: a truncated/bit-flipped/unpicklable
+entry, *or* an entry whose recorded environment no longer matches (new jax
+version, different device kind or count — the "wrong topology" case), is
+**quarantined** to ``*.corrupt`` (never deleted, never silently reused)
+and reported as a miss, so the caller recompiles.  The
+``resilience.FaultyStore`` chaos schedule applies to every *mutating*
+file operation — temp staging, payload write, publish, quarantine rename
+route through the :class:`~evox_tpu.utils.CheckpointStore` seam — and
+saves are atomic (temp + ``fsync`` + ``os.replace``) with the same
+torn-write discipline as checkpoints.  (Entry *reads* are plain file
+reads: a failed or damaged read is already a handled miss by
+construction, so there is nothing for chaos to prove there.)
+
+The XLA compilation cache (``jax.config.jax_compilation_cache_dir``) is
+complementary: it dedups compilations *within* jax's own dispatch path
+(covering the eager ops and probe scans this cache does not), while this
+cache eliminates the compile call entirely for the known hot programs.
+:func:`enable_xla_compilation_cache` wires it with serving-friendly
+thresholds.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import time
+import warnings
+from pathlib import Path
+from typing import Any, Callable, Union
+
+import jax
+
+from .checkpoint import CheckpointStore
+
+__all__ = [
+    "ExecutableCache",
+    "ExecCacheStats",
+    "abstract_signature",
+    "compile_uncached",
+    "enable_xla_compilation_cache",
+]
+
+_MAGIC = b"EVOXEXEC"
+_FORMAT = 1
+# Header struct: magic (8s) + header-JSON byte length (<I).
+_HEADER = struct.Struct("<8sI")
+
+
+def abstract_signature(*args: Any) -> tuple:
+    """Hashable abstract identity of a call's inputs: every leaf's key
+    path plus its ``(shape, dtype)``.  Two calls with equal signatures
+    lower to the same program (given the same callable), so the signature
+    — not the values — keys the cache.
+
+    Key paths, not ``str(treedef)``: treedef reprs embed ``frozenset``
+    aux data whose iteration order is hash-randomized **across
+    processes**, and a cache whose keys change per process never hits on
+    the restart it exists for.  Key paths (dict keys, attr names, child
+    indices) are deterministic."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(args)
+    return tuple(
+        (
+            jax.tree_util.keystr(path),
+            tuple(getattr(l, "shape", ()) or ()),
+            str(getattr(l, "dtype", type(l).__name__)),
+        )
+        for path, l in leaves
+    )
+
+
+def compile_uncached(compile_fn: Callable[[], Any]) -> Any:
+    """Run one compile with jax's persistent compilation cache bypassed.
+
+    An executable *served* from the XLA disk cache serializes to an
+    incomplete payload on the CPU backend — ``deserialize_and_load``
+    later fails with ``Symbols not found`` — so a program destined for
+    the executable cache must be compiled for real.  Two subtleties:
+
+    * flipping ``jax_enable_compilation_cache`` alone is NOT enough —
+      ``compilation_cache.is_cache_used`` latches its verdict at the
+      process's first compile, so the flag flip must be paired with a
+      ``reset_cache()`` (and the latch restored after);
+    * this is belt to the braces of save-time validation in
+      :meth:`ExecutableCache.save` — if the private reset API drifts,
+      the validation still keeps a broken payload from ever being
+      published.
+
+    Known limitation: the flip is process-global, not thread-scoped.  A
+    compile abandoned mid-body by a watchdog deadline leaves the
+    compilation cache disabled until the hung compile eventually
+    finishes and the ``finally`` restores it — degraded caching for the
+    interim, never a correctness issue (save-time validation still
+    rejects any cache-served payload)."""
+    try:
+        from jax._src import compilation_cache as cc
+
+        enabled = bool(jax.config.jax_enable_compilation_cache)
+    except (ImportError, AttributeError):  # pragma: no cover - API drift
+        return compile_fn()
+    if not enabled:
+        return compile_fn()
+    try:
+        jax.config.update("jax_enable_compilation_cache", False)
+        # Drop the latched is-cache-used verdict so the flip takes effect
+        # even after earlier compiles initialized the cache.
+        cc.reset_cache()
+        return compile_fn()
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+        # Un-latch again so the restored flag is honored by later
+        # compiles too.
+        try:
+            cc.reset_cache()
+        except Exception:  # pragma: no cover - teardown safety
+            pass
+
+
+def _environment_fingerprint() -> dict[str, Any]:
+    """What must match for a serialized executable to be loadable AND
+    correct: compiler version, backend, and the device world it was
+    compiled against.  A mismatch is the "stale / wrong topology" case —
+    the entry is quarantined, never trusted."""
+    devices = jax.devices()
+    return {
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "none",
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+    }
+
+
+class ExecCacheStats:
+    """Counters of what the cache did (mirrored into the metrics registry
+    when one is attached)."""
+
+    def __init__(self) -> None:
+        self.hits = 0
+        self.misses = 0
+        self.saves = 0
+        self.save_failures = 0
+        self.quarantines = 0
+        # (path, reason) per quarantined entry — evidence, like
+        # ``RunStats.checkpoint_skips``.
+        self.quarantined: list[tuple[Path, str]] = []
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"ExecCacheStats(hits={self.hits}, misses={self.misses}, "
+            f"saves={self.saves}, save_failures={self.save_failures}, "
+            f"quarantines={self.quarantines})"
+        )
+
+
+from .checkpoint import quarantine_target as _quarantine_target
+
+
+class ExecutableCache:
+    """Digest-guarded persistent store of serialized XLA executables.
+
+    Usage (what :class:`~evox_tpu.service.TenantPack` and
+    :class:`~evox_tpu.resilience.ResilientRunner` do internally)::
+
+        cache = ExecutableCache("svc_root/exec_cache")
+        lowered = jax.jit(fn).lower(*args)           # tracing: always
+        sig = abstract_signature(*args)
+        exe = cache.load("segment[16]", sig)
+        if exe is None:                              # cold: compile once
+            exe = lowered.compile()
+            cache.save("segment[16]", sig, exe)
+        out = exe(*call_args)
+
+    :param directory: cache directory (created on first save).
+    :param store: the :class:`~evox_tpu.utils.CheckpointStore` every file
+        operation routes through (``FaultyStore`` chaos-injectable).
+    :param durable: fsync entries on publish (default True — the cache
+        exists to survive the process).
+    :param on_event: optional one-line event callback (quarantines, save
+        failures); defaults to ``warnings.warn`` for quarantines.
+    :param registry: optional duck-typed
+        :class:`~evox_tpu.obs.MetricsRegistry`; feeds
+        ``evox_exec_cache_{hits,misses,saves,quarantines}_total``.
+    """
+
+    def __init__(
+        self,
+        directory: Union[str, Path],
+        *,
+        store: CheckpointStore | None = None,
+        durable: bool = True,
+        on_event: Callable[[str], None] | None = None,
+        registry: Any | None = None,
+    ):
+        self.directory = Path(directory)
+        self.store = store if store is not None else CheckpointStore()
+        self.durable = bool(durable)
+        self.on_event = on_event
+        self.registry = registry
+        self.stats = ExecCacheStats()
+
+    # -- events / metrics ---------------------------------------------------
+    def _event(self, msg: str, *, warn: bool = False) -> None:
+        if self.on_event is not None:
+            self.on_event(msg)
+        elif warn:
+            warnings.warn(msg)
+
+    def _inc(self, name: str, help: str) -> None:
+        if self.registry is None:
+            return
+        try:
+            self.registry.counter(name, help).inc()
+        except Exception:  # pragma: no cover - broken registry
+            pass
+
+    # -- keying -------------------------------------------------------------
+    def _key_material(self, label: str, signature: Any) -> dict[str, Any]:
+        material = dict(_environment_fingerprint())
+        material["label"] = str(label)
+        material["signature"] = hashlib.sha256(
+            repr(signature).encode()
+        ).hexdigest()
+        material["evox_tpu_version"] = _library_version()
+        return material
+
+    def entry_path(self, label: str, signature: Any) -> Path:
+        """Deterministic file path of the entry for ``(label, signature)``
+        in the current environment."""
+        material = self._key_material(label, signature)
+        digest = hashlib.sha256(
+            json.dumps(material, sort_keys=True).encode()
+        ).hexdigest()
+        return self.directory / f"exe_{digest[:32]}.jaxexe"
+
+    # -- quarantine ---------------------------------------------------------
+    def _quarantine(self, path: Path, reason: str) -> None:
+        self.stats.quarantines += 1
+        self.stats.quarantined.append((path, reason))
+        self._inc(
+            "evox_exec_cache_quarantines_total",
+            "Executable-cache entries quarantined as *.corrupt.",
+        )
+        renamed = ""
+        try:
+            self.store.rename(path, _quarantine_target(path))
+            renamed = " (quarantined)"
+        except OSError:  # racing cleaners / read-only store
+            pass
+        self._event(
+            f"exec cache rejected {path.name}: {reason}{renamed}; "
+            f"recompiling",
+            warn=True,
+        )
+
+    # -- load ---------------------------------------------------------------
+    def load(self, label: str, signature: Any) -> Callable | None:
+        """The deserialized, loaded executable for ``(label, signature)``,
+        or ``None`` (miss).  Corrupt, stale, or wrong-topology entries are
+        quarantined ``*.corrupt`` and reported as misses — a cache entry
+        is never trusted past its digests."""
+        path = self.entry_path(label, signature)
+        try:
+            blob = path.read_bytes()
+        except FileNotFoundError:
+            self.stats.misses += 1
+            self._inc(
+                "evox_exec_cache_misses_total",
+                "Executable-cache lookups that had to compile.",
+            )
+            return None
+        except OSError as e:
+            self.stats.misses += 1
+            self._event(
+                f"exec cache could not read {path.name} ({e}); recompiling",
+                warn=True,
+            )
+            return None
+        exe = self._decode(path, blob, label, signature)
+        if exe is None:
+            self.stats.misses += 1
+            self._inc(
+                "evox_exec_cache_misses_total",
+                "Executable-cache lookups that had to compile.",
+            )
+            return None
+        self.stats.hits += 1
+        self._inc(
+            "evox_exec_cache_hits_total",
+            "Executable-cache lookups served without a compile.",
+        )
+        return exe
+
+    def _decode(
+        self, path: Path, blob: bytes, label: str, signature: Any
+    ) -> Callable | None:
+        if len(blob) < _HEADER.size:
+            self._quarantine(path, "truncated header")
+            return None
+        magic, header_len = _HEADER.unpack_from(blob)
+        if magic != _MAGIC:
+            self._quarantine(path, "bad magic — not an exec-cache entry")
+            return None
+        header_end = _HEADER.size + header_len
+        if len(blob) < header_end:
+            self._quarantine(path, "truncated header JSON")
+            return None
+        try:
+            header = json.loads(blob[_HEADER.size : header_end])
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            self._quarantine(path, "unparseable header JSON")
+            return None
+        if header.get("format") != _FORMAT:
+            self._quarantine(
+                path, f"unknown entry format {header.get('format')!r}"
+            )
+            return None
+        payload = blob[header_end:]
+        actual = hashlib.sha256(payload).hexdigest()
+        if actual != header.get("payload_sha256"):
+            self._quarantine(
+                path,
+                f"payload digest mismatch (recorded "
+                f"{str(header.get('payload_sha256'))[:12]}…, recomputed "
+                f"{actual[:12]}…) — bit rot or torn write",
+            )
+            return None
+        # Digest-clean: now gate on key material.  The file name already
+        # encodes the digest of the CURRENT environment's material, so a
+        # stale entry is normally unreachable — but a renamed/copied file,
+        # or an entry written by a buggy/malicious producer, must still be
+        # refused by content, not by file name.
+        expected = self._key_material(label, signature)
+        recorded = header.get("key", {})
+        if recorded != expected:
+            diff = sorted(
+                k
+                for k in set(expected) | set(recorded)
+                if expected.get(k) != recorded.get(k)
+            )
+            self._quarantine(
+                path,
+                f"stale entry: key material differs on {diff} (e.g. "
+                f"compiled for a different jax version or device "
+                f"topology)",
+            )
+            return None
+        try:
+            from jax.experimental import serialize_executable as se
+
+            serialized, in_tree, out_tree = pickle.loads(payload)
+            return se.deserialize_and_load(serialized, in_tree, out_tree)
+        except Exception as e:  # noqa: BLE001 - any load failure → recompile
+            self._quarantine(
+                path, f"deserialization failed ({type(e).__name__}: {e})"
+            )
+            return None
+
+    # -- save ---------------------------------------------------------------
+    def save(self, label: str, signature: Any, compiled: Any) -> Path | None:
+        """Serialize and atomically publish one compiled executable.
+        Failures (unserializable executable, ``ENOSPC``, torn store) are
+        events, not aborts — the caller already holds the live executable
+        and a later restart simply recompiles.  Returns the published path
+        or ``None``."""
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload = pickle.dumps(se.serialize(compiled))
+            # Trust nothing, including our own serialization: prove the
+            # payload round-trips BEFORE publishing it (an executable
+            # served from the XLA disk cache serializes to bytes that
+            # fail deserialization with "Symbols not found"; publishing
+            # those would turn every restart into a quarantine+recompile).
+            se.deserialize_and_load(*pickle.loads(payload))
+        except Exception as e:  # noqa: BLE001 - backend without support
+            self.stats.save_failures += 1
+            self._event(
+                f"exec cache could not serialize {label!r} "
+                f"({type(e).__name__}: {e}); restarts will recompile",
+                warn=True,
+            )
+            return None
+        header = {
+            "format": _FORMAT,
+            "key": self._key_material(label, signature),
+            "payload_sha256": hashlib.sha256(payload).hexdigest(),
+            "created_at": time.time(),
+        }
+        header_json = json.dumps(header, sort_keys=True).encode()
+        blob = _HEADER.pack(_MAGIC, len(header_json)) + header_json + payload
+        path = self.entry_path(label, signature)
+        try:
+            self.directory.mkdir(parents=True, exist_ok=True)
+            fd, tmp = self.store.open_temp(
+                self.directory, path.name + ".tmp."
+            )
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    self.store.write_bytes(f, blob)
+                    if self.durable:
+                        self.store.fsync_file(f)
+                self.store.publish(tmp, path)
+                if self.durable:
+                    self.store.fsync_dir(self.directory)
+            except BaseException:
+                try:
+                    self.store.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except (OSError, RuntimeError) as e:
+            self.stats.save_failures += 1
+            self._inc(
+                "evox_exec_cache_save_failures_total",
+                "Executable-cache publishes that failed.",
+            )
+            self._event(
+                f"exec cache write of {path.name} failed "
+                f"({type(e).__name__}: {e}); restarts will recompile",
+                warn=True,
+            )
+            return None
+        self.stats.saves += 1
+        self._inc(
+            "evox_exec_cache_saves_total",
+            "Executables durably published to the cache.",
+        )
+        return path
+
+    def get_or_compile(
+        self, label: str, signature: Any, compile_fn: Callable[[], Any]
+    ) -> tuple[Callable, bool]:
+        """One-stop lookup: returns ``(executable, was_cached)``.  On a
+        miss, ``compile_fn()`` pays the compile and the result is saved
+        for the next process."""
+        exe = self.load(label, signature)
+        if exe is not None:
+            return exe, True
+        exe = compile_uncached(compile_fn)
+        self.save(label, signature, exe)
+        return exe, False
+
+
+def _library_version() -> str:
+    try:
+        import evox_tpu
+
+        return evox_tpu.__version__
+    except Exception:  # pragma: no cover - stripped install
+        return "unknown"
+
+
+def enable_xla_compilation_cache(
+    directory: Union[str, Path],
+    *,
+    min_compile_time_secs: float = 0.0,
+) -> bool:
+    """Point jax's own persistent compilation cache at ``directory`` with
+    serving-friendly thresholds (cache everything, however small/fast).
+    Complementary to :class:`ExecutableCache` — it catches the long tail
+    of programs nobody pre-warms (eager lane surgery, probe scans).
+    Returns whether the configuration took; unsupported jax builds and
+    backends degrade to ``False`` without raising."""
+    try:
+        Path(directory).mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(directory))
+    except Exception:  # pragma: no cover - stripped build
+        return False
+    for name, value in (
+        ("jax_persistent_cache_min_compile_time_secs", min_compile_time_secs),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(name, value)
+        except Exception:  # pragma: no cover - older/newer config surface
+            pass
+    return True

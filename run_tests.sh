@@ -52,6 +52,21 @@
 #                               per-tenant gen/s (artifact under
 #                               bench_artifacts/).  Runs under a HARD
 #                               wall-clock timeout like --multihost.
+#   ./run_tests.sh --serve      durable serving daemon lane: crash-safe
+#                               request journal (torn/bit-flip/ENOSPC
+#                               chaos through the CheckpointStore seam),
+#                               kill-at-every-boundary restart matrix
+#                               (bit-identical incl. checkpoint digests),
+#                               executable-cache integrity (corrupt/stale
+#                               entries quarantined), SLO admission
+#                               (shed with retry-after, brown-out), and
+#                               the 64-tenant kill-restart acceptance —
+#                               then tools/bench_daemon.py: the
+#                               CompileSentinel-verified zero-compile
+#                               warm-restart gate and the 90% overload
+#                               retention gate (artifacts under
+#                               bench_artifacts/).  Runs under a HARD
+#                               wall-clock timeout like --multihost.
 #   ./run_tests.sh --obs        observability lane: the obs-plane suite
 #                               (event-bus ordering + JSONL rotation,
 #                               registry snapshot vs a real faulty run's
@@ -139,6 +154,15 @@ if [ "$1" = "--service" ]; then
     tests/test_service.py tests/test_preemption.py -q "$@" || exit 1
   exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_service.py
 fi
+if [ "$1" = "--serve" ]; then
+  shift
+  # Hard timeout (SIGKILL escalation), same pattern as --multihost: a
+  # wedged restart replay or a stuck subprocess child must fail loudly.
+  SERVE_TIMEOUT="${EVOX_TPU_SERVE_TIMEOUT:-1500}"
+  timeout -k 30 "$SERVE_TIMEOUT" \
+    "${CPU_ENV[@]}" python -m pytest tests/test_daemon.py -q "$@" || exit 1
+  exec timeout -k 30 900 "${CPU_ENV[@]}" python tools/bench_daemon.py
+fi
 if [ "$1" = "--obs" ]; then
   shift
   # Hard timeout (SIGKILL escalation), same pattern as --multihost: the
@@ -151,9 +175,12 @@ if [ "$1" = "--obs" ]; then
   # No observability call site may land inside compiled scope: the full
   # graftlint sweep (GL002 et al.) must stay clean against its baselines.
   python -m tools.graftlint || exit 1
-  # Perf-regression analytics, report-only: a CPU container holds no
-  # TPU-anchored rows to gate, but the join must stay runnable.
-  python tools/check_bench_history.py --report-only || exit 1
+  # Perf-regression analytics as a REAL gate (ROADMAP item 5 carry-over):
+  # exit is nonzero iff a TPU-anchored baseline regressed.  CPU-provisional
+  # rows still report without gating (the tool's default), so CPU
+  # containers — which hold no comparable TPU-anchored rows — pass
+  # vacuously while a TPU box running this lane gates for real.
+  python tools/check_bench_history.py || exit 1
   exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_obs_overhead.py
 fi
 if [ "$1" = "--multihost" ]; then

@@ -1,0 +1,1101 @@
+"""Durable serving daemon tests: crash-safe journal, zero cold-start
+executable cache, SLO-aware admission, and kill-at-every-boundary restart.
+
+The headline suite is the **kill-restart matrix** (acceptance): a daemon
+SIGKILLed at each lifecycle point — post-submit/pre-journal-ack,
+post-ack/pre-admit, mid-run, post-checkpoint — restarts from journal +
+namespaces + executable cache with every tenant's final state and
+checkpoint leaf digests bit-identical to an uninterrupted daemon.  SIGKILL
+is modelled as *abandonment*: the daemon object is dropped without any
+shutdown path running (exactly what SIGKILL guarantees — no handler, no
+flush, no destructor), and a fresh daemon is built over the same root.
+Around it: journal chaos (torn record, single-bit flip, ENOSPC
+mid-append, spliced sequences — ``FaultyStore``-driven through the
+``CheckpointStore`` seam), executable-cache integrity (corrupt/stale
+entries quarantined ``*.corrupt``, never trusted), SLO admission
+(per-class budgets, shed with structured retry-after, brown-out cadence
+stretch), and the ``AdmissionError.retry_after_segments`` satellite.
+"""
+
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.algorithms import PSO
+from evox_tpu.problems.numerical import Ackley
+from evox_tpu.resilience import FaultyStore, Preempted
+from evox_tpu.service import (
+    AdmissionError,
+    JournalError,
+    Rejection,
+    RequestJournal,
+    ServiceDaemon,
+    TenantClass,
+    TenantSpec,
+    TenantStatus,
+)
+from evox_tpu.utils import ExecutableCache, abstract_signature
+from evox_tpu.utils.checkpoint import ReadOnlyCheckpointStore, read_manifest
+
+DIM = 4
+POP = 8
+LB = jnp.full((DIM,), -32.0)
+UB = jnp.full((DIM,), 32.0)
+
+
+def pso_spec(name, uid, n_steps=12):
+    return TenantSpec(name, PSO(POP, LB, UB), Ackley(), n_steps=n_steps, uid=uid)
+
+
+# One executable cache shared by every daemon in this module: the tests
+# reuse a handful of bucket shapes, so the first daemon compiles each
+# program once and every later construction deserializes in milliseconds
+# — which both keeps the tier-1 lane inside its wall-clock budget and
+# exercises the cache's cross-instance path constantly.  Tests probing
+# cache behavior itself override ``exec_cache`` (``True`` = a private
+# root-local cache, ``None`` = no persistence).
+_SHARED = {"cache": None}
+
+
+def shared_cache():
+    if _SHARED["cache"] is None:
+        import tempfile
+
+        _SHARED["cache"] = ExecutableCache(
+            os.path.join(tempfile.mkdtemp(prefix="evox_daemon_test_"), "exec")
+        )
+    return _SHARED["cache"]
+
+
+def make_daemon(root, **overrides):
+    kwargs = dict(
+        lanes_per_pack=4,
+        segment_steps=4,
+        seed=0,
+        preemption=False,
+        brownout_threshold=None,
+        exec_cache=shared_cache(),
+    )
+    kwargs.update(overrides)
+    if kwargs["exec_cache"] is True:
+        del kwargs["exec_cache"]  # ServiceDaemon default: root-local cache
+    return ServiceDaemon(root, **kwargs)
+
+
+def _npify(x):
+    if isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key
+    ):
+        return np.asarray(jax.random.key_data(x))
+    return np.asarray(x)
+
+
+def assert_states_equal(a, b, context=""):
+    leaves_a = jax.tree_util.tree_leaves_with_path(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    for (path, la), lb_ in zip(leaves_a, leaves_b):
+        assert np.array_equal(_npify(la), _npify(lb_)), (
+            f"{context}: leaf {jax.tree_util.keystr(path)} differs"
+        )
+
+
+def last_checkpoint_digests(root, tenant_id):
+    ns = os.path.join(root, "tenants", tenant_id)
+    newest = sorted(f for f in os.listdir(ns) if f.endswith(".npz"))[-1]
+    manifest = read_manifest(os.path.join(ns, newest))
+    return newest, manifest["leaf_digests"]
+
+
+def run_silently(daemon, *args, **kwargs):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        daemon.run(*args, **kwargs)
+
+
+def silent(fn, *args, **kwargs):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return fn(*args, **kwargs)
+
+
+# -- journal: append / replay / chaos ---------------------------------------
+
+
+def test_journal_roundtrip_and_sequence_continuation(tmp_path):
+    j = RequestJournal(tmp_path / "j.jsonl")
+    assert j.append("submit", tenant_id="a", uid=0) == 0
+    assert j.append("evict", tenant_id="a", uid=0) == 1
+    j.close()
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    records, damage = j2.replay()
+    assert damage is None
+    assert [(r.seq, r.kind) for r in records] == [(0, "submit"), (1, "evict")]
+    assert records[0].data == {"tenant_id": "a", "uid": 0}
+    # Sequence continues where the replay left off.
+    assert j2.append("retire", tenant_id="a", uid=0) == 2
+
+
+def test_journal_torn_tail_quarantined_and_truncated(tmp_path):
+    j = RequestJournal(tmp_path / "j.jsonl")
+    for i in range(3):
+        j.append("submit", uid=i)
+    j.close()
+    # Crash mid-append: a partial record with no newline at the tail.
+    with open(tmp_path / "j.jsonl", "ab") as f:
+        f.write(b'{"body":{"seq":3,"kind":"subm')
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    records, damage = j2.replay()
+    assert len(records) == 3  # the acked prefix survives in full
+    assert damage is not None and damage.truncated
+    assert damage.quarantine_path is not None
+    assert damage.quarantine_path.exists()
+    assert damage.bytes_quarantined > 0
+    # The repaired journal accepts appends and replays clean.
+    assert j2.append("submit", uid=3) == 3
+    j2.close()
+    records, damage = RequestJournal(tmp_path / "j.jsonl").replay()
+    assert damage is None and len(records) == 4
+
+
+def test_journal_bit_flip_ends_trusted_prefix(tmp_path):
+    j = RequestJournal(tmp_path / "j.jsonl")
+    for i in range(4):
+        j.append("submit", uid=i, tenant_id=f"tenant-{i}")
+    j.close()
+    raw = bytearray((tmp_path / "j.jsonl").read_bytes())
+    # Flip one bit inside the THIRD record (a value character, so the
+    # line stays parseable and the checksum is what catches it).
+    lines = raw.split(b"\n")
+    target = lines[2]
+    offset = target.find(b"tenant-2") + 3  # inside the data value
+    lines[2] = (
+        target[:offset]
+        + bytes([target[offset] ^ 0x01])
+        + target[offset + 1 :]
+    )
+    (tmp_path / "j.jsonl").write_bytes(b"\n".join(lines))
+    records, damage = RequestJournal(tmp_path / "j.jsonl").replay()
+    assert len(records) == 2  # everything before the flip is trusted
+    assert damage is not None
+    assert (
+        "checksum mismatch" in damage.reason
+        or "unparseable" in damage.reason
+    )
+    assert damage.quarantine_path is not None and damage.truncated
+
+
+def test_journal_sequence_splice_detected(tmp_path):
+    j = RequestJournal(tmp_path / "j.jsonl")
+    for i in range(3):
+        j.append("submit", uid=i)
+    j.close()
+    raw = (tmp_path / "j.jsonl").read_bytes()
+    lines = raw.splitlines(keepends=True)
+    # Drop the middle record: seqs 0,2 — a reordered/spliced journal.
+    (tmp_path / "j.jsonl").write_bytes(lines[0] + lines[2])
+    records, damage = RequestJournal(tmp_path / "j.jsonl").replay()
+    assert len(records) == 1
+    assert damage is not None and "sequence break" in damage.reason
+
+
+def test_journal_enospc_mid_append_heals_and_retries(tmp_path):
+    store = FaultyStore(enospc_saves=[1])
+    j = RequestJournal(tmp_path / "j.jsonl", store=store)
+    j.append("submit", uid=0)
+    with pytest.raises(JournalError):
+        j.append("submit", uid=1)  # ENOSPC: torn prefix hits the disk
+    assert j.append_failures == 1
+    # The failed append was truncated away in-process: the retry lands
+    # cleanly and the file replays with no damage at all.
+    assert j.append("submit", uid=1) == 1
+    j.close()
+    records, damage = RequestJournal(tmp_path / "j.jsonl").replay()
+    assert damage is None
+    assert [r.data["uid"] for r in records] == [0, 1]
+
+
+def test_journal_torn_append_raises_and_restart_loses_only_unacked(tmp_path):
+    store = FaultyStore(torn_saves=[1])
+    j = RequestJournal(tmp_path / "j.jsonl", store=store)
+    j.append("submit", uid=0)
+    with pytest.raises(JournalError, match="torn"):
+        j.append("submit", uid=1)  # short write: unacked
+    j.close()
+    # Restart: only the unacked record is gone.
+    records, damage = RequestJournal(tmp_path / "j.jsonl").replay()
+    assert [r.data["uid"] for r in records] == [0]
+    assert damage is None  # the in-process heal already cut the torn tail
+
+
+def test_journal_readonly_store_refuses_appends(tmp_path):
+    j = RequestJournal(
+        tmp_path / "j.jsonl", store=ReadOnlyCheckpointStore()
+    )
+    with pytest.raises(JournalError):
+        j.append("submit", uid=0)
+
+
+# -- executable cache: integrity --------------------------------------------
+
+
+def _toy_executable():
+    return jax.jit(lambda x: x * 2 + 1).lower(jnp.ones((4,)))
+
+
+def test_exec_cache_roundtrip_across_instances(tmp_path):
+    cache = ExecutableCache(tmp_path / "exec")
+    sig = abstract_signature(jnp.ones((4,)))
+    assert cache.load("toy", sig) is None
+    exe, hit = cache.get_or_compile("toy", sig, _toy_executable().compile)
+    assert not hit and cache.stats.saves == 1
+    # A fresh instance (= a restarted process's view of the directory).
+    cache2 = ExecutableCache(tmp_path / "exec")
+    loaded = cache2.load("toy", sig)
+    assert loaded is not None and cache2.stats.hits == 1
+    np.testing.assert_array_equal(
+        np.asarray(loaded(jnp.ones((4,)))), np.asarray([3.0] * 4)
+    )
+
+
+def test_exec_cache_corrupt_entry_quarantined_never_trusted(tmp_path):
+    cache = ExecutableCache(tmp_path / "exec")
+    sig = abstract_signature(jnp.ones((4,)))
+    cache.get_or_compile("toy", sig, _toy_executable().compile)
+    path = cache.entry_path("toy", sig)
+    blob = bytearray(path.read_bytes())
+    blob[-20] ^= 0x01  # single-bit flip in the serialized executable
+    path.write_bytes(bytes(blob))
+    cache2 = ExecutableCache(tmp_path / "exec")
+    assert silent(cache2.load, "toy", sig) is None
+    assert cache2.stats.quarantines == 1
+    assert "digest mismatch" in cache2.stats.quarantined[0][1]
+    assert (path.parent / (path.name + ".corrupt")).exists()
+    assert not path.exists()
+    # A re-save then works (recompile path), and quarantine evidence from
+    # the first corruption is never overwritten.
+    cache2.get_or_compile("toy", sig, _toy_executable().compile)
+    assert cache2.load("toy", sig) is not None
+
+
+def test_exec_cache_truncated_entry_quarantined(tmp_path):
+    cache = ExecutableCache(tmp_path / "exec")
+    sig = abstract_signature(jnp.ones((4,)))
+    cache.get_or_compile("toy", sig, _toy_executable().compile)
+    path = cache.entry_path("toy", sig)
+    path.write_bytes(path.read_bytes()[:40])  # torn write survivor
+    cache2 = ExecutableCache(tmp_path / "exec")
+    assert silent(cache2.load, "toy", sig) is None
+    assert cache2.stats.quarantines == 1
+
+
+def test_exec_cache_stale_key_material_quarantined(tmp_path, monkeypatch):
+    """An entry whose recorded environment no longer matches (a different
+    jax version / device topology) is quarantined, not loaded."""
+    cache = ExecutableCache(tmp_path / "exec")
+    sig = abstract_signature(jnp.ones((4,)))
+    cache.get_or_compile("toy", sig, _toy_executable().compile)
+    path = cache.entry_path("toy", sig)
+    blob = path.read_bytes()
+    # Simulate "the environment changed since this entry was written" by
+    # changing what the CURRENT process claims about itself — the entry
+    # on disk now records a stale world.
+    from evox_tpu.utils import exec_cache as ec
+
+    real = ec._environment_fingerprint()
+    monkeypatch.setattr(
+        ec,
+        "_environment_fingerprint",
+        lambda: {**real, "device_count": real["device_count"] + 8},
+    )
+    cache2 = ExecutableCache(tmp_path / "exec")
+    # The new fingerprint keys a different path; plant the stale entry
+    # there to prove content (not file name) is what gates the load.
+    cache2.entry_path("toy", sig).write_bytes(blob)
+    assert silent(cache2.load, "toy", sig) is None
+    assert cache2.stats.quarantines == 1
+    assert "stale entry" in cache2.stats.quarantined[0][1]
+
+
+def test_exec_cache_save_failure_is_event_not_abort(tmp_path):
+    store = FaultyStore(enospc_saves=[0])
+    cache = ExecutableCache(tmp_path / "exec", store=store)
+    sig = abstract_signature(jnp.ones((4,)))
+    exe, hit = silent(
+        cache.get_or_compile, "toy", sig, _toy_executable().compile
+    )
+    assert not hit and cache.stats.save_failures == 1
+    # The live executable still works; nothing was published.
+    np.testing.assert_array_equal(
+        np.asarray(exe(jnp.ones((4,)))), np.asarray([3.0] * 4)
+    )
+    assert cache.load("toy", sig) is None  # nothing was published
+
+
+# -- admission: retry-after satellite, shed, classes, brown-out --------------
+
+
+def test_queue_full_rejection_carries_retry_after_hint(tmp_path):
+    daemon = make_daemon(
+        tmp_path / "svc",
+        max_queue=1,
+        classes=[TenantClass("standard", 99, sheddable=False)],
+    )
+    daemon.start()
+    daemon.submit(pso_spec("a", 0))
+    with pytest.raises(AdmissionError) as exc_info:
+        silent(daemon.submit, pso_spec("b", 1))
+    err = exc_info.value
+    assert err.reason == "queue-full"
+    assert isinstance(err.retry_after_segments, int)
+    assert err.retry_after_segments >= 1
+    # stats.rejections records the hint AND stays tuple-compatible.
+    rej = daemon.service.stats.rejections[-1]
+    assert rej == ("b", "queue-full")
+    assert isinstance(rej, Rejection)
+    assert rej.retry_after_segments == err.retry_after_segments
+
+
+def test_class_budget_shed_with_structured_retry_after(tmp_path):
+    daemon = make_daemon(
+        tmp_path / "svc",
+        lanes_per_pack=2,
+        classes=[
+            TenantClass("standard", 2),
+            TenantClass("batch", 1),
+        ],
+    )
+    daemon.start()
+    daemon.submit(pso_spec("s0", 0))
+    daemon.submit(pso_spec("s1", 1))
+    daemon.submit(pso_spec("b0", 2), tenant_class="batch")
+    with pytest.raises(AdmissionError) as exc_info:
+        silent(daemon.submit, pso_spec("b1", 3), tenant_class="batch")
+    err = exc_info.value
+    assert err.reason == "shed"
+    assert err.retry_after_segments >= 1
+    assert daemon.stats.sheds == 1
+    assert ("b1", "shed") in daemon.service.stats.rejections
+    # The standard class is at ITS budget too: sheds independently.
+    with pytest.raises(AdmissionError, match="shed"):
+        silent(daemon.submit, pso_spec("s2", 4))
+
+
+def test_class_budget_counts_only_that_class(tmp_path):
+    daemon = make_daemon(
+        tmp_path / "svc",
+        classes=[TenantClass("standard", 1), TenantClass("bulk", 1)],
+    )
+    daemon.start()
+    daemon.submit(pso_spec("s0", 0))
+    # A different class has its own budget: not shed by standard's depth.
+    daemon.submit(pso_spec("k0", 1), tenant_class="bulk")
+    with pytest.raises(AdmissionError, match="shed"):
+        silent(daemon.submit, pso_spec("s1", 2))
+
+
+def test_unknown_class_rejected(tmp_path):
+    daemon = make_daemon(tmp_path / "svc")
+    daemon.start()
+    with pytest.raises(AdmissionError) as exc_info:
+        silent(daemon.submit, pso_spec("a", 0), tenant_class="gold")
+    assert exc_info.value.reason == "unknown-class"
+
+
+def test_journal_failure_unadmits_submission(tmp_path):
+    """An acked-but-unjournaled tenant would be silently lost by a crash:
+    when the journal append fails, the submission is withdrawn and the
+    caller told — the ack and the record are one atom."""
+    # Save index 0 is the submit record's append (journal appends count
+    # on the same FaultyStore schedule as checkpoint saves).
+    store = FaultyStore(enospc_saves=[0])
+    daemon = make_daemon(tmp_path / "svc", store=store, exec_cache=None)
+    daemon.start()
+    with pytest.raises(AdmissionError) as exc_info:
+        silent(daemon.submit, pso_spec("a", 0))
+    assert exc_info.value.reason == "journal-failed"
+    # Fully un-admitted: no record, no queue entry.
+    with pytest.raises(KeyError):
+        daemon.tenant("a")
+    assert daemon.service._queue == []
+    # A restart sees an empty journal: nothing replays.
+    daemon2 = make_daemon(tmp_path / "svc", exec_cache=None)
+    assert daemon2.start() == 0
+
+
+def test_journal_failure_on_readmission_parks_existing_record(tmp_path):
+    """A failed journal append on a READMISSION must not delete the
+    pre-existing tenant record: its journaled history and namespace
+    describe a real tenant — it goes back to EVICTED (parked)."""
+    from evox_tpu.utils.checkpoint import CheckpointStore
+
+    class FlakyAppends(CheckpointStore):
+        fail_next = False
+
+        def append_record(self, f, data):
+            if self.fail_next:
+                FlakyAppends.fail_next = False
+                raise OSError(28, "No space left on device (injected)")
+            return super().append_record(f, data)
+
+    store = FlakyAppends()
+    daemon = make_daemon(
+        tmp_path / "svc", store=store, exec_cache=None
+    )
+    daemon.start()
+    daemon.submit(pso_spec("t", 0, n_steps=20))
+    run_silently(daemon, max_rounds=1)
+    daemon.evict("t")
+    FlakyAppends.fail_next = True
+    with pytest.raises(AdmissionError, match="journal-failed"):
+        silent(daemon.submit, pso_spec("t", 0, n_steps=20))
+    record = daemon.tenant("t")  # record survives ...
+    assert record.status is TenantStatus.EVICTED  # ... parked, not queued
+    # A clean retry resumes it to completion.
+    daemon.submit(pso_spec("t", 0, n_steps=20))
+    run_silently(daemon)
+    assert daemon.tenant("t").status is TenantStatus.COMPLETED
+
+
+def test_duplicate_id_rejected_as_collision_not_shed(tmp_path):
+    """A duplicate of a live id is non-retryable: it must surface as
+    id-collision even when the class budget is exhausted (a client
+    honoring a 'shed' retry hint would wait and re-collide forever)."""
+    daemon = make_daemon(
+        tmp_path / "svc", classes=[TenantClass("standard", 1)]
+    )
+    daemon.start()
+    daemon.submit(pso_spec("a", 0))  # queued; class budget now full
+    with pytest.raises(AdmissionError) as exc_info:
+        silent(daemon.submit, pso_spec("a", 0))
+    assert exc_info.value.reason == "id-collision"
+
+
+def test_journal_fsyncs_directory_on_creation(tmp_path):
+    """The journal's directory entry must be made durable with its first
+    record — fsyncing only the file leaves a freshly-created journal
+    un-linked after power loss."""
+    from evox_tpu.utils.checkpoint import CheckpointStore
+
+    class Recorder(CheckpointStore):
+        dirs = []
+
+        def fsync_dir(self, directory):
+            Recorder.dirs.append(str(directory))
+            super().fsync_dir(directory)
+
+    Recorder.dirs = []
+    j = RequestJournal(tmp_path / "deep" / "j.jsonl", store=Recorder())
+    j.append("submit", uid=0)
+    assert str(tmp_path / "deep") in Recorder.dirs
+    j.close()
+
+
+def test_prewarm_reports_true_provenance_on_reruns(tmp_path):
+    """A re-prewarm must report where an installed program ACTUALLY came
+    from — an in-process compile re-reported as a cache hit would fake
+    the zero-cold-start telemetry."""
+    from evox_tpu.service import TenantPack
+    from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+    wf = StdWorkflow(
+        PSO(POP, LB, UB), Ackley(), monitor=EvalMonitor(ordered=False)
+    )
+    pack = TenantPack(wf, 2)
+    key = jax.random.key(0)
+    ak, pk, mk = jax.random.split(key, 3)
+    from evox_tpu.core import State
+
+    state = State(
+        algorithm=wf.algorithm.setup(ak),
+        problem=wf.problem.setup(pk),
+        monitor=wf.monitor.setup(mk),
+    )
+    first = pack.prewarm(state, 4, cache=None)
+    assert all(v is False for v in first.values())
+    # Second pass adds a cadence; already-installed programs must still
+    # report compiled-in-process, not "from cache".
+    second = pack.prewarm(state, [4, 8], cache=None)
+    assert all(v is False for v in second.values())
+
+
+def test_brownout_stretches_cadence_then_recovers(tmp_path):
+    daemon = make_daemon(
+        tmp_path / "svc",
+        lanes_per_pack=2,
+        max_queue=4,
+        brownout_threshold=0.5,
+        brownout_factor=2,
+        classes=[TenantClass("standard", 99)],
+    )
+    daemon.start()
+    for i in range(4):
+        daemon.submit(pso_spec(f"t{i}", i, n_steps=8))
+    # 4 tenants queued (2 lanes): pressure 4/4 >= 0.5 at the round start.
+    silent(daemon.step)
+    assert daemon.brownout
+    assert daemon.service.segment_steps == 8  # 4 * factor 2
+    assert daemon.stats.brownout_entries == 1
+    run_silently(daemon)
+    # Drained: pressure 0 <= threshold/2 — cadence restored.
+    assert not daemon.brownout
+    assert daemon.service.segment_steps == 4
+    assert daemon.stats.brownout_exits == 1
+    for i in range(4):
+        assert daemon.tenant(f"t{i}").status is TenantStatus.COMPLETED
+
+
+# -- kill-at-every-boundary restart matrix (acceptance) ----------------------
+
+
+N_TENANTS = 3
+
+
+def _reference_results(tmp_path, n_steps=12):
+    ref = make_daemon(tmp_path / "ref")
+    ref.start()
+    for i in range(N_TENANTS):
+        ref.submit(pso_spec(f"t{i}", i, n_steps=n_steps))
+    run_silently(ref)
+    return {
+        f"t{i}": ref.result(f"t{i}") for i in range(N_TENANTS)
+    }, {
+        f"t{i}": last_checkpoint_digests(tmp_path / "ref", f"t{i}")
+        for i in range(N_TENANTS)
+    }
+
+
+@pytest.mark.parametrize(
+    "kill_point",
+    [
+        "post-submit-pre-journal-ack",
+        "post-ack-pre-admit",
+        "mid-run",
+        "post-checkpoint",
+    ],
+)
+def test_kill_restart_bit_identical(tmp_path, kill_point):
+    """SIGKILL (modelled as abandonment — no shutdown code runs) at each
+    lifecycle point; the restarted daemon finishes every tenant
+    bit-identical to an uninterrupted one, including checkpoint leaf
+    digests."""
+    expected, expected_digests = _reference_results(tmp_path)
+    root = tmp_path / "killed"
+    resubmit_after_restart = []
+    if kill_point == "post-submit-pre-journal-ack":
+        # The LAST tenant's journal append dies after the service accepted
+        # it: the submission is unacked (the caller sees the failure) and
+        # a crash right there loses exactly that one record.  The client
+        # contract for an unacked submit is retry-after-restart.
+        # (exec_cache=None keeps the FaultyStore save schedule counting
+        # journal appends only.)
+        store = FaultyStore(enospc_saves=[N_TENANTS - 1])
+        daemon = make_daemon(root, store=store, exec_cache=None)
+        daemon.start()
+        for i in range(N_TENANTS - 1):
+            daemon.submit(pso_spec(f"t{i}", i))
+        with pytest.raises(AdmissionError):
+            silent(daemon.submit, pso_spec(f"t{N_TENANTS-1}", N_TENANTS - 1))
+        resubmit_after_restart = [N_TENANTS - 1]
+    elif kill_point == "post-ack-pre-admit":
+        daemon = make_daemon(root)
+        daemon.start()
+        for i in range(N_TENANTS):
+            daemon.submit(pso_spec(f"t{i}", i))
+        # killed before any scheduling round ran
+    elif kill_point == "mid-run":
+        daemon = make_daemon(root)
+        daemon.start()
+        for i in range(N_TENANTS):
+            daemon.submit(pso_spec(f"t{i}", i))
+        run_silently(daemon, max_rounds=1)
+    else:  # post-checkpoint
+        daemon = make_daemon(root)
+        daemon.start()
+        for i in range(N_TENANTS):
+            daemon.submit(pso_spec(f"t{i}", i))
+        run_silently(daemon, max_rounds=2)
+    del daemon  # SIGKILL: nothing else runs
+
+    restarted = make_daemon(root)
+    restored = silent(restarted.start)
+    assert restored == N_TENANTS - len(resubmit_after_restart)
+    for i in resubmit_after_restart:
+        restarted.submit(pso_spec(f"t{i}", i))
+    run_silently(restarted)
+    for i in range(N_TENANTS):
+        tid = f"t{i}"
+        assert restarted.tenant(tid).status is TenantStatus.COMPLETED
+        assert_states_equal(
+            expected[tid], restarted.result(tid), f"{kill_point}: {tid}"
+        )
+        name, digests = last_checkpoint_digests(root, tid)
+        assert (name, digests) == expected_digests[tid], (
+            f"{kill_point}: {tid} final checkpoint digests differ"
+        )
+
+
+def test_restart_after_completion_materializes_results_without_lanes(
+    tmp_path,
+):
+    expected, _ = _reference_results(tmp_path)
+    root = tmp_path / "done"
+    daemon = make_daemon(root)
+    daemon.start()
+    for i in range(N_TENANTS):
+        daemon.submit(pso_spec(f"t{i}", i))
+    run_silently(daemon)
+    del daemon  # killed after everything completed
+
+    restarted = make_daemon(root)
+    restarted.start()
+    run_silently(restarted)
+    for i in range(N_TENANTS):
+        tid = f"t{i}"
+        record = restarted.tenant(tid)
+        assert record.status is TenantStatus.COMPLETED
+        assert record.lane is None  # completed at admission, no lane burned
+        assert_states_equal(expected[tid], restarted.result(tid), tid)
+
+
+def test_restart_replays_through_damaged_journal_tail(tmp_path):
+    """A daemon crash can tear the journal mid-record; the restart must
+    quarantine the tail and still restore every acked tenant."""
+    expected, _ = _reference_results(tmp_path)
+    root = tmp_path / "torn"
+    daemon = make_daemon(root)
+    daemon.start()
+    for i in range(N_TENANTS):
+        daemon.submit(pso_spec(f"t{i}", i))
+    run_silently(daemon, max_rounds=1)
+    del daemon
+    # The crash tore a record mid-append.
+    with open(root / ServiceDaemon.JOURNAL_NAME, "ab") as f:
+        f.write(b'{"body":{"seq":99,"kind":"co')
+    restarted = make_daemon(root)
+    assert silent(restarted.start) == N_TENANTS
+    assert len(restarted.stats.journal_damage) == 1
+    run_silently(restarted)
+    for i in range(N_TENANTS):
+        assert_states_equal(
+            expected[f"t{i}"], restarted.result(f"t{i}"), f"t{i}"
+        )
+
+
+def test_evict_is_durable_restart_parks_not_resumes(tmp_path):
+    root = tmp_path / "svc"
+    daemon = make_daemon(root)
+    daemon.start()
+    daemon.submit(pso_spec("keep", 0, n_steps=20))
+    daemon.submit(pso_spec("parked", 1, n_steps=20))
+    run_silently(daemon, max_rounds=1)
+    daemon.evict("parked")
+    del daemon
+
+    restarted = make_daemon(root)
+    silent(restarted.start)
+    assert restarted.tenant("parked").status is TenantStatus.EVICTED
+    run_silently(restarted)
+    assert restarted.tenant("keep").status is TenantStatus.COMPLETED
+    assert restarted.tenant("parked").status is TenantStatus.EVICTED
+    # Readmission (a fresh submit of the same id) resumes it.
+    restarted.submit(pso_spec("parked", 1, n_steps=20))
+    run_silently(restarted)
+    assert restarted.tenant("parked").status is TenantStatus.COMPLETED
+
+
+def test_forget_is_durable_restart_drops_record(tmp_path):
+    root = tmp_path / "svc"
+    daemon = make_daemon(root)
+    daemon.start()
+    daemon.submit(pso_spec("a", 0))
+    daemon.submit(pso_spec("b", 1))
+    run_silently(daemon)
+    daemon.forget("a")
+    del daemon
+
+    restarted = make_daemon(root)
+    silent(restarted.start)
+    run_silently(restarted)
+    with pytest.raises(KeyError):
+        restarted.tenant("a")
+    assert restarted.tenant("b").status is TenantStatus.COMPLETED
+
+
+def test_preempted_daemon_journals_and_restart_resumes(tmp_path):
+    expected, _ = _reference_results(tmp_path, n_steps=16)
+    root = tmp_path / "svc"
+    # A caller-owned guard: a service-owned one (preemption=True) is
+    # deliberately reset at every run() start, which would erase this
+    # test's manual trip.
+    from evox_tpu.resilience import PreemptionGuard
+
+    guard = PreemptionGuard()
+    daemon = make_daemon(root, preemption=guard)
+    daemon.start()
+    for i in range(N_TENANTS):
+        daemon.submit(pso_spec(f"t{i}", i, n_steps=16))
+    run_silently(daemon, max_rounds=1)
+    guard.trip("maintenance")
+    with pytest.raises(Preempted):
+        run_silently(daemon)
+    records, _ = RequestJournal(root / ServiceDaemon.JOURNAL_NAME).replay()
+    assert any(r.kind == "preempt" for r in records)
+    del daemon
+
+    restarted = make_daemon(root, preemption=False)
+    assert silent(restarted.start) == N_TENANTS
+    run_silently(restarted)
+    for i in range(N_TENANTS):
+        tid = f"t{i}"
+        state = restarted.result(tid)
+        # Bit-identical minus the preemption counter the emergency
+        # checkpoint bumped into the saved state.
+        ref_leaves = jax.tree_util.tree_leaves_with_path(expected[tid])
+        got_leaves = jax.tree_util.tree_leaves(state)
+        for (path, la), lb_ in zip(ref_leaves, got_leaves):
+            key = jax.tree_util.keystr(path)
+            if "num_preemptions" in key:
+                continue
+            assert np.array_equal(_npify(la), _npify(lb_)), (
+                f"{tid}: leaf {key} differs"
+            )
+
+
+# -- journal chaos through a running daemon ----------------------------------
+
+
+def test_daemon_survives_torn_journal_record_chaos(tmp_path):
+    """FaultyStore tears a submit record's append mid-run: that submission
+    is unacked (lost), every other tenant survives kill+restart."""
+    expected, _ = _reference_results(tmp_path)
+    root = tmp_path / "svc"
+    store = FaultyStore(torn_saves=[1])  # second journal append tears
+    daemon = make_daemon(root, store=store, exec_cache=None)
+    daemon.start()
+    daemon.submit(pso_spec("t0", 0))
+    with pytest.raises(AdmissionError):
+        silent(daemon.submit, pso_spec("t1", 1))
+    daemon.submit(pso_spec("t2", 2))
+    del daemon  # crash
+
+    restarted = make_daemon(root)
+    assert silent(restarted.start) == 2  # t0 and t2; t1 was never acked
+    restarted.submit(pso_spec("t1", 1))  # client retries the unacked one
+    run_silently(restarted)
+    for i in range(N_TENANTS):
+        assert_states_equal(
+            expected[f"t{i}"], restarted.result(f"t{i}"), f"t{i}"
+        )
+
+
+# -- zero cold-start ---------------------------------------------------------
+
+
+def test_warm_restart_loads_every_pack_program_from_cache(tmp_path):
+    root = tmp_path / "svc"
+    daemon = make_daemon(root, exec_cache=True)  # private root-local cache
+    daemon.start()
+    for i in range(2):
+        daemon.submit(pso_spec(f"t{i}", i, n_steps=16))
+    run_silently(daemon, max_rounds=1)
+    cold = daemon.exec_cache.stats
+    assert cold.saves >= 2 and cold.hits == 0
+    del daemon
+
+    restarted = make_daemon(root, exec_cache=True)
+    silent(restarted.start)
+    assert restarted.exec_cache.stats.misses == 0
+    assert restarted.exec_cache.stats.hits == len(
+        restarted.stats.prewarmed
+    )
+    assert all(restarted.stats.prewarmed.values())
+    run_silently(restarted)
+    for i in range(2):
+        assert restarted.tenant(f"t{i}").status is TenantStatus.COMPLETED
+
+
+def test_corrupt_exec_cache_entry_recompiles_with_identical_results(
+    tmp_path,
+):
+    """Chaos on the executable cache must never change results: a corrupt
+    entry is quarantined and the recompiled program produces the same
+    bits."""
+    expected, _ = _reference_results(tmp_path)
+    root = tmp_path / "svc"
+    daemon = make_daemon(root, exec_cache=True)  # private root-local cache
+    daemon.start()
+    for i in range(N_TENANTS):
+        daemon.submit(pso_spec(f"t{i}", i))
+    run_silently(daemon, max_rounds=1)
+    del daemon
+    # Bit-flip every cache entry.
+    exec_dir = root / ServiceDaemon.EXEC_CACHE_DIR
+    for entry in exec_dir.glob("*.jaxexe"):
+        blob = bytearray(entry.read_bytes())
+        blob[-30] ^= 0x01
+        entry.write_bytes(bytes(blob))
+    restarted = make_daemon(root, exec_cache=True)
+    silent(restarted.start)
+    assert restarted.exec_cache.stats.quarantines >= 1
+    assert list(exec_dir.glob("*.corrupt*"))
+    run_silently(restarted)
+    for i in range(N_TENANTS):
+        assert_states_equal(
+            expected[f"t{i}"], restarted.result(f"t{i}"), f"t{i}"
+        )
+
+
+@pytest.mark.slow
+def test_kill_restart_64_tenants_acceptance(tmp_path):
+    """The ISSUE acceptance at width: a daemon serving 64 packed tenants,
+    killed mid-run, restarts from journal + namespaces + executable cache
+    with every tenant's final state and checkpoint leaf digests
+    bit-identical to an uninterrupted daemon."""
+    lanes = 64
+    n_tenants = 64
+    n_steps = 8
+    shared_cache = ExecutableCache(tmp_path / "shared_exec")
+
+    def build(root):
+        return make_daemon(
+            root,
+            lanes_per_pack=lanes,
+            segment_steps=4,
+            max_queue=n_tenants,
+            exec_cache=shared_cache,
+        )
+
+    ref = build(tmp_path / "ref")
+    ref.start()
+    for i in range(n_tenants):
+        ref.submit(pso_spec(f"t{i:03d}", i, n_steps=n_steps))
+    run_silently(ref)
+    expected = {
+        f"t{i:03d}": ref.result(f"t{i:03d}") for i in range(n_tenants)
+    }
+    expected_digests = {
+        f"t{i:03d}": last_checkpoint_digests(tmp_path / "ref", f"t{i:03d}")
+        for i in range(n_tenants)
+    }
+
+    root = tmp_path / "killed"
+    daemon = build(root)
+    daemon.start()
+    for i in range(n_tenants):
+        daemon.submit(pso_spec(f"t{i:03d}", i, n_steps=n_steps))
+    run_silently(daemon, max_rounds=1)  # mid-run: every tenant mid-flight
+    del daemon
+
+    restarted = build(root)
+    assert silent(restarted.start) == n_tenants
+    # Zero cold start: every pack program came from the shared cache.
+    assert all(restarted.stats.prewarmed.values())
+    run_silently(restarted)
+    for i in range(n_tenants):
+        tid = f"t{i:03d}"
+        assert restarted.tenant(tid).status is TenantStatus.COMPLETED
+        assert_states_equal(expected[tid], restarted.result(tid), tid)
+        name, digests = last_checkpoint_digests(root, tid)
+        assert (name, digests) == expected_digests[tid], tid
+
+
+# -- fleet integration -------------------------------------------------------
+
+
+def test_fleet_supervisor_wired_to_daemon_root(tmp_path):
+    """`daemon.fleet_supervisor` builds a supervisor whose workers share
+    the daemon's root (journal + namespaces + exec cache = the migration
+    plane); a relaunch after a host death completes on the survivors —
+    scripted workers, same pattern as the fleet decision tests."""
+    root = tmp_path / "svc"
+    daemon = make_daemon(root)
+    daemon.start()
+    daemon.submit(pso_spec("t", 0))
+    run_silently(daemon)
+    daemon.close()
+
+    class FakeWorker:
+        pid = 4242
+
+        def __init__(self, rc=None):
+            self.rc = rc
+
+        def poll(self):
+            return self.rc
+
+        def terminate(self):
+            if self.rc is None:
+                self.rc = -15
+
+        def kill(self):
+            if self.rc is None:
+                self.rc = -9
+
+        def wait(self, timeout=None):
+            return self.rc
+
+    script = {(0, 1): 1, (0, 0): None}  # attempt 0: worker 1 dies
+
+    def spawn(argv, env, spec):
+        return FakeWorker(rc=script.get((spec.attempt, spec.process_id), 0))
+
+    sup = daemon.fleet_supervisor(
+        lambda spec: ["daemon-worker"],
+        2,
+        spawn=spawn,
+        poll_interval=0.01,
+        grace_seconds=0.05,
+        start_grace=1000.0,
+    )
+    assert sup.checkpoint_dir == root
+    assert sup.heartbeat_dir == root / "heartbeats"
+    stats = sup.run()
+    assert stats.completed
+    assert stats.world_sizes == [2, 1]  # relaunched smaller after the death
+    assert stats.host_deaths == 1
+
+
+# -- misc --------------------------------------------------------------------
+
+
+def test_withdraw_requires_queued(tmp_path):
+    daemon = make_daemon(tmp_path / "svc")
+    daemon.start()
+    daemon.submit(pso_spec("a", 0))
+    run_silently(daemon)
+    with pytest.raises(RuntimeError, match="not QUEUED"):
+        daemon.service.withdraw("a")
+    with pytest.raises(RuntimeError, match="not QUEUED"):
+        daemon.service.withdraw("ghost")
+
+
+def test_daemon_validates_configuration(tmp_path):
+    with pytest.raises(ValueError, match="brownout_factor"):
+        ServiceDaemon(tmp_path / "a", brownout_factor=0)
+    with pytest.raises(ValueError, match="brownout_threshold"):
+        ServiceDaemon(tmp_path / "b", brownout_threshold=1.5)
+    with pytest.raises(ValueError, match="queue_budget"):
+        TenantClass("x", -1)
+    with pytest.raises(ValueError, match="duplicate"):
+        ServiceDaemon(
+            tmp_path / "c",
+            classes=[TenantClass("a", 1), TenantClass("a", 2)],
+        )
+
+
+def test_rejection_tuple_compat_regression():
+    import copy
+    import pickle
+
+    r = Rejection("tid", "shed", 3)
+    assert r == ("tid", "shed")
+    assert ("tid", "shed") in [r]
+    assert r.retry_after_segments == 3
+    assert Rejection("tid", "queue-full").retry_after_segments is None
+    # tuple's default reduce does not know the subclass __new__ signature;
+    # ServiceStats must survive pickling (fleet transport) and deepcopy.
+    for clone in (pickle.loads(pickle.dumps(r)), copy.deepcopy(r)):
+        assert clone == ("tid", "shed")
+        assert clone.retry_after_segments == 3
+
+
+def test_journal_unrepaired_damage_keeps_refusing_appends(tmp_path):
+    """replay(quarantine=False) leaves the damaged tail in place — appends
+    must stay refused, or the next replay would cut an ACKED record away
+    with the garbage it was appended after."""
+    j = RequestJournal(tmp_path / "j.jsonl")
+    j.append("submit", uid=0)
+    j.close()
+    with open(tmp_path / "j.jsonl", "ab") as f:
+        f.write(b'{"body":{"seq":1,"kind":"subm')
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    records, damage = j2.replay(quarantine=False)
+    assert len(records) == 1 and damage is not None and not damage.truncated
+    with pytest.raises(JournalError, match="torn tail"):
+        j2.append("submit", uid=1)
+    # A repairing replay un-poisons it.
+    records, damage = j2.replay(quarantine=True)
+    assert damage is not None and damage.truncated
+    assert j2.append("submit", uid=1) == 1
+
+
+def test_evict_and_forget_journal_before_mutating(tmp_path):
+    """An acked evict/retire is durable: the journal record lands BEFORE
+    the service mutates, and a failed append leaves the service state
+    untouched (the caller sees the failure — unacked)."""
+    from evox_tpu.utils.checkpoint import CheckpointStore
+
+    class FlakyAppends(CheckpointStore):
+        fail_next = False
+
+        def append_record(self, f, data):
+            if FlakyAppends.fail_next:
+                FlakyAppends.fail_next = False
+                raise OSError(28, "No space left on device (injected)")
+            return super().append_record(f, data)
+
+    daemon = make_daemon(
+        tmp_path / "svc", store=FlakyAppends(), exec_cache=None
+    )
+    daemon.start()
+    daemon.submit(pso_spec("t", 0, n_steps=20))
+    run_silently(daemon, max_rounds=1)
+    FlakyAppends.fail_next = True
+    with pytest.raises(JournalError):
+        silent(daemon.evict, "t")
+    assert daemon.tenant("t").status is TenantStatus.RUNNING  # untouched
+    daemon.evict("t")  # clean retry
+    assert daemon.tenant("t").status is TenantStatus.EVICTED
+    FlakyAppends.fail_next = True
+    with pytest.raises(JournalError):
+        silent(daemon.forget, "t")
+    assert daemon.tenant("t").status is TenantStatus.EVICTED  # untouched
+    daemon.forget("t")
+    with pytest.raises(KeyError):
+        daemon.tenant("t")
+    # Preconditions are validated BEFORE any journal write: a doomed call
+    # leaves no record.
+    daemon.submit(pso_spec("queued", 1, n_steps=20))  # never stepped
+    before = daemon.journal.next_seq
+    with pytest.raises(RuntimeError, match="no lane"):
+        daemon.evict("queued")
+    with pytest.raises(RuntimeError, match="evict it"):
+        daemon.forget("queued")
+    assert daemon.journal.next_seq == before
+
+
+def test_runner_shared_exec_cache_isolates_programs(tmp_path):
+    """Two workflows with identically-shaped states but different
+    problems must not collide in a shared runner cache: the label is
+    salted with the workflow's static-configuration digest."""
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.resilience import ResilientRunner
+    from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+    cache = ExecutableCache(tmp_path / "exec")
+
+    def run(problem, tag):
+        wf = StdWorkflow(
+            PSO(POP, LB, UB), problem, monitor=EvalMonitor(ordered=False)
+        )
+        runner = ResilientRunner(
+            wf,
+            tmp_path / tag,
+            checkpoint_every=4,
+            exec_cache=cache,
+            preemption=False,
+        )
+        return silent(
+            runner.run, wf.setup(jax.random.key(0)), n_steps=8
+        )
+
+    run(Ackley(), "a")
+    hits_before = cache.stats.hits
+    run(Sphere(), "b")  # same shapes, different program
+    # The Sphere run must NOT have been served Ackley's executables.
+    assert cache.stats.hits == hits_before

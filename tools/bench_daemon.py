@@ -1,0 +1,378 @@
+#!/usr/bin/env python
+"""Serving-daemon gates: zero cold-start restart + overload isolation.
+
+Two claims from ISSUE 11, each pinned to a number and FAILED loudly (exit
+1) when it does not hold:
+
+1. **Cold-start gate** — a warm-cache daemon restart admits its first
+   tenant with **zero** pack-program compiles, proven by a
+   ``CompileSentinel`` in a *fresh process*: a cold daemon process
+   compiles the bucket's programs (and persists them via
+   ``jax.experimental.serialize_executable`` into the root's
+   ``exec_cache/``), is hard-killed mid-run (``os._exit`` — no shutdown
+   path), and a second process restarts over the same root.  The gate
+   asserts the restart (a) recorded **no** ``_vmapped_segment`` /
+   ``_init_program`` compile-log events, (b) loaded every pre-warmed
+   program from the executable cache, and (c) resumed every journaled
+   tenant.  The cold/warm time-to-first-segment ratio is the recorded
+   speedup.
+
+2. **Overload gate** — under a submit rate beyond capacity, the admitted
+   tenants' per-tenant gen/s stays ≥ ``OVERLOAD_FLOOR`` (90%) of the
+   uncontended packed rate, while every excess submission is shed with a
+   structured ``AdmissionError(reason="shed",
+   retry_after_segments=...)`` — no silent degradation, no unbounded
+   queue growth (the queue is asserted bounded at its budget throughout).
+
+The configuration is deliberately tiny (pop=8, dim=4 — the dispatch-bound
+regime, same rationale as ``tools/bench_service.py``); the committed CPU
+artifacts are provisional until ``tools/run_tpu_sweep.sh`` re-anchors them
+(``BENCH_HISTORY.json`` carries ``indicative_only``).
+
+Run via::
+
+    ./run_tests.sh --serve          # suite + this harness
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_daemon.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LANES = 8
+# Serving-cadence segment: long enough that one round's compute dwarfs the
+# per-round fixed costs the gate exists to bound (journal fsyncs for the
+# shed pressure, admission scans) — the same amortization argument as the
+# service's own continuous-batching quantum.
+SEGMENT = 128
+POP, DIM = 8, 4
+QUEUE_BUDGET = 8
+ROUNDS = 4
+REPEATS = 3
+OVERLOAD_FLOOR = 0.90
+
+_CHILD = textwrap.dedent(
+    '''
+    """Cold-start gate child: one daemon lifecycle phase per process."""
+    import json, os, sys, time, warnings
+
+    sys.path.insert(0, {repo!r})
+    import jax.numpy as jnp
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.problems.numerical import Ackley
+    from evox_tpu.service import ServiceDaemon, TenantSpec
+    from tools.graftlint.compile_sentinel import CompileSentinel
+
+    phase, root, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
+    LANES, SEGMENT, POP, DIM = {lanes}, {segment}, {pop}, {dim}
+    LB = -32.0 * jnp.ones(DIM)
+    UB = 32.0 * jnp.ones(DIM)
+
+    def make():
+        # xla_cache=True is the composed zero-cold-start design: the
+        # executable cache serves the pre-warmed pack programs, jax's
+        # persistent compilation cache (under the shared root) serves the
+        # long tail of eager lane-surgery/resume programs the restart
+        # otherwise recompiles.
+        return ServiceDaemon(
+            root, lanes_per_pack=LANES, segment_steps=SEGMENT,
+            max_queue=LANES, seed=0, preemption=False,
+            brownout_threshold=None, xla_cache=True,
+        )
+
+    warnings.simplefilter("ignore")
+    with CompileSentinel() as sentinel:
+        t0 = time.perf_counter()
+        daemon = make()
+        daemon.start()
+        if phase == "cold":
+            for uid in range(LANES):
+                daemon.submit(TenantSpec(
+                    f"t{{uid}}", PSO(POP, LB, UB), Ackley(),
+                    n_steps=SEGMENT * 8, uid=uid,
+                ))
+        daemon.step()          # first packed segment
+        ready = time.perf_counter() - t0
+    pack_compiles = [
+        e.name for e in sentinel.events
+        if e.name in ("_vmapped_segment", "_init_program")
+    ]
+    report = {{
+        "phase": phase,
+        "ready_seconds": ready,
+        "pack_compiles": pack_compiles,
+        "total_compile_events": len(sentinel.events),
+        "cache_hits": daemon.exec_cache.stats.hits,
+        "cache_misses": daemon.exec_cache.stats.misses,
+        "cache_saves": daemon.exec_cache.stats.saves,
+        "prewarmed": daemon.stats.prewarmed,
+        "restored": daemon.stats.replayed_tenants,
+        "running": sum(
+            1 for t in daemon.service._tenants.values()
+            if t.lane is not None
+        ),
+    }}
+    with open(out_path, "w") as f:
+        json.dump(report, f)
+    if phase == "cold":
+        os._exit(9)            # SIGKILL semantics: no shutdown path runs
+    '''
+)
+
+
+def _run_child(phase: str, root: str, out_path: str) -> dict:
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".py", delete=False
+    ) as f:
+        f.write(
+            _CHILD.format(
+                repo=REPO, lanes=LANES, segment=SEGMENT, pop=POP, dim=DIM
+            )
+        )
+        script = f.name
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, phase, root, out_path],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        # The cold child hard-exits 9 BY DESIGN (SIGKILL semantics).
+        expected_rc = 9 if phase == "cold" else 0
+        if proc.returncode != expected_rc:
+            print(proc.stdout, file=sys.stderr)
+            print(proc.stderr, file=sys.stderr)
+            raise RuntimeError(
+                f"{phase} child exited {proc.returncode} "
+                f"(expected {expected_rc})"
+            )
+        with open(out_path) as f:
+            return json.load(f)
+    finally:
+        os.unlink(script)
+
+
+def cold_start_gate(out_dir: str, backend: str) -> tuple[dict, bool]:
+    with tempfile.TemporaryDirectory() as tmp:
+        root = os.path.join(tmp, "svc")
+        cold = _run_child("cold", root, os.path.join(tmp, "cold.json"))
+        warm = _run_child("warm", root, os.path.join(tmp, "warm.json"))
+    zero_compiles = len(warm["pack_compiles"]) == 0
+    all_cached = (
+        warm["cache_misses"] == 0
+        and warm["prewarmed"]
+        and all(warm["prewarmed"].values())
+    )
+    resumed = warm["restored"] == LANES and warm["running"] == LANES
+    speedup = cold["ready_seconds"] / max(warm["ready_seconds"], 1e-9)
+    result = {
+        "metric": (
+            f"Daemon warm-restart time-to-first-segment speedup "
+            f"({LANES} x PSO pop={POP} dim={DIM}, segment={SEGMENT})"
+        ),
+        "value": round(speedup, 3),
+        "unit": "x (cold ready_seconds / warm ready_seconds)",
+        "platform": backend,
+        "device_kind": backend,
+        "indicative_only": backend != "tpu",
+        "cold_ready_seconds": round(cold["ready_seconds"], 3),
+        "warm_ready_seconds": round(warm["ready_seconds"], 3),
+        "cold_pack_compiles": len(cold["pack_compiles"]),
+        "warm_pack_compiles": len(warm["pack_compiles"]),
+        "warm_cache_hits": warm["cache_hits"],
+        "warm_cache_misses": warm["cache_misses"],
+        "tenants_restored_on_restart": warm["restored"],
+        "zero_compile_restart": zero_compiles and all_cached,
+        "journal_replay_complete": resumed,
+    }
+    path = os.path.join(out_dir, f"daemon_coldstart.{backend}.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"cold-start: cold {cold['ready_seconds']:.2f}s "
+        f"({len(cold['pack_compiles'])} pack compiles) -> warm restart "
+        f"{warm['ready_seconds']:.2f}s ({len(warm['pack_compiles'])} pack "
+        f"compiles, {warm['cache_hits']} cache hits, "
+        f"{warm['restored']} tenants replayed) = {speedup:.1f}x; "
+        f"recorded -> {os.path.relpath(path, REPO)}"
+    )
+    ok = zero_compiles and all_cached and resumed
+    if not ok:
+        print(
+            f"FAIL cold-start gate: warm restart paid "
+            f"{len(warm['pack_compiles'])} pack compiles "
+            f"(cache hits {warm['cache_hits']}, misses "
+            f"{warm['cache_misses']}, restored {warm['restored']})",
+            file=sys.stderr,
+        )
+    return result, ok
+
+
+def overload_gate(out_dir: str, backend: str) -> tuple[dict, bool]:
+    import warnings
+
+    import jax.numpy as jnp
+
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.problems.numerical import Ackley
+    from evox_tpu.service import (
+        AdmissionError,
+        ServiceDaemon,
+        TenantClass,
+        TenantSpec,
+    )
+    from evox_tpu.utils import ExecutableCache
+
+    LB = -32.0 * jnp.ones(DIM)
+    UB = 32.0 * jnp.ones(DIM)
+
+    def spec(name, uid):
+        # Effectively-unbounded budget: the gate measures the
+        # steady-state serving loop, so tenants never retire mid-pass.
+        return TenantSpec(
+            name, PSO(POP, LB, UB), Ackley(), n_steps=10**9, uid=uid
+        )
+
+    def timed_rounds(daemon, per_round=None):
+        times = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            for _ in range(ROUNDS):
+                if per_round is not None:
+                    per_round()
+                daemon.step()
+            times.append(time.perf_counter() - t0)
+        return ROUNDS * SEGMENT / statistics.median(times)
+
+    with tempfile.TemporaryDirectory() as tmp, warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        cache = ExecutableCache(os.path.join(tmp, "exec"))
+
+        def build(tag, **kw):
+            return ServiceDaemon(
+                os.path.join(tmp, tag),
+                lanes_per_pack=LANES,
+                segment_steps=SEGMENT,
+                max_queue=LANES + QUEUE_BUDGET,
+                seed=0,
+                preemption=False,
+                brownout_threshold=None,
+                exec_cache=cache,
+                checkpoint_every=10**6,  # steady-state loop, not ckpt I/O
+                **kw,
+            )
+
+        uncontended = build("uncontended")
+        uncontended.start()
+        for uid in range(LANES):
+            uncontended.submit(spec(f"u{uid}", uid))
+        uncontended.step()  # admit + warm
+        rate_uncontended = timed_rounds(uncontended)
+
+        contended = build(
+            "contended",
+            classes=[TenantClass("standard", QUEUE_BUDGET)],
+        )
+        contended.start()
+        for uid in range(LANES):
+            contended.submit(spec(f"c{uid}", uid))
+        contended.step()  # admit the running cohort
+        # Fill the bounded queue to its class budget...
+        for uid in range(LANES, LANES + QUEUE_BUDGET):
+            contended.submit(spec(f"c{uid}", uid))
+        # ...then keep submitting beyond capacity during the timed loop.
+        sheds = []
+        extra_uid = [LANES + QUEUE_BUDGET]
+
+        def pressure():
+            for _ in range(2):
+                uid = extra_uid[0]
+                extra_uid[0] += 1
+                try:
+                    contended.submit(spec(f"x{uid}", uid))
+                except AdmissionError as e:
+                    sheds.append((e.reason, e.retry_after_segments))
+            assert len(contended.service._queue) <= QUEUE_BUDGET, (
+                "queue grew beyond its budget"
+            )
+
+        rate_contended = timed_rounds(contended, per_round=pressure)
+
+    ratio = rate_contended / rate_uncontended
+    structured = [
+        s for s in sheds
+        if s[0] == "shed" and isinstance(s[1], int) and s[1] >= 1
+    ]
+    all_shed_structured = len(sheds) > 0 and len(structured) == len(sheds)
+    result = {
+        "metric": (
+            f"Daemon overload per-tenant retention ({LANES} lanes, "
+            f"queue budget {QUEUE_BUDGET}, PSO pop={POP} dim={DIM}, "
+            f"segment={SEGMENT})"
+        ),
+        "value": round(ratio, 4),
+        "unit": "ratio (contended / uncontended per-tenant gen/s)",
+        "platform": backend,
+        "device_kind": backend,
+        "indicative_only": backend != "tpu",
+        "per_tenant_gens_per_sec": {
+            "uncontended": round(rate_uncontended, 3),
+            "contended": round(rate_contended, 3),
+        },
+        "floor_ratio": OVERLOAD_FLOOR,
+        "submissions_shed": len(sheds),
+        "sheds_structured": all_shed_structured,
+        "retry_after_segments_seen": sorted(
+            {s[1] for s in structured}
+        ),
+        "within_budget": ratio >= OVERLOAD_FLOOR,
+    }
+    path = os.path.join(out_dir, f"daemon_overload.{backend}.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"overload: contended {rate_contended:.0f} vs uncontended "
+        f"{rate_uncontended:.0f} gen/s/tenant = {ratio * 100:.1f}% kept "
+        f"(floor {OVERLOAD_FLOOR * 100:.0f}%); {len(sheds)} submissions "
+        f"shed, all structured: {all_shed_structured}; recorded -> "
+        f"{os.path.relpath(path, REPO)}"
+    )
+    ok = ratio >= OVERLOAD_FLOOR and all_shed_structured
+    if not ok:
+        print(
+            f"FAIL overload gate: retention {ratio * 100:.1f}% "
+            f"(floor {OVERLOAD_FLOOR * 100:.0f}%), sheds structured: "
+            f"{all_shed_structured}",
+            file=sys.stderr,
+        )
+    return result, ok
+
+
+def main() -> int:
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    import jax
+
+    backend = jax.default_backend()
+    _, cold_ok = cold_start_gate(out_dir, backend)
+    _, overload_ok = overload_gate(out_dir, backend)
+    return 0 if (cold_ok and overload_ok) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,8 +29,11 @@ every comparison (``evox_bench_check_*`` gauges) is written atomically
 for scrape-based dashboards.
 
 Wired into ``tools/run_tpu_sweep.sh`` (after the sweep re-anchors) and
-``./run_tests.sh --obs`` (report-only: CPU containers have no anchored
-rows to gate).
+``./run_tests.sh --obs`` as a REAL gate (PR 11): the default exit code —
+nonzero iff a TPU-anchored baseline regressed — is the lane's verdict.
+CPU-provisional rows keep reporting without gating, so CPU containers
+pass vacuously while a TPU box gates for real; ``--report-only`` remains
+for wiring that must never gate.
 
 Usage::
 
