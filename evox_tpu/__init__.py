@@ -10,6 +10,7 @@ __version__ = "0.1.0"
 
 from . import (
     algorithms,
+    control,
     core,
     metrics,
     obs,
@@ -37,6 +38,7 @@ from .core import (
 
 __all__ = [
     "algorithms",
+    "control",
     "core",
     "metrics",
     "obs",

@@ -1,0 +1,54 @@
+"""Closed-loop adaptive control plane: observe → decide → act.
+
+The observability plane (PRs 9–10) records per-generation flight
+signals, per-segment timings, and XLA cost verdicts; the durable daemon
+(PR 11) journals every lifecycle transition.  This package *consumes*
+those signals: a :class:`Controller` renders structured, journaled
+:class:`Decision`\\ s — trend verdicts that fire restarts before a run
+wedges, self-tuned segment cadence from measured compile/execute
+ratios, and graduated degradation (tenant restart/quarantine/evict
+scoring, brown-out hysteresis, SLO-aware shed thresholds) — and the
+:class:`~evox_tpu.resilience.ResilientRunner`,
+:class:`~evox_tpu.service.OptimizationService`, and
+:class:`~evox_tpu.service.ServiceDaemon` act on them.
+
+Contracts (``docs/guide/control.md``, pinned in
+``tests/test_control.py``):
+
+* every decision's action is a **pure function of its journaled
+  evidence** (the ``decide_*`` functions), so a replayed journal
+  reproduces the identical decision sequence bit-for-bit;
+* decisions are excluded from bit-identity the way ``num_preemptions``
+  is — a controller that fires no decision leaves the run bit-identical
+  to a controller-less one;
+* the controller **never crashes a run**: missing/NaN signals, a
+  detached flight recorder, torn decision records, and failed journal
+  appends all degrade to the existing threshold probes with one
+  structured warning.
+
+Strictly host-side at segment boundaries — nothing in this package is
+ever traced (the graftlint sweep keeps GL002/GL003 clean over it).
+"""
+
+from .controller import (
+    Controller,
+    decide,
+    decide_brownout,
+    decide_cadence,
+    decide_shed,
+    decide_tenant,
+    decide_trend,
+)
+from .decision import DECISION_SCHEMA_VERSION, Decision
+
+__all__ = [
+    "DECISION_SCHEMA_VERSION",
+    "Controller",
+    "Decision",
+    "decide",
+    "decide_brownout",
+    "decide_cadence",
+    "decide_shed",
+    "decide_tenant",
+    "decide_trend",
+]

@@ -46,7 +46,14 @@ from .events import (
     JsonlFileSink,
     RingBufferSink,
 )
-from .flight import FlightRecorder, finalize_row, flight_signals
+from .flight import (
+    FlightRecorder,
+    finalize_row,
+    flight_signals,
+    last_n,
+    window_ema,
+    window_slope,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -79,5 +86,8 @@ __all__ = [
     "FlightRecorder",
     "finalize_row",
     "flight_signals",
+    "last_n",
+    "window_ema",
+    "window_slope",
     "xla",
 ]

@@ -42,7 +42,14 @@ from typing import Any, Mapping, Union
 
 from .version import OBS_SCHEMA_VERSION
 
-__all__ = ["FlightRecorder", "finalize_row", "flight_signals"]
+__all__ = [
+    "FlightRecorder",
+    "finalize_row",
+    "flight_signals",
+    "last_n",
+    "window_ema",
+    "window_slope",
+]
 
 # Bus categories that can trip a postmortem dump.  "health" and "tenant"
 # additionally require warning severity (routine tenant lifecycle lines —
@@ -196,6 +203,95 @@ def finalize_row(row: dict[str, float]) -> dict[str, float]:
             -row["_velocity_min"], row["_velocity_max"]
         )
     return out
+
+
+# -- trend queries -----------------------------------------------------------
+# ONE definition of the window math, shared by the control plane
+# (evox_tpu/control/ consumes these to render trend verdicts) and ad-hoc
+# postmortem analysis (a dumped bundle's ``flight.jsonl`` rows feed the
+# same functions verbatim).  All three are NaN-robust: non-finite samples
+# are *skipped*, never propagated — a NaN burst in a signal must degrade
+# a trend estimate gracefully (fewer points), not poison it.  Pure float
+# math, stdlib-only, deterministic for a given row sequence.
+
+
+def _finite_pairs(
+    rows: Any, signal: str, window: int | None
+) -> list[tuple[float, float]]:
+    """``(generation, value)`` pairs of the newest ``window`` rows that
+    carry a *finite* value for ``signal`` (oldest first).  The window is
+    cut over ROWS before the finite filter: a NaN burst in the newest
+    rows must shrink the estimate to fewer points inside the window, not
+    silently pull pre-burst history back in (a trend rendered from stale
+    rows would describe the wrong regime).  Rows without a ``generation``
+    key use their position index, so bundle rows and ad-hoc row lists
+    work alike."""
+    rows = list(rows)
+    if window is not None and window > 0:
+        rows = rows[-window:]
+    pairs: list[tuple[float, float]] = []
+    for i, row in enumerate(rows):
+        if signal not in row:
+            continue
+        value = float(row[signal])
+        if value != value or value in (float("inf"), float("-inf")):
+            continue
+        pairs.append((float(row.get("generation", i)), value))
+    return pairs
+
+
+def last_n(rows: Any, signal: str, n: int) -> list[float]:
+    """The newest ``n`` values of ``signal`` among ``rows`` (oldest
+    first).  Values are returned verbatim — non-finite included — so the
+    caller sees exactly what the ring recorded; the trend estimators
+    below are the NaN-robust consumers."""
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    values = [float(row[signal]) for row in rows if signal in row]
+    return values[-n:]
+
+
+def window_ema(
+    rows: Any,
+    signal: str,
+    *,
+    alpha: float = 0.3,
+    window: int | None = None,
+) -> float | None:
+    """Exponential moving average of ``signal`` over the newest ``window``
+    rows (all rows when ``None``), oldest-to-newest, skipping non-finite
+    samples.  ``None`` when no finite sample exists.  ``alpha`` is the
+    weight of each newer sample (0 < alpha <= 1)."""
+    if not (0.0 < alpha <= 1.0):
+        raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+    pairs = _finite_pairs(rows, signal, window)
+    if not pairs:
+        return None
+    ema = pairs[0][1]
+    for _, value in pairs[1:]:
+        ema = (1.0 - alpha) * ema + alpha * value
+    return ema
+
+
+def window_slope(
+    rows: Any, signal: str, *, window: int | None = None
+) -> float | None:
+    """Least-squares slope of ``signal`` per *generation* over the newest
+    ``window`` rows (all rows when ``None``), skipping non-finite
+    samples.  ``None`` when fewer than two finite samples remain or every
+    sample sits on one generation (a rollback replay can momentarily fold
+    the window onto itself) — the caller must treat "no slope" as "no
+    verdict", never as zero."""
+    pairs = _finite_pairs(rows, signal, window)
+    if len(pairs) < 2:
+        return None
+    n = float(len(pairs))
+    mean_g = sum(g for g, _ in pairs) / n
+    mean_v = sum(v for _, v in pairs) / n
+    denom = sum((g - mean_g) ** 2 for g, _ in pairs)
+    if denom <= 0.0:
+        return None
+    return sum((g - mean_g) * (v - mean_v) for g, v in pairs) / denom
 
 
 class FlightRecorder:
@@ -361,6 +457,26 @@ class FlightRecorder:
     def latest_generation(self) -> int | None:
         with self._lock:
             return int(self._rows[-1]["generation"]) if self._rows else None
+
+    # -- trend queries (the control plane's read surface) -------------------
+    def last_n(self, signal: str, n: int) -> list[float]:
+        """The newest ``n`` recorded values of ``signal`` (oldest first;
+        non-finite values included) — see :func:`last_n`."""
+        return last_n(self.rows(), signal, n)
+
+    def window_ema(
+        self, signal: str, *, alpha: float = 0.3, window: int | None = None
+    ) -> float | None:
+        """NaN-robust EMA of ``signal`` over the ring — see
+        :func:`window_ema`."""
+        return window_ema(self.rows(), signal, alpha=alpha, window=window)
+
+    def window_slope(
+        self, signal: str, *, window: int | None = None
+    ) -> float | None:
+        """NaN-robust per-generation slope of ``signal`` over the ring —
+        see :func:`window_slope`."""
+        return window_slope(self.rows(), signal, window=window)
 
     def _check_storm(self) -> None:
         if self.quarantine_storm is None:

@@ -660,6 +660,24 @@ class FaultyProblem(Problem):
             "sharding": SingleDeviceSharding(jax.local_devices()[0]),
         }
 
+    # -- pickling ----------------------------------------------------------
+    # A fault plan must survive pickling: the serving daemon journals
+    # every TenantSpec (problem included) to make submissions durable,
+    # and chaos tenants are exactly the specs the kill-restart tests
+    # resubmit.  The attempt-counter lock is process-local, and the
+    # counters themselves are host-side observation state — a spec
+    # restored in a fresh process re-arms them, which is the fresh-
+    # process semantics anyway.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        state["_attempts"] = {}
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
     # -- host side ---------------------------------------------------------
     def _bump(self, kind: str, gen: int) -> int:
         with self._lock:

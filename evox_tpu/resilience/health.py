@@ -242,6 +242,26 @@ class HealthReport:
     dead_shards: list[int] = field(default_factory=list)
     shard_diversity: list[float] | None = None
     collapsed_shards: list[int] = field(default_factory=list)
+    # True when the unhealthy verdict came from the control plane's
+    # flight-window trend analysis (``evox_tpu.control``) rather than the
+    # probe's instantaneous threshold detectors — see :meth:`with_trend`.
+    trend: bool = False
+
+    def with_trend(self, reasons: Sequence[str]) -> "HealthReport":
+        """A copy of this report rendered unhealthy by a controller
+        trend verdict: ``healthy=False``, ``trend=True``, the trend
+        reasons appended after any probe reasons.  The probe's metric
+        fields are untouched — the trend verdict is *about* the window's
+        trajectory, which the flight recorder (and the journaled
+        decision's evidence) documents."""
+        import dataclasses
+
+        return dataclasses.replace(
+            self,
+            healthy=False,
+            trend=True,
+            reasons=[*self.reasons, *reasons],
+        )
 
 
 class HealthProbe:

@@ -181,6 +181,13 @@ class RestartContext:
     report: "HealthReport"
     restart_index: int
     lineage: tuple[RestartEvent, ...] = ()
+    # The controller trend decision that fired this restart, when the
+    # verdict came from the control plane (``evox_tpu.control``) rather
+    # than the threshold probe — ``None`` for probe-triggered restarts.
+    # Policies may consult its evidence (e.g. scale a perturbation by the
+    # measured stagnation slope); the runner also folds its action into
+    # the RestartEvent's detail, so the lineage records which plane fired.
+    decision: Any | None = None
 
 
 # -- the policy interface ----------------------------------------------------

@@ -451,6 +451,7 @@ class ResilientRunner:
         primary: bool | None = None,
         heartbeat: Any | None = None,
         obs: Union[Observability, bool, None] = None,
+        controller: Any | None = None,
     ):
         """
         :param workflow: any ``Workflow`` whose ``init_step``/``step`` are
@@ -646,6 +647,29 @@ class ResilientRunner:
             ``tests/test_flight.py`` pin bit-identity,
             ``tools/bench_obs_overhead.py`` gates the wall-clock cost
             with the flight recorder on).
+        :param controller: optional
+            :class:`~evox_tpu.control.Controller` closing the
+            observe→decide→act loop over this runner.  Two planes, each
+            opt-in on the controller: **trend verdicts** — at every
+            boundary where the threshold probe reads healthy, the
+            controller examines the flight recorder's signal window
+            (slope/EMA, NaN-robust) and may declare the run degenerate
+            *early* (fitness-slope stagnation, diversity-collapse
+            trajectory, quarantine-storm prediction), firing the same
+            ``restart=`` policy the probe would — needs the obs plane's
+            flight recorder; with it detached the controller degrades
+            to the threshold probes with one structured warning and the
+            run completes.  **Self-tuning cadence** — the next segment's
+            scan length is sized from measured compile/execute ratios
+            and checkpoint-block seconds (``stats.segment_timings``),
+            generalizing (and taking precedence over)
+            ``checkpoint_wall_interval``.  Every decision carries its
+            evidence, is appended to the controller's journal when one
+            is wired, and is *excluded from bit-identity* like
+            ``num_preemptions``: a controller that fires no decision
+            leaves the run bit-identical to ``controller=None``
+            (``tests/test_control.py``).  The controller never crashes
+            a run — every consult is exception-guarded on both sides.
         """
         if checkpoint_every < 1:
             raise ValueError(
@@ -708,6 +732,14 @@ class ResilientRunner:
         # digest-guarded and failure-isolated inside the cache itself.
         self.exec_cache = exec_cache or None
         self.obs = resolve_obs(obs, run_id=Path(checkpoint_dir).name)
+        # The closed-loop control plane (evox_tpu/control): trend verdicts
+        # from the flight window + self-tuned cadence from measured
+        # timings.  Bound to this runner's obs plane so its decisions and
+        # degrade warnings publish as "control" events.
+        self.controller = controller
+        if controller is not None:
+            controller.bind(self.obs)
+        self._controller_chunk = self.checkpoint_every
         # Counters are monotone and (by default) process-shared: publish
         # per-run stats as deltas against this cursor, reset with stats.
         self._metric_cursor: dict[str, float] = {}
@@ -1731,6 +1763,31 @@ class ResilientRunner:
                 state = self._reload_for_retry(state, generation)
 
     # -- run-health probing and restarts -----------------------------------
+    def _controller_trend(self, done: int):
+        """Consult the controller's trend plane with the flight window.
+        Returns a fired :class:`~evox_tpu.control.Decision` or ``None``;
+        never raises — a missing/detached flight recorder and any
+        controller failure degrade to the threshold probes (the
+        controller emits the structured warning + ``degrade`` decision,
+        and this wrapper is the belt-and-braces outer guard)."""
+        flight = self.obs.flight if self.obs is not None else None
+        rows = None
+        if flight is not None:
+            try:
+                rows = flight.rows()
+            except Exception:  # noqa: BLE001 - detached/broken recorder
+                rows = None
+        try:
+            return self.controller.trend_verdict(rows, generation=done)
+        except Exception as e:  # noqa: BLE001 - advisory plane only
+            self._event(
+                f"controller trend consult failed ({type(e).__name__}: "
+                f"{e}); continuing on threshold probes",
+                warn=True,
+                category="control",
+            )
+            return None
+
     def _health_boundary(
         self, state: State, done: int, n_steps: int
     ) -> tuple[State, int]:
@@ -1743,15 +1800,39 @@ class ResilientRunner:
         in interrupted and uninterrupted runs.  Returns the (possibly
         restarted) state and generation count.
         """
-        if self.health is None:
+        if self.health is None and self.controller is None:
             return state, done
-        with self._span("health-probe", generation=done):
-            report = self.health.check(state, generation=done)
-        self.stats.health_checks += 1
-        self.stats.last_report = report
-        if report.healthy:
+        report: HealthReport | None = None
+        if self.health is not None:
+            with self._span("health-probe", generation=done):
+                report = self.health.check(state, generation=done)
+            self.stats.health_checks += 1
+            self.stats.last_report = report
+            if not report.healthy:
+                self.stats.unhealthy_probes += 1
+        # Controller trend overlay: a boundary the threshold probe calls
+        # healthy may still be on a degenerate *trajectory* — the
+        # controller reads the flight window and can fire the restart
+        # machinery early.  An unhealthy probe verdict always wins (the
+        # probe's detectors are the baseline the controller degrades to).
+        trend_decision = None
+        if (
+            (report is None or report.healthy)
+            and self.controller is not None
+            and self.controller.trend_enabled
+            and done < n_steps
+        ):
+            trend_decision = self._controller_trend(done)
+            if trend_decision is not None:
+                base = report if report is not None else HealthReport(
+                    generation=done, healthy=True
+                )
+                report = base.with_trend(
+                    [f"controller trend verdict: {trend_decision.action}"]
+                )
+                self.stats.last_report = report
+        if report is None or report.healthy:
             return state, done
-        self.stats.unhealthy_probes += 1
         reasons = "; ".join(report.reasons)
         if self.restart is None or done >= n_steps:
             self._event(
@@ -1790,8 +1871,17 @@ class ResilientRunner:
             report=report,
             restart_index=idx,
             lineage=tuple(self.stats.restarts),
+            decision=trend_decision,
         )
         new_state, new_done, needs_init, detail = self.restart.apply(ctx)
+        if trend_decision is not None:
+            # Record which plane fired in the lineage: the journaled
+            # decision (seq) holds the full evidence.
+            detail = {
+                **detail,
+                "trend": trend_decision.action,
+                "decision_seq": trend_decision.seq,
+            }
         event = RestartEvent(
             generation=done,
             policy=self.restart.name,
@@ -1816,7 +1906,8 @@ class ResilientRunner:
         # monotone, so a restart can never improve it instantly) and
         # cascade restarts until the budget is gone.  The cleared window is
         # what later checkpoints persist, so replay stays deterministic.
-        self.health.reset()
+        if self.health is not None:
+            self.health.reset()
         # Count the restart into the monitor's in-state metrics so it is
         # visible from the checkpointed state itself (EvalMonitor surfaces
         # it as ``num_restarts``), not only from host-side stats.
@@ -1914,6 +2005,28 @@ class ResilientRunner:
 
     # -- wall-clock checkpoint cadence ---------------------------------------
     def _next_chunk(self) -> int:
+        if self.controller is not None and self.controller.cadence_enabled:
+            chunk = None
+            try:
+                chunk = self.controller.next_chunk(
+                    self.stats.segment_timings,
+                    checkpoint_every=self.checkpoint_every,
+                    generation=self.stats.completed_generations,
+                    current=self._controller_chunk,
+                )
+            except Exception as e:  # noqa: BLE001 - advisory plane only
+                # Belt and braces: the controller guards itself, but a
+                # broken controller must never take the run with it.
+                self._event(
+                    f"controller cadence consult failed "
+                    f"({type(e).__name__}: {e}); keeping the configured "
+                    f"cadence",
+                    warn=True,
+                    category="control",
+                )
+            if chunk:
+                self._controller_chunk = int(chunk)
+                return self._controller_chunk
         if self.checkpoint_wall_interval is None:
             return self.checkpoint_every
         return self._adaptive_chunk
@@ -1993,6 +2106,10 @@ class ResilientRunner:
         self._resumed_probed = False
         self._adaptive_chunk = 1
         self._per_gen_ema = None
+        # Controller cadence resumes from the configured chunk each run;
+        # the controller itself (decisions, degrade latches, quiet
+        # windows) persists — its journal is cross-run by design.
+        self._controller_chunk = self.checkpoint_every
         if self.health is not None:
             self.health.reset()
         installed_guard = False

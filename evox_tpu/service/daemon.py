@@ -168,6 +168,22 @@ class ServiceDaemon:
     :param preemption: as the service's — default ``True`` (the daemon
         exists to be supervised); :class:`Preempted` is journaled before
         it propagates.
+    :param controller: optional
+        :class:`~evox_tpu.control.Controller` closing the loop over the
+        daemon: brown-out entry/exit runs on the controller's journaled
+        hysteresis instead of the ad-hoc flag check (the daemon's
+        ``brownout_threshold`` stays the entry pressure unless the
+        controller overrides it), shed thresholds are recomputed from
+        the live measured segment cadence when the controller carries an
+        ``slo_wait_seconds`` target, and the controller is handed down
+        to the :class:`~evox_tpu.service.OptimizationService` for
+        per-tenant trend verdicts.  Every decision is appended to THIS
+        daemon's request journal (kind ``"decision"``, advisory — a
+        failed append warns, the decision still applies) unless the
+        controller already carries its own journal; replay reproduces
+        the decision sequence bit-for-bit from the journaled evidence
+        (``tests/test_control.py``).  Decision records carry no ``uid``,
+        so :meth:`start`'s tenant fold skips them by construction.
     :param service_kwargs: everything else
         (:class:`~evox_tpu.service.OptimizationService` surface:
         ``health``, ``max_restarts``, ``checkpoint_every``,
@@ -196,6 +212,7 @@ class ServiceDaemon:
         primary: bool | None = None,
         preemption: Union[PreemptionGuard, bool, None] = True,
         on_event: Callable[[str], None] | None = None,
+        controller: Any | None = None,
         **service_kwargs: Any,
     ):
         if brownout_factor < 1:
@@ -239,6 +256,7 @@ class ServiceDaemon:
         if len(self.classes) != len(class_list):
             raise ValueError("duplicate TenantClass names")
         self.prewarm_specs = list(prewarm)
+        self.controller = controller
         self.service = OptimizationService(
             self.root,
             lanes_per_pack=lanes_per_pack,
@@ -248,11 +266,23 @@ class ServiceDaemon:
             preemption=preemption,
             store=store,
             on_event=on_event,
+            controller=controller,
             **service_kwargs,
         )
         self.journal = RequestJournal(
             self.root / self.JOURNAL_NAME, store=store
         )
+        if controller is not None and controller.journal is None:
+            # Decisions ride the daemon's own request journal (advisory
+            # appends; the tenant fold skips uid-less records).  A
+            # non-primary daemon's read-only store refuses the appends —
+            # the controller warns once and keeps deciding in-memory.
+            controller.journal = self.journal
+        # Controller-driven tenant evictions must be journal-acked like
+        # operator evictions (an acked evict parks on restart): route the
+        # service's trend-eviction seam through the daemon's durable
+        # evict.
+        self.service.evict_hook = self.evict
         if exec_cache is True:
             exec_cache = ExecutableCache(
                 self.root / self.EXEC_CACHE_DIR,
@@ -454,7 +484,7 @@ class ServiceDaemon:
         bucket = self.service._bucket_for(spec)
         label = _bucket_label(bucket.key)
         lengths = {self.segment_steps}
-        if self.brownout_threshold is not None and self.brownout_factor > 1:
+        if self._brownout_enter() is not None and self.brownout_factor > 1:
             lengths.add(self.segment_steps * self.brownout_factor)
         if all(n in bucket.pack._aot_segment for n in lengths) and (
             bucket.pack._aot_init is not None
@@ -514,8 +544,10 @@ class ServiceDaemon:
             # validation reject it with the truthful reason.
             self.service.submit(spec)
             raise AssertionError("collision must have been rejected")
-        if cls.sheddable and self._class_depth(cls.name) >= cls.queue_budget:
-            self._shed(spec, cls)
+        if cls.sheddable:
+            budget = self._effective_budget(cls)
+            if self._class_depth(cls.name) >= budget:
+                self._shed(spec, cls, budget)
         record = self.service.submit(spec)
         try:
             self._journal(
@@ -574,7 +606,29 @@ class ServiceDaemon:
         lanes = max(1, self.service.lanes_per_pack)
         return base + ahead // lanes
 
-    def _shed(self, spec: TenantSpec, cls: TenantClass) -> None:
+    def _effective_budget(self, cls: TenantClass) -> int:
+        """The class's live queue budget: the configured bound,
+        tightened by the controller's SLO-aware shed threshold when one
+        is armed (``slo_wait_seconds`` on the controller, fed by the
+        measured segment cadence).  A changed effective budget is one
+        journaled ``shed-threshold`` decision."""
+        if (
+            self.controller is None
+            or self.controller.slo_wait_seconds is None
+        ):
+            return cls.queue_budget
+        return self.controller.shed_threshold(
+            queue_budget=cls.queue_budget,
+            segment_seconds=self._last_segment_seconds,
+            lanes=self.service.lanes_per_pack,
+            tenant_class=cls.name,
+            generation=self.service.stats.segments_run,
+        )
+
+    def _shed(
+        self, spec: TenantSpec, cls: TenantClass, budget: int | None = None
+    ) -> None:
+        budget = cls.queue_budget if budget is None else budget
         hint = self._retry_after(cls)
         self.stats.sheds += 1
         self._inc(
@@ -588,11 +642,17 @@ class ServiceDaemon:
             if self._last_segment_seconds
             else ""
         )
+        tightened = (
+            f" (tightened from {cls.queue_budget} by the controller's "
+            f"SLO target)"
+            if budget != cls.queue_budget
+            else ""
+        )
         self.service._reject(
             spec,
             "shed",
             f"class {cls.name!r} is at its queue budget "
-            f"({cls.queue_budget}); retry after ~{hint} segment "
+            f"({budget}{tightened}); retry after ~{hint} segment "
             f"boundaries{seconds}",
             retry_after_segments=hint,
         )
@@ -602,12 +662,44 @@ class ServiceDaemon:
         bound = max(1, self.service.max_queue)
         return len(self.service._queue) / bound
 
+    def _brownout_enter(self) -> float | None:
+        """The live brown-out entry pressure: the controller's
+        ``brownout_enter`` override when set — an armed controller plane
+        must not be silently dead just because the daemon's own
+        threshold is ``None`` — else the daemon's configured
+        ``brownout_threshold``."""
+        if (
+            self.controller is not None
+            and self.controller.brownout_enter is not None
+        ):
+            return self.controller.brownout_enter
+        return self.brownout_threshold
+
     # Host-side boundary work (see the step-family scope note on start).
     def _update_brownout(self) -> None:  # graftlint: disable=GL005
-        if self.brownout_threshold is None or self.brownout_factor == 1:
+        enter = self._brownout_enter()
+        if enter is None or self.brownout_factor == 1:
             return
         pressure = self._queue_pressure()
-        if not self.brownout and pressure >= self.brownout_threshold:
+        if self.controller is not None:
+            # Controller hysteresis: the transition is a journaled
+            # decision (enter/exit thresholds in the evidence), the
+            # cadence change below is the act half.  Exception-guarded
+            # inside the controller — a failure decides "hold" and the
+            # cadence stays where it is.
+            action = self.controller.brownout(
+                pressure=pressure,
+                active=self.brownout,
+                enter=self.brownout_threshold,
+                generation=self.service.stats.segments_run,
+            )
+            transition = (action == "enter", action == "exit")
+        else:
+            transition = (
+                not self.brownout and pressure >= enter,
+                self.brownout and pressure <= enter / 2,
+            )
+        if transition[0]:
             self.brownout = True
             self.stats.brownout_entries += 1
             self.service.segment_steps = (
@@ -618,13 +710,13 @@ class ServiceDaemon:
                 "Times the daemon stretched segment cadence under load.",
             )
             self._event(
-                f"brown-out: queue pressure {pressure:.2f} >= "
-                f"{self.brownout_threshold}; segment cadence stretched "
+                f"brown-out: queue pressure {pressure:.2f} >= {enter}; "
+                f"segment cadence stretched "
                 f"{self.segment_steps} -> {self.service.segment_steps} "
                 f"(pre-warmed — no compile)",
                 warn=True,
             )
-        elif self.brownout and pressure <= self.brownout_threshold / 2:
+        elif transition[1]:
             self.brownout = False
             self.stats.brownout_exits += 1
             self.service.segment_steps = self.segment_steps

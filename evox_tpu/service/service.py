@@ -221,6 +221,20 @@ class OptimizationService:
         by tenant id).  ``None`` builds a default plane; ``False``
         disables instrumentation.  Strictly host-side at boundaries:
         the packed segment programs are identical either way.
+    :param controller: optional
+        :class:`~evox_tpu.control.Controller` — at every boundary where
+        a tenant's threshold verdict reads healthy, the controller
+        examines that tenant's flight window (requires a plane-level
+        :class:`~evox_tpu.obs.FlightRecorder`, which gives every tenant
+        a per-lane recorder) and may fire the graduated degradation
+        ladder early: trend verdict → journaled ``tenant`` decision →
+        restart (budget permitting) / quarantine / evict
+        (``evict_on_storm``).  Decisions are excluded from bit-identity
+        like ``num_preemptions``; a controller that fires none leaves
+        every tenant bit-identical to ``controller=None``
+        (``tests/test_control.py``).  Exception-guarded on both sides —
+        a controller failure degrades the tenant to threshold verdicts,
+        never wedges the pack.
     """
 
     def __init__(
@@ -240,6 +254,7 @@ class OptimizationService:
         monitor_factory: Callable[[], EvalMonitor] | None = None,
         on_event: Callable[[str], None] | None = None,
         obs: Union[Observability, bool, None] = None,
+        controller: Any | None = None,
     ):
         if lanes_per_pack < 1:
             raise ValueError(
@@ -274,6 +289,14 @@ class OptimizationService:
         )
         self.on_event = on_event
         self.obs = resolve_obs(obs, run_id=Path(root).name)
+        self.controller = controller
+        if controller is not None:
+            controller.bind(self.obs)
+        # Durable-eviction seam: a serving daemon installs its own
+        # journaled evict here so controller-driven evictions are
+        # journal-acked exactly like operator evictions (see
+        # :meth:`_evict_for_trend`).
+        self.evict_hook: Callable[[str], None] | None = None
         self.stats = ServiceStats()
         self._tenants: dict[str, TenantRecord] = {}
         self._tenants_by_uid: dict[int, TenantRecord] = {}
@@ -1013,6 +1036,31 @@ class OptimizationService:
             if record.generations >= record.spec.n_steps:
                 self._complete(bucket, record)
                 continue
+            if (
+                report.healthy
+                and self.controller is not None
+                and self.controller.trend_enabled
+            ):
+                # Trend overlay on a threshold-healthy lane: the
+                # controller reads the tenant's flight window and may
+                # fire the degradation ladder early.  An unhealthy
+                # threshold verdict below always wins unchanged.  (The
+                # trend_enabled gate matters at scale: consulting a
+                # cadence/shed-only controller would copy every
+                # tenant's flight ring per boundary for nothing.)
+                action, trend = self._controller_tenant(record)
+                if action == "evict":
+                    if self._evict_for_trend(record, trend):
+                        continue
+                if action in ("restart", "quarantine"):
+                    self._unhealthy(
+                        bucket,
+                        record,
+                        report.with_trend(
+                            [f"controller trend verdict: {trend.action}"]
+                        ),
+                    )
+                    continue
             if report.healthy:
                 if record.segments_since_checkpoint >= self.checkpoint_every:
                     self._checkpoint_tenant(
@@ -1023,6 +1071,80 @@ class OptimizationService:
 
     def _record_by_uid(self, uid: int) -> TenantRecord:
         return self._tenants_by_uid[uid]
+
+    def _evict_for_trend(self, record: TenantRecord, trend: Any) -> bool:
+        """Act on a controller ``evict`` decision, through the durable
+        seam when one is installed: a serving daemon sets
+        :attr:`evict_hook` to its own journaled evict so a
+        controller-driven eviction is journaled BEFORE the lane surgery
+        — an acked eviction must park on daemon restart, never silently
+        resume.  A failed hook (journal refused the record) leaves the
+        tenant RUNNING with a warning — an eviction that cannot be made
+        durable must not happen, and the threshold verdicts still cover
+        the lane; returns whether the eviction went through."""
+        evict = self.evict_hook if self.evict_hook is not None else self.evict
+        try:
+            evict(record.spec.tenant_id)
+        except Exception as e:  # noqa: BLE001 - never crash the boundary
+            self._note(
+                record,
+                f"controller eviction (trend verdict {trend.action}) "
+                f"could not be applied ({type(e).__name__}: {e}); tenant "
+                f"stays running on threshold verdicts",
+                warn=True,
+            )
+            return False
+        self._note(
+            record,
+            f"controller evicted (trend verdict {trend.action}); "
+            f"resubmit to resume",
+            warn=True,
+        )
+        return True
+
+    def _controller_tenant(
+        self, record: TenantRecord
+    ) -> tuple[str | None, Any]:
+        """Consult the controller for one threshold-healthy tenant:
+        ``(action, trend_decision)`` where action is ``"restart"`` /
+        ``"quarantine"`` / ``"evict"``, or ``(None, None)`` when no
+        trend verdict fired.  Never raises — a missing per-tenant flight
+        recorder degrades the controller's trend plane (structured
+        warning, threshold verdicts only), and any controller failure is
+        swallowed with a warning event (belt and braces over the
+        controller's own guards)."""
+        rows = None
+        if record.flight is not None:
+            try:
+                rows = record.flight.rows()
+            except Exception:  # noqa: BLE001 - detached/broken recorder
+                rows = None
+        try:
+            trend = self.controller.trend_verdict(
+                rows,
+                generation=record.generations,
+                tenant_id=record.spec.tenant_id,
+            )
+            if trend is None:
+                return None, None
+            decision = self.controller.tenant_action(
+                trend,
+                restarts_used=record.restarts,
+                max_restarts=self.max_restarts,
+                generation=record.generations,
+                tenant_id=record.spec.tenant_id,
+            )
+            return (decision.action if decision is not None else None), trend
+        except Exception as e:  # noqa: BLE001 - advisory plane only
+            self._event(
+                f"controller consult for tenant "
+                f"{record.spec.tenant_id!r} failed ({type(e).__name__}: "
+                f"{e}); threshold verdicts only",
+                warn=True,
+                category="control",
+                tenant_id=record.spec.tenant_id,
+            )
+            return None, None
 
     def _complete(self, bucket: _Bucket, record: TenantRecord) -> None:
         state = bucket.pack.lane_state(record.lane)

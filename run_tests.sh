@@ -92,6 +92,24 @@
 #                               bench_artifacts/).
 #                               Runs under a HARD wall-clock timeout like
 #                               --multihost.
+#   ./run_tests.sh --control    closed-loop control-plane lane: the
+#                               controller suite (NaN-robust flight trend
+#                               queries, pure evidence->action deciders,
+#                               earlier-or-equal trend restarts vs the
+#                               threshold-probe baseline, controller-on ==
+#                               controller-off bit-identity solo + packed,
+#                               daemon kill-restart decision-sequence
+#                               replay, torn-journal-tail survival,
+#                               detached-flight-recorder degradation),
+#                               then a full graftlint sweep (no control/
+#                               code may land in compiled scope —
+#                               GL002/GL003 stay clean), then
+#                               tools/bench_control_overhead.py asserting
+#                               a controller-on fused runner keeps >=98%
+#                               of controller-off throughput on the PSO
+#                               Ackley config (artifact under
+#                               bench_artifacts/).  Runs under a HARD
+#                               wall-clock timeout like --multihost.
 #   ./run_tests.sh --multihost  multi-host fleet lane: the fast multihost
 #                               suite (FleetTopology/bootstrap/heartbeat/
 #                               verdict plumbing, single-writer checkpoint
@@ -182,6 +200,18 @@ if [ "$1" = "--obs" ]; then
   # vacuously while a TPU box running this lane gates for real.
   python tools/check_bench_history.py || exit 1
   exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_obs_overhead.py
+fi
+if [ "$1" = "--control" ]; then
+  shift
+  # Hard timeout (SIGKILL escalation), same pattern as --multihost: a
+  # wedged pack or a stuck daemon restart must fail the lane loudly.
+  CONTROL_TIMEOUT="${EVOX_TPU_CONTROL_TIMEOUT:-1200}"
+  timeout -k 30 "$CONTROL_TIMEOUT" \
+    "${CPU_ENV[@]}" python -m pytest tests/test_control.py -q "$@" || exit 1
+  # No control-plane call site may land inside compiled scope: the full
+  # graftlint sweep (GL002/GL003 et al.) must stay clean vs baselines.
+  python -m tools.graftlint || exit 1
+  exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_control_overhead.py
 fi
 if [ "$1" = "--multihost" ]; then
   shift

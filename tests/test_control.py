@@ -1,0 +1,939 @@
+"""Closed-loop control plane: trend verdicts, cadence, degradation, replay.
+
+Contracts pinned here (``docs/guide/control.md``):
+
+* the flight trend queries (``window_slope``/``window_ema``/``last_n``)
+  are NaN-robust and shared between the controller and ad-hoc bundle
+  analysis;
+* every decision's action is a pure function of its journaled evidence
+  — a replayed journal reproduces the decision sequence bit-for-bit,
+  including across a daemon kill/restart and through a torn journal
+  tail;
+* a controller that fires no decision leaves a run (solo PSO/OpenES,
+  and a packed service tenant) bit-identical to a controller-less one —
+  decisions are excluded from bit-identity exactly like
+  ``num_preemptions``;
+* the chaos acceptance: an injected stagnation plateau + NaN burst
+  restarts *earlier or equal* under an active controller than under the
+  threshold-probe baseline, every decision journaled with evidence; a
+  detached flight recorder degrades the controller to threshold probes
+  with a structured warning and the run still completes.
+"""
+
+import math
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.algorithms import PSO, OpenES
+from evox_tpu.control import (
+    Controller,
+    Decision,
+    decide,
+    decide_brownout,
+    decide_cadence,
+    decide_shed,
+    decide_tenant,
+    decide_trend,
+)
+from evox_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    Observability,
+    last_n,
+    window_ema,
+    window_slope,
+)
+from evox_tpu.problems.numerical import Ackley, Sphere
+from evox_tpu.resilience import (
+    FaultyProblem,
+    FaultyStore,
+    HealthProbe,
+    ResilientRunner,
+    RollbackToCheckpoint,
+)
+from evox_tpu.resilience.runner import SegmentTiming
+from evox_tpu.service import (
+    OptimizationService,
+    ServiceDaemon,
+    TenantSpec,
+    TenantStatus,
+)
+from evox_tpu.service.journal import RequestJournal
+from evox_tpu.utils.checkpoint import read_manifest
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+POP, DIM = 16, 4
+LB = -32.0 * jnp.ones(DIM)
+UB = 32.0 * jnp.ones(DIM)
+
+NAN = float("nan")
+
+
+@pytest.fixture
+def key():
+    return jax.random.key(0)
+
+
+def _npify(x):
+    if isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key
+    ):
+        return np.asarray(jax.random.key_data(x))
+    return np.asarray(x)
+
+
+def assert_states_equal(a, b, context=""):
+    leaves_a = jax.tree_util.tree_leaves_with_path(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    for (path, la), lb_ in zip(leaves_a, leaves_b):
+        assert np.array_equal(_npify(la), _npify(lb_)), (
+            f"{context}: leaf {jax.tree_util.keystr(path)} differs"
+        )
+
+
+def _rows(values, signal="best_fitness", start_gen=1):
+    return [
+        {"generation": start_gen + i, signal: v}
+        for i, v in enumerate(values)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# flight trend queries (satellite: one shared, NaN-robust definition)
+# ---------------------------------------------------------------------------
+
+
+def test_window_slope_linear():
+    rows = _rows([10.0, 8.0, 6.0, 4.0, 2.0])
+    assert window_slope(rows, "best_fitness") == pytest.approx(-2.0)
+    # window restricts to the newest rows.
+    rows2 = _rows([0.0, 0.0, 0.0]) + _rows([4.0, 2.0], start_gen=4)
+    assert window_slope(rows2, "best_fitness", window=2) == pytest.approx(-2.0)
+
+
+def test_window_slope_nan_robust():
+    # Non-finite samples are skipped, never propagated.
+    rows = _rows([10.0, NAN, 6.0, float("inf"), 2.0])
+    assert window_slope(rows, "best_fitness") == pytest.approx(-2.0)
+    assert window_slope(_rows([NAN, NAN]), "best_fitness") is None
+    assert window_slope(_rows([1.0]), "best_fitness") is None
+    assert window_slope([], "best_fitness") is None
+    # All samples on one generation (rollback fold): no slope, not 0.
+    same = [{"generation": 5, "best_fitness": v} for v in (1.0, 2.0)]
+    assert window_slope(same, "best_fitness") is None
+
+
+def test_window_is_cut_over_rows_before_finite_filter():
+    """A NaN burst in the newest rows must shrink the estimate (fewer
+    points inside the window), never pull pre-burst stale history back
+    in — a trend rendered from old rows describes the wrong regime."""
+    stale = _rows([100.0, 80.0, 60.0, 40.0])          # old, steep
+    burst = _rows([NAN, NAN, NAN, NAN], start_gen=5)  # the newest window
+    assert window_slope(stale + burst, "best_fitness", window=4) is None
+    assert window_ema(stale + burst, "best_fitness", window=4) is None
+    # With one finite survivor in the window, the estimate uses it alone.
+    mixed = stale + _rows([NAN, 7.0, NAN], start_gen=5)
+    assert window_ema(mixed, "best_fitness", window=3) == 7.0
+    assert window_slope(mixed, "best_fitness", window=3) is None
+
+
+def test_window_ema_skips_nonfinite():
+    rows = _rows([4.0, NAN, 4.0, 4.0])
+    assert window_ema(rows, "best_fitness") == pytest.approx(4.0)
+    assert window_ema(_rows([NAN]), "best_fitness") is None
+    assert window_ema([], "best_fitness") is None
+    with pytest.raises(ValueError):
+        window_ema(rows, "best_fitness", alpha=0.0)
+
+
+def test_last_n_returns_raw_values():
+    rows = _rows([1.0, NAN, 3.0])
+    values = last_n(rows, "best_fitness", 2)
+    assert math.isnan(values[0]) and values[1] == 3.0
+    assert last_n(rows, "absent", 3) == []
+    with pytest.raises(ValueError):
+        last_n(rows, "best_fitness", 0)
+
+
+def test_recorder_trend_queries_match_module_functions(tmp_path):
+    rec = FlightRecorder(tmp_path / "pm", window=8)
+    signals = {"best_fitness": np.asarray([5.0, 4.0, 3.0, 2.0])}
+    rec.record_rows(signals, executed=4, start_generation=0)
+    assert rec.window_slope("best_fitness") == pytest.approx(-1.0)
+    assert rec.window_ema("best_fitness") == window_ema(
+        rec.rows(), "best_fitness"
+    )
+    assert rec.last_n("best_fitness", 2) == [3.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# pure deciders: evidence -> action (the replay contract)
+# ---------------------------------------------------------------------------
+
+
+def test_decide_trend_matrix():
+    base = {
+        "span": 10.0,
+        "stagnation_window": 8.0,
+        "stagnation_tol": 0.0,
+        "best_slope": 0.0,
+    }
+    assert decide_trend(base) == "stagnation"
+    # Improving fitness (negative slope in the minimizing frame): healthy.
+    assert decide_trend({**base, "best_slope": -1.0}) is None
+    # Window not yet spanned: no verdict.
+    assert decide_trend({**base, "span": 4.0}) is None
+    # Missing slope (all-NaN signal): no verdict, never a crash.
+    assert decide_trend({**base, "best_slope": None}) is None
+    collapse = {
+        "diversity_floor": 1e-3,
+        "diversity_ema": 2e-3,
+        "diversity_slope": -5e-4,
+        "collapse_horizon": 4.0,
+    }
+    assert decide_trend(collapse) == "collapse"  # 2e-3 - 4*5e-4 < 1e-3
+    assert decide_trend({**collapse, "diversity_slope": 5e-4}) is None
+    storm = {"storm_rate": 2.0, "nonfinite_slope": 3.0}
+    assert decide_trend(storm) == "storm"
+    assert decide_trend({**storm, "nonfinite_slope": 1.0}) is None
+    assert decide_trend({**base, **collapse, **storm}) == (
+        "stagnation+collapse+storm"
+    )
+
+
+def test_decide_cadence_quantizes_and_amortizes():
+    # Wall target: largest power of two within target_seconds.
+    ev = {
+        "per_gen_seconds": 0.01,
+        "boundary_seconds": 0.0,
+        "target_seconds": 0.05,
+        "overhead_cap": None,
+        "checkpoint_every": 64,
+    }
+    assert decide_cadence(ev) == 4  # 4*0.01 <= 0.05 < 8*0.01
+    # checkpoint_every caps growth.
+    assert decide_cadence({**ev, "checkpoint_every": 2}) == 2
+    # Boundary overhead grows the scan past the wall target.
+    heavy = {**ev, "boundary_seconds": 1.0, "overhead_cap": 0.5}
+    assert decide_cadence(heavy) == 64
+    # No target at all: overhead term alone sizes the chunk.
+    free = {
+        "per_gen_seconds": 0.01,
+        "boundary_seconds": 0.02,
+        "target_seconds": None,
+        "overhead_cap": 0.4,
+        "checkpoint_every": 64,
+    }
+    assert decide_cadence(free) == 64  # unbounded target -> every
+
+
+def test_decide_brownout_hysteresis():
+    assert decide_brownout(
+        {"pressure": 0.8, "enter": 0.75, "exit": 0.375, "active": False}
+    ) == "enter"
+    assert decide_brownout(
+        {"pressure": 0.5, "enter": 0.75, "exit": 0.375, "active": True}
+    ) == "hold"  # between exit and enter: hysteresis holds
+    assert decide_brownout(
+        {"pressure": 0.3, "enter": 0.75, "exit": 0.375, "active": True}
+    ) == "exit"
+    assert decide_brownout(
+        {"pressure": None, "enter": 0.75, "exit": 0.375, "active": False}
+    ) == "hold"
+
+
+def test_decide_shed_slo_budget():
+    ev = {
+        "queue_budget": 100,
+        "slo_wait_seconds": 10.0,
+        "segment_seconds": 2.0,
+        "lanes": 4,
+    }
+    assert decide_shed(ev) == 20  # floor(10/2) * 4
+    assert decide_shed({**ev, "segment_seconds": None}) == 100
+    assert decide_shed({**ev, "slo_wait_seconds": None}) == 100
+    # Never below 1: one tenant may always wait.
+    assert decide_shed({**ev, "segment_seconds": 1e6}) == 1
+
+
+def test_decide_tenant_ladder():
+    assert decide_tenant(
+        {"verdict": "stagnation", "restarts_used": 0, "max_restarts": 1}
+    ) == "restart"
+    assert decide_tenant(
+        {"verdict": "stagnation", "restarts_used": 1, "max_restarts": 1}
+    ) == "quarantine"
+    assert decide_tenant(
+        {
+            "verdict": "stagnation+storm",
+            "restarts_used": 0,
+            "max_restarts": 1,
+            "evict_on_storm": True,
+        }
+    ) == "evict"
+    # Without the opt-in, a storm rides the restart/quarantine ladder.
+    assert decide_tenant(
+        {"verdict": "storm", "restarts_used": 0, "max_restarts": 1}
+    ) == "restart"
+
+
+def test_decide_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        decide("no-such-kind", {})
+
+
+# ---------------------------------------------------------------------------
+# controller unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_controller_quiet_window_after_firing():
+    ctl = Controller(stagnation_window=3, grace=10)
+    flat = _rows([1.0] * 8)
+    assert ctl.trend_verdict(flat, generation=8) is not None
+    # The rolled-back window must not instantly re-trip the detector.
+    assert ctl.trend_verdict(flat, generation=9) is None
+    assert ctl.trend_verdict(flat, generation=19) is not None
+
+
+def test_controller_detached_rows_degrade_once():
+    ctl = Controller(stagnation_window=3)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert ctl.trend_verdict(None, generation=4) is None
+        assert ctl.trend_verdict(None, generation=8) is None
+    assert ctl.degraded
+    assert [d.kind for d in ctl.decisions] == ["degrade"]
+    assert ctl.decisions[0].action == "threshold-probes"
+    assert ctl.decisions[0].evidence["plane"] == "trend"
+    assert any("degraded" in str(w.message) for w in caught)
+
+
+def test_controller_survives_broken_rows():
+    class Bomb:
+        def __getitem__(self, k):
+            raise RuntimeError("poisoned row")
+
+        def __contains__(self, k):
+            return True
+
+    ctl = Controller(stagnation_window=3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert ctl.trend_verdict([Bomb()] * 8, generation=8) is None
+    assert ctl.degraded and ctl.failures
+
+
+def test_controller_journal_append_failure_is_advisory(tmp_path):
+    store = FaultyStore(enospc_saves=list(range(16)))
+    journal = RequestJournal(tmp_path / "j.jsonl", store=store)
+    ctl = Controller(stagnation_window=3, journal=journal)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        decision = ctl.trend_verdict(_rows([1.0] * 8), generation=8)
+    assert decision is not None  # the decision still applies
+    assert ctl.journal_append_failures >= 1
+    assert any("journal append failed" in str(w.message) for w in caught)
+
+
+def test_cadence_ema_skips_rollback_segments():
+    timings = [
+        SegmentTiming(8, 0.0, 0.8, 0.0),
+        SegmentTiming(4, 0.0, 0.8, 0.0),  # rollback: generation went back
+        SegmentTiming(12, 0.0, 0.8, 0.0),
+    ]
+    per_gen, _ = Controller._cadence_ema(timings)
+    assert per_gen == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: earlier-or-equal restart + journaled evidence + replay
+# ---------------------------------------------------------------------------
+
+
+def _plateau_runner(tmp_path, tag, *, controller, key, n_steps=29):
+    """A PSO run wedged on an injected stagnation plateau (every fitness
+    clamped up to 1e6 from eval 0) with a NaN burst at eval 3 (quarantined
+    — it feeds the flight counters, not the state)."""
+    wf = StdWorkflow(
+        PSO(POP, LB, UB),
+        FaultyProblem(
+            Sphere(), plateau_from=0, plateau_floor=1e6, nan_generations=[3]
+        ),
+        monitor=EvalMonitor(full_fit_history=True),
+    )
+    obs = Observability(
+        registry=MetricsRegistry(),
+        flight=FlightRecorder(tmp_path / tag / "pm", window=64),
+        run_id=tag,
+    )
+    runner = ResilientRunner(
+        wf,
+        tmp_path / tag,
+        checkpoint_every=4,
+        health=HealthProbe(stagnation_window=5, stagnation_tol=0.0),
+        restart=RollbackToCheckpoint(),
+        max_restarts=1,
+        obs=obs,
+        controller=controller,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        final = runner.run(wf.init(key), n_steps)
+    return runner, final
+
+
+def test_trend_restart_earlier_or_equal_and_journaled(tmp_path, key):
+    baseline, _ = _plateau_runner(tmp_path, "base", controller=None, key=key)
+    assert len(baseline.stats.restarts) == 1
+    journal = RequestJournal(tmp_path / "decisions.jsonl")
+    ctl = Controller(stagnation_window=6, journal=journal)
+    guided, _ = _plateau_runner(tmp_path, "ctl", controller=ctl, key=key)
+    assert len(guided.stats.restarts) == 1
+    # The whole point: the trend verdict fires BEFORE the probe's window
+    # elapses (earlier-or-equal restart generation; strictly earlier at
+    # this configuration).
+    assert (
+        guided.stats.restarts[0].generation
+        <= baseline.stats.restarts[0].generation
+    )
+    assert guided.stats.restarts[0].generation < 17
+    # The lineage records which plane fired, pointing at the decision.
+    detail = guided.stats.restarts[0].detail
+    assert detail["trend"] == "stagnation"
+    assert detail["decision_seq"] == 0
+    # Both runs complete their full budget despite the plateau.
+    assert guided.stats.completed_generations == 29
+    assert baseline.stats.completed_generations == 29
+    # Every decision journaled with its evidence, and the replayed
+    # journal reproduces the decision sequence bit-for-bit.
+    assert ctl.decisions and all(d.evidence for d in ctl.decisions)
+    records, damage = journal.replay()
+    assert damage is None
+    replayed = Controller.replay_decisions(records)
+    assert [d.to_manifest() for d in replayed] == [
+        d.to_manifest() for d in ctl.decisions
+    ]
+    # Trend evidence names the measured signals AND the thresholds.
+    trend_evidence = replayed[0].evidence
+    assert trend_evidence["best_slope"] is not None
+    assert trend_evidence["stagnation_window"] == 6.0
+
+
+def test_detached_flight_recorder_degrades_and_completes(tmp_path, key):
+    """Flight recorder detached mid-run: the controller degrades to the
+    threshold probes with a structured warning event, and the run (incl.
+    the probe-driven restart) still completes."""
+    wf = StdWorkflow(
+        PSO(POP, LB, UB),
+        FaultyProblem(Sphere(), plateau_from=0, plateau_floor=1e6),
+        monitor=EvalMonitor(full_fit_history=True),
+    )
+    obs = Observability(
+        registry=MetricsRegistry(),
+        flight=FlightRecorder(tmp_path / "pm", window=64),
+        run_id="detach",
+    )
+    ctl = Controller(stagnation_window=6)
+    runner = ResilientRunner(
+        wf,
+        tmp_path / "run",
+        checkpoint_every=4,
+        health=HealthProbe(stagnation_window=5),
+        restart=RollbackToCheckpoint(),
+        max_restarts=1,
+        obs=obs,
+        controller=ctl,
+    )
+    # Detach mid-run: after the first boundary consult, the recorder's
+    # read surface starts failing (a GC'd/closed recorder).
+    calls = {"n": 0}
+    original_rows = obs.flight.rows
+
+    def flaky_rows():
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise RuntimeError("flight recorder detached")
+        return original_rows()
+
+    obs.flight.rows = flaky_rows
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        runner.run(wf.init(key), 29)
+    assert runner.stats.completed_generations == 29
+    assert ctl.degraded  # trend plane latched off
+    assert [d.kind for d in ctl.decisions if d.kind == "degrade"]
+    # The threshold probe still fired the restart (the baseline behavior
+    # the controller degrades to).
+    assert len(runner.stats.restarts) == 1
+    assert "trend" not in runner.stats.restarts[0].detail
+    # The degrade warning is a structured control event on the bus.
+    events = [
+        e
+        for e in obs.ring.events()
+        if e.category == "control" and e.severity == "warning"
+    ]
+    assert any("degraded" in e.message for e in events)
+
+
+def test_self_tuning_cadence_decisions_replayable(tmp_path, key):
+    wf = StdWorkflow(PSO(POP, LB, UB), Sphere(), monitor=EvalMonitor())
+    journal = RequestJournal(tmp_path / "j.jsonl")
+    # A micro target far below one 16-gen segment forces the chunk down;
+    # decisions are journaled on every change.
+    ctl = Controller(target_seconds=1e-6, journal=journal)
+    runner = ResilientRunner(
+        wf, tmp_path / "run", checkpoint_every=16, obs=False, controller=ctl
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        runner.run(wf.init(key), 49)
+    assert runner.stats.completed_generations == 49
+    # The adapted chunks are power-of-two (plus the ragged tail) and the
+    # tiny target drove them to 1.
+    assert all(
+        c == 1 or (c & (c - 1)) == 0 for c in runner.stats.chunk_sizes
+    )
+    assert 1 in runner.stats.chunk_sizes
+    cadence = [d for d in ctl.decisions if d.kind == "cadence"]
+    assert cadence and cadence[0].action == "1"
+    records, _ = journal.replay()
+    assert [d.to_manifest() for d in Controller.replay_decisions(records)] == [
+        d.to_manifest() for d in ctl.decisions
+    ]
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: controller-on (no decision fired) == controller-off
+# ---------------------------------------------------------------------------
+
+
+def _algorithms():
+    return {
+        "pso": lambda: PSO(POP, LB, UB),
+        "openes": lambda: OpenES(
+            pop_size=POP,
+            center_init=jnp.full((DIM,), 3.0),
+            learning_rate=0.1,
+            noise_stdev=0.1,
+            optimizer="adam",
+        ),
+    }
+
+
+def _newest_digests(ckpt_dir):
+    newest = sorted(p for p in ckpt_dir.glob("ckpt_*.npz"))[-1]
+    return newest.name, read_manifest(newest)["leaf_digests"]
+
+
+def _identity_run(tmp_path, tag, algo_factory, *, controller, key):
+    mon = EvalMonitor(full_fit_history=True)
+    wf = StdWorkflow(algo_factory(), Sphere(), monitor=mon)
+    obs = Observability(
+        registry=MetricsRegistry(),
+        flight=FlightRecorder(tmp_path / tag / "pm", window=64),
+        run_id=tag,
+    )
+    runner = ResilientRunner(
+        wf,
+        tmp_path / tag,
+        checkpoint_every=4,
+        health=HealthProbe(stagnation_window=5),
+        restart=RollbackToCheckpoint(),
+        obs=obs,
+        controller=controller,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        final = runner.run(wf.init(key), 11)
+    return final, mon
+
+
+def _non_firing_controller():
+    # Every detector armed, none able to fire in an 11-generation healthy
+    # run: the stagnation span never fills, the diversity floor is
+    # unreachable (horizon 0 = no extrapolation, so a healthy run's
+    # early diversity drop cannot project below it), the storm rate is
+    # absurd.
+    return Controller(
+        stagnation_window=10_000,
+        diversity_floor=1e-300,
+        collapse_horizon=0,
+        storm_rate=1e12,
+    )
+
+
+@pytest.mark.parametrize("algo", sorted(_algorithms()))
+def test_bit_identity_controller_on_vs_off_solo(tmp_path, key, algo):
+    """Satellite: controller decisions are excluded from bit-identity
+    the way num_preemptions is — with no decision fired, controller-on
+    equals controller-off to the bit (final state, history, checkpoint
+    leaf digests)."""
+    factory = _algorithms()[algo]
+    ctl = _non_firing_controller()
+    final_on, mon_on = _identity_run(
+        tmp_path, f"{algo}-on", factory, controller=ctl, key=key
+    )
+    final_off, mon_off = _identity_run(
+        tmp_path, f"{algo}-off", factory, controller=None, key=key
+    )
+    assert not ctl.decisions  # genuinely the no-decision regime
+    assert_states_equal(final_on, final_off, context=algo)
+    hist_on = [np.asarray(f) for f in mon_on.fitness_history]
+    hist_off = [np.asarray(f) for f in mon_off.fitness_history]
+    assert len(hist_on) == len(hist_off) > 0
+    for a, b in zip(hist_on, hist_off):
+        np.testing.assert_array_equal(a, b)
+    name_on, dig_on = _newest_digests(tmp_path / f"{algo}-on")
+    name_off, dig_off = _newest_digests(tmp_path / f"{algo}-off")
+    assert (name_on, dig_on) == (name_off, dig_off)
+
+
+def _service(root, *, controller, flight_dir):
+    obs = Observability(
+        registry=MetricsRegistry(),
+        flight=FlightRecorder(flight_dir, window=64),
+        run_id="svc",
+    )
+    return OptimizationService(
+        root,
+        lanes_per_pack=4,
+        segment_steps=4,
+        seed=0,
+        max_restarts=1,
+        obs=obs,
+        controller=controller,
+    )
+
+
+def test_bit_identity_controller_on_vs_off_packed(tmp_path):
+    """The packed-tenant half of the bit-identity satellite."""
+
+    def spec():
+        return TenantSpec(
+            "alice", PSO(8, LB, UB), Ackley(), n_steps=12, uid=7
+        )
+
+    results = {}
+    for tag, controller in (
+        ("on", _non_firing_controller()),
+        ("off", None),
+    ):
+        svc = _service(
+            tmp_path / tag, controller=controller, flight_dir=tmp_path / f"pm-{tag}"
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            svc.submit(spec())
+            svc.run()
+        assert svc.tenant("alice").status is TenantStatus.COMPLETED
+        results[tag] = svc.result("alice")
+        if controller is not None:
+            assert not controller.decisions
+    assert_states_equal(results["on"], results["off"], context="packed")
+
+
+# ---------------------------------------------------------------------------
+# service: graduated degradation from per-tenant trends
+# ---------------------------------------------------------------------------
+
+
+def _lane_plateau_spec(name, uid, n_steps=40):
+    problem = FaultyProblem(
+        Sphere(),
+        lane_faults={uid: dict(plateau_from=0, plateau_floor=1e6)},
+    )
+    return TenantSpec(name, PSO(8, LB, UB), problem, n_steps=n_steps, uid=uid)
+
+
+def test_service_trend_restart_then_quarantine(tmp_path):
+    ctl = Controller(stagnation_window=6)
+    svc = _service(tmp_path / "svc", controller=ctl, flight_dir=tmp_path / "pm")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        svc.submit(_lane_plateau_spec("plateau", 0))
+        svc.submit(
+            TenantSpec("healthy", PSO(8, LB, UB), Sphere(), n_steps=12, uid=1)
+        )
+        svc.run(max_rounds=14)
+    plateau = svc.tenant("plateau")
+    # Graduated ladder: one trend-driven restart, then quarantine once
+    # the budget is spent — while the healthy cotenant completes.
+    assert plateau.status is TenantStatus.QUARANTINED
+    assert plateau.restarts == 1
+    assert svc.tenant("healthy").status is TenantStatus.COMPLETED
+    kinds = [(d.kind, d.action) for d in ctl.decisions]
+    assert ("trend", "stagnation") in kinds
+    assert ("tenant", "restart") in kinds
+    assert ("tenant", "quarantine") in kinds
+    assert all(
+        d.tenant_id == "plateau" for d in ctl.decisions if d.kind == "tenant"
+    )
+
+
+def test_service_trend_evict_on_storm(tmp_path):
+    """evict_on_storm parks a NaN-bursting tenant on its checkpoint
+    instead of burning restarts replaying the poisoned window."""
+    ctl = Controller(storm_rate=1.0, evict_on_storm=True, grace=0)
+    svc = _service(tmp_path / "svc", controller=ctl, flight_dir=tmp_path / "pm")
+    burst = TenantSpec(
+        "burst",
+        PSO(8, LB, UB),
+        FaultyProblem(
+            Sphere(),
+            lane_faults={
+                0: dict(nan_generations=list(range(2, 30)), nan_rows=4)
+            },
+        ),
+        n_steps=40,
+        uid=0,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        svc.submit(burst)
+        svc.run(max_rounds=10)
+    record = svc.tenant("burst")
+    assert record.status is TenantStatus.EVICTED
+    assert record.restarts == 0  # parked, not restarted
+    tenant_decisions = [d for d in ctl.decisions if d.kind == "tenant"]
+    assert tenant_decisions and tenant_decisions[0].action == "evict"
+    assert "storm" in tenant_decisions[0].evidence["verdict"]
+
+
+# ---------------------------------------------------------------------------
+# daemon: controller-driven brown-out, SLO shed, kill/restart replay
+# ---------------------------------------------------------------------------
+
+
+def _pso_spec(name, uid, n_steps=12):
+    return TenantSpec(
+        name, PSO(8, LB, UB), Ackley(), n_steps=n_steps, uid=uid
+    )
+
+
+def _make_daemon(root, controller=None, **overrides):
+    kwargs = dict(
+        lanes_per_pack=2,
+        segment_steps=4,
+        max_queue=4,
+        seed=0,
+        preemption=False,
+        brownout_threshold=0.5,
+        brownout_factor=2,
+        exec_cache=None,
+        controller=controller,
+    )
+    kwargs.update(overrides)
+    return ServiceDaemon(root, **kwargs)
+
+
+def test_daemon_brownout_runs_on_controller_hysteresis(tmp_path):
+    ctl = Controller()
+    daemon = _make_daemon(tmp_path / "svc", controller=ctl)
+    daemon.start()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(4):
+            daemon.submit(_pso_spec(f"t{i}", i))
+        daemon.run()
+    assert daemon.stats.brownout_entries == 1
+    assert daemon.stats.brownout_exits == 1
+    transitions = [
+        (d.action, d.evidence["pressure"])
+        for d in ctl.decisions
+        if d.kind == "brownout"
+    ]
+    assert [a for a, _ in transitions] == ["enter", "exit"]
+    # The hysteresis thresholds ride in the evidence.
+    enter = next(d for d in ctl.decisions if d.action == "enter")
+    assert enter.evidence["enter"] == 0.5
+    assert enter.evidence["exit"] == 0.25
+
+
+def test_daemon_brownout_armed_by_controller_enter_alone(tmp_path):
+    """Controller(brownout_enter=...) must engage even when the daemon's
+    own brownout_threshold is None — an armed plane is never silently
+    dead."""
+    ctl = Controller(brownout_enter=0.5)
+    daemon = _make_daemon(
+        tmp_path / "svc", controller=ctl, brownout_threshold=None
+    )
+    daemon.start()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(4):
+            daemon.submit(_pso_spec(f"t{i}", i))
+        daemon.run()
+    assert daemon.stats.brownout_entries == 1
+    enter = next(d for d in ctl.decisions if d.action == "enter")
+    assert enter.evidence["enter"] == 0.5
+
+
+def test_controller_evict_through_daemon_is_journaled_and_parks(tmp_path):
+    """A controller-driven eviction under a daemon routes through the
+    daemon's journaled evict (the durable seam): the 'evict' record is
+    appended, and a restarted daemon PARKS the tenant instead of
+    silently resuming it."""
+    root = tmp_path / "svc"
+    ctl = Controller(storm_rate=1.0, evict_on_storm=True, grace=0)
+    obs = Observability(
+        registry=MetricsRegistry(),
+        flight=FlightRecorder(tmp_path / "pm", window=64),
+        run_id="svc",
+    )
+    daemon = _make_daemon(
+        root, controller=ctl, lanes_per_pack=4, obs=obs, max_restarts=1
+    )
+    daemon.start()
+    burst = TenantSpec(
+        "burst",
+        PSO(8, LB, UB),
+        FaultyProblem(
+            Sphere(),
+            lane_faults={
+                0: dict(nan_generations=list(range(2, 30)), nan_rows=4)
+            },
+        ),
+        n_steps=40,
+        uid=0,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        daemon.submit(burst)
+        daemon.run(max_rounds=10)
+    assert daemon.tenant("burst").status is TenantStatus.EVICTED
+    records, _ = daemon.journal.replay()
+    assert any(r.kind == "evict" for r in records)
+    del daemon  # SIGKILL modelled as abandonment
+
+    restarted = _make_daemon(
+        root,
+        controller=Controller(),
+        lanes_per_pack=4,
+        max_restarts=1,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        restarted.start()
+        restarted.run()
+    # Parked, not resurrected: the acked eviction survives the restart.
+    assert restarted.tenant("burst").status is TenantStatus.EVICTED
+
+
+def test_daemon_slo_shed_threshold_recomputed_from_live_timings(tmp_path):
+    # A 1-microsecond SLO: once a segment time is measured, every class
+    # budget collapses to the floor of 1 waiting tenant, so the second
+    # queued submission of the round sheds where the configured budget
+    # (4) would have held.
+    ctl = Controller(slo_wait_seconds=1e-6)
+    daemon = _make_daemon(tmp_path / "svc", controller=ctl)
+    daemon.start()
+    from evox_tpu.service import AdmissionError
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        daemon.submit(_pso_spec("a", 0, n_steps=24))
+        daemon.submit(_pso_spec("b", 1, n_steps=24))
+        daemon.step()  # measures _last_segment_seconds; a+b hold lanes
+        daemon.submit(_pso_spec("c", 2, n_steps=24))  # 1 waiting: at budget
+        with pytest.raises(AdmissionError) as excinfo:
+            daemon.submit(_pso_spec("d", 3, n_steps=24))
+    assert excinfo.value.reason == "shed"
+    assert excinfo.value.retry_after_segments >= 1
+    shed = [d for d in ctl.decisions if d.kind == "shed-threshold"]
+    assert shed and shed[-1].action == "1"
+    assert shed[-1].evidence["segment_seconds"] > 0
+    assert daemon.stats.sheds == 1
+
+
+def test_daemon_kill_restart_replays_identical_decision_sequence(tmp_path):
+    """Satellite: kill the daemon mid-run; the restarted process replays
+    the journaled decisions and recomputing every action from the
+    journaled evidence reproduces the identical sequence bit-for-bit."""
+    root = tmp_path / "svc"
+    ctl = Controller()
+    daemon = _make_daemon(root, controller=ctl)
+    daemon.start()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(4):
+            daemon.submit(_pso_spec(f"t{i}", i, n_steps=16))
+        daemon.run(max_rounds=1)  # brown-out enters here
+    live = [d.to_manifest() for d in ctl.decisions]
+    assert any(d["kind"] == "brownout" for d in live)
+    del daemon  # SIGKILL modelled as abandonment: no shutdown code runs
+
+    restarted = _make_daemon(root, controller=Controller())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        restored = restarted.start()
+    assert restored == 4
+    records, damage = restarted.journal.replay()
+    assert damage is None
+    replayed = Controller.replay_decisions(records)
+    # Same decision sequence, bit-for-bit, recomputed from the evidence.
+    assert [d.to_manifest() for d in replayed] == live
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        restarted.run()
+    for i in range(4):
+        assert (
+            restarted.tenant(f"t{i}").status is TenantStatus.COMPLETED
+        )
+
+
+def test_decision_replay_survives_torn_journal_tail(tmp_path):
+    """A torn decision record is quarantined with the tail; the trusted
+    prefix still replays bit-for-bit and the daemon restarts cleanly."""
+    root = tmp_path / "svc"
+    ctl = Controller()
+    daemon = _make_daemon(root, controller=ctl)
+    daemon.start()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(4):
+            daemon.submit(_pso_spec(f"t{i}", i, n_steps=16))
+        daemon.run(max_rounds=1)
+    live = [d.to_manifest() for d in ctl.decisions]
+    assert live
+    del daemon
+    # The crash tore a decision record mid-append.
+    with open(root / ServiceDaemon.JOURNAL_NAME, "ab") as f:
+        f.write(b'{"body":{"seq":99,"kind":"decision","data":{"decisi')
+
+    restarted = _make_daemon(root, controller=Controller())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert restarted.start() == 4
+    assert len(restarted.stats.journal_damage) == 1
+    records, _ = restarted.journal.replay()
+    assert [d.to_manifest() for d in Controller.replay_decisions(records)] == (
+        live
+    )
+
+
+# ---------------------------------------------------------------------------
+# decision record round trip
+# ---------------------------------------------------------------------------
+
+
+def test_decision_manifest_round_trip():
+    d = Decision(
+        seq=3,
+        kind="trend",
+        generation=42,
+        action="stagnation+storm",
+        policy="trend",
+        evidence={"best_slope": -0.0, "span": 12.0, "storm_rate": 2.0},
+        tenant_id="alice",
+    )
+    assert Decision.from_manifest(d.to_manifest()) == d
+    # Unknown keys from a future schema are tolerated.
+    extended = {**d.to_manifest(), "future_field": 1}
+    assert Decision.from_manifest(extended) == d

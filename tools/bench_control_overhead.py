@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""CPU microbenchmark: wall-clock cost of the closed-loop control plane.
+
+The controller is strictly advisory and strictly host-side: at every
+segment boundary it reads the flight recorder's (already device_get-ed)
+ring, runs a few dozen floating-point operations of trend math, and —
+when nothing fires — changes nothing.  Both sides of this A/B therefore
+execute the IDENTICAL compiled program (both carry the flight recorder,
+which is what the controller reads), so any throughput difference is
+pure host overhead: gated at >=98% of controller-off, the same floor the
+obs plane's host-side instrumentation holds.
+
+The controller side arms every trend detector with thresholds a healthy
+run cannot trip (the no-decision regime the bit-identity contract pins),
+so the gate measures the steady-state consult cost — the price every
+healthy boundary pays — not the cost of a restart that would dwarf it.
+
+FAILS (exit 1) when the floor is violated.
+
+Methodology mirrors ``tools/bench_obs_overhead.py``: one warmed runner
+per side (AOT executables compile exactly once), interleaved repeats so
+machine drift hits both sides alike, tmpfs checkpoints when available,
+best-of-N per side (instrumentation cost survives in the minimum;
+scheduler noise does not).
+
+Run via::
+
+    ./run_tests.sh --control        # suite + graftlint sweep + this gate
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_control_overhead.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.algorithms import PSO  # noqa: E402
+from evox_tpu.control import Controller  # noqa: E402
+from evox_tpu.obs import (  # noqa: E402
+    FlightRecorder,
+    MetricsRegistry,
+    Observability,
+)
+from evox_tpu.problems.numerical import Ackley  # noqa: E402
+from evox_tpu.resilience import HealthProbe, ResilientRunner  # noqa: E402
+from evox_tpu.workflows import StdWorkflow  # noqa: E402
+
+N_STEPS = 200
+CHUNK = 25  # generations per fused segment (= checkpoint cadence)
+POP, DIM = 1024, 100  # the PSO Ackley dispatch-bound bench config
+REPEATS = 7
+# Same compiled program on both sides: pure host cost, same floor as the
+# plane-only obs gate.
+FLOOR = 0.98
+
+LB = -32.0 * jnp.ones(DIM)
+UB = 32.0 * jnp.ones(DIM)
+
+
+def _non_firing_controller() -> Controller:
+    # Every trend detector armed, none able to fire on a healthy run:
+    # the steady-state consult cost is what the gate measures.
+    return Controller(
+        stagnation_window=1_000_000,
+        diversity_floor=1e-300,
+        collapse_horizon=0,
+        storm_rate=1e12,
+    )
+
+
+def _make_runner(workdir: str, tag: str, with_controller: bool):
+    ckpt_dir = os.path.join(workdir, tag)
+    obs = Observability(
+        registry=MetricsRegistry(),
+        flight=FlightRecorder(
+            os.path.join(ckpt_dir, "postmortems"), window=256
+        ),
+        run_id=tag,
+    )
+    wf = StdWorkflow(PSO(POP, LB, UB), Ackley())
+    runner = ResilientRunner(
+        wf,
+        ckpt_dir,
+        checkpoint_every=CHUNK,
+        health=HealthProbe(),
+        obs=obs,
+        controller=_non_firing_controller() if with_controller else None,
+    )
+    state = wf.init(jax.random.key(0))
+    return runner, state
+
+
+def _timed_run(runner, state) -> float:
+    t0 = time.perf_counter()
+    runner.run(state, N_STEPS, fresh=True)
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    workdir = tempfile.mkdtemp(prefix="evox_control_bench_", dir=base)
+    modes = {"off": False, "on": True}
+    try:
+        sides = {m: _make_runner(workdir, m, flag) for m, flag in modes.items()}
+        for runner, state in sides.values():  # warm: compiles amortized out
+            _timed_run(runner, state)
+        seconds = {m: [] for m in modes}
+        for _ in range(REPEATS):
+            for m in modes:
+                seconds[m].append(_timed_run(*sides[m]))
+        fired = [
+            d.to_manifest()
+            for d in (sides["on"][0].controller.decisions or [])
+        ]
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    if fired:
+        # A decision firing would change control flow and invalidate the
+        # A/B: the config above must stay in the no-decision regime.
+        print(
+            f"FAIL: the supposedly non-firing controller fired "
+            f"{len(fired)} decision(s): {fired[:3]}",
+            file=sys.stderr,
+        )
+        return 1
+
+    gps = {m: N_STEPS / min(seconds[m]) for m in modes}
+    ratio = gps["on"] / gps["off"]
+    result = {
+        "bench": "control_plane_overhead",
+        "backend": jax.default_backend(),
+        "n_steps": N_STEPS,
+        "chunk": CHUNK,
+        "pop_size": POP,
+        "dim": DIM,
+        "repeats": REPEATS,
+        "seconds": seconds,
+        "gens_per_sec": gps,
+        "throughput_ratio": ratio,
+        "floor_ratio": FLOOR,
+        "within_budget": ratio >= FLOOR,
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(
+        out_dir, f"control_overhead.{jax.default_backend()}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"control-plane overhead ({N_STEPS} gens in {CHUNK}-gen fused "
+        f"segments, best-of-{REPEATS}):\n"
+        f"  controller-off {gps['off']:7.1f} gen/s\n"
+        f"  controller-on  {gps['on']:7.1f} gen/s = {ratio * 100:5.1f}% "
+        f"(floor {FLOOR * 100:.0f}% — identical program, host consult "
+        f"cost only)"
+    )
+    print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+    if ratio < FLOOR:
+        print(
+            f"FAIL: controller-on throughput {ratio * 100:.1f}% is under "
+            f"the {FLOOR * 100:.0f}% floor",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

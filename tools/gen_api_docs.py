@@ -40,6 +40,7 @@ NAMESPACES = [
     ("resilience", "evox_tpu.resilience"),
     ("service", "evox_tpu.service"),
     ("obs", "evox_tpu.obs"),
+    ("control", "evox_tpu.control"),
     ("metrics", "evox_tpu.metrics"),
     ("utils", "evox_tpu.utils"),
     ("vis_tools", "evox_tpu.vis_tools"),
