@@ -156,18 +156,37 @@ def decide_cadence(evidence: Mapping[str, Any]) -> int:
 
 def decide_brownout(evidence: Mapping[str, Any]) -> str:
     """Brown-out transition with hysteresis: ``"enter"`` when inactive
-    and queue pressure reaches ``enter``, ``"exit"`` when active and
-    pressure has fallen to ``exit`` or below, else ``"hold"``."""
+    and queue pressure reaches ``enter`` OR the SLO burn rate reaches
+    ``burn_enter`` (the formalized-objective trigger — evidence carries
+    ``burn_rate`` when the controller has an :class:`~evox_tpu.obs.SLOTracker`
+    attached), ``"exit"`` when active and every armed signal has calmed
+    (pressure at/below ``exit``, burn at/below ``burn_exit``), else
+    ``"hold"``.  Evidence without the burn keys (pre-SLO journals)
+    reproduces the original pressure-only hysteresis bit-for-bit."""
     pressure = _num(evidence, "pressure")
     enter = _num(evidence, "enter")
     exit_ = _num(evidence, "exit")
+    burn = _num(evidence, "burn_rate")
+    burn_enter = _num(evidence, "burn_enter")
+    burn_exit = _num(evidence, "burn_exit")
     active = bool(evidence.get("active"))
-    if pressure is None:
+    if pressure is None and burn is None:
         return "hold"
-    if not active and enter is not None and pressure >= enter:
+    over_pressure = (
+        pressure is not None and enter is not None and pressure >= enter
+    )
+    over_burn = (
+        burn is not None and burn_enter is not None and burn >= burn_enter
+    )
+    if not active and (over_pressure or over_burn):
         return "enter"
-    if active and exit_ is not None and pressure <= exit_:
-        return "exit"
+    if active and (exit_ is not None or burn_exit is not None):
+        pressure_calm = (
+            exit_ is None or pressure is None or pressure <= exit_
+        )
+        burn_calm = burn_exit is None or burn is None or burn <= burn_exit
+        if pressure_calm and burn_calm:
+            return "exit"
     return "hold"
 
 
@@ -176,14 +195,22 @@ def decide_shed(evidence: Mapping[str, Any]) -> int:
     ``queue_budget``, tightened so a tenant admitted at the back of the
     queue still lands within ``slo_wait_seconds`` at the measured
     ``segment_seconds`` cadence (``lanes`` tenants drain per segment
-    wave).  Unknown timing leaves the configured budget untouched."""
+    wave); tightened again — halved — while the class's SLO error budget
+    is exhausted (``budget_remaining <= 0`` in the evidence: admitting
+    at full rate while the objective is already violated digs the hole
+    deeper).  Unknown timing / absent SLO evidence leaves each term
+    untouched, so pre-SLO journals replay bit-for-bit."""
     budget = int(_num(evidence, "queue_budget") or 0)
     slo = _num(evidence, "slo_wait_seconds")
     seconds = _num(evidence, "segment_seconds")
     lanes = max(int(_num(evidence, "lanes") or 1), 1)
-    if not slo or not seconds or seconds <= 0.0:
-        return budget
-    return min(budget, max(1, int(slo / seconds) * lanes))
+    effective = budget
+    if slo and seconds and seconds > 0.0:
+        effective = min(budget, max(1, int(slo / seconds) * lanes))
+    remaining = _num(evidence, "budget_remaining")
+    if remaining is not None and remaining <= 0.0:
+        effective = max(1, effective // 2)
+    return effective
 
 
 def decide_tenant(evidence: Mapping[str, Any]) -> str:
@@ -288,6 +315,19 @@ class Controller:
     :param slo_wait_seconds: arm SLO-aware shed thresholds — admission
         class budgets are tightened so queued tenants land within this
         many seconds at the live measured segment cadence.
+    :param slo: optional :class:`~evox_tpu.obs.SLOTracker` — the
+        formalized objectives behind degradation decisions.  When
+        attached, the worst matching burn rate / budget remaining rides
+        the journaled evidence: brown-out entry additionally triggers on
+        ``burn_rate >= brownout_burn`` (exit requires burn back under
+        half of it), and a class whose error budget is exhausted
+        (``budget_remaining <= 0``) has its shed threshold halved.  The
+        daemon wires its own tracker in automatically (first binder
+        wins); a failed tracker consult degrades the owning plane like
+        any other controller failure.
+    :param brownout_burn: SLO burn-rate threshold for brown-out entry
+        (e.g. ``2.0`` = budget burning at twice the sustainable rate);
+        ``None`` disables the burn trigger even with a tracker attached.
     :param grace: generations a trend verdict stays quiet after firing
         (per tenant), so the rolled-back window cannot instantly re-trip
         the same detector; defaults to the largest armed window.
@@ -309,6 +349,8 @@ class Controller:
         brownout_enter: float | None = None,
         brownout_exit: float | None = None,
         slo_wait_seconds: float | None = None,
+        slo: Any | None = None,
+        brownout_burn: float | None = None,
         grace: int | None = None,
     ):
         if stagnation_window < 0:
@@ -332,6 +374,10 @@ class Controller:
         if slo_wait_seconds is not None and slo_wait_seconds <= 0:
             raise ValueError(
                 f"slo_wait_seconds must be > 0, got {slo_wait_seconds}"
+            )
+        if brownout_burn is not None and brownout_burn <= 0:
+            raise ValueError(
+                f"brownout_burn must be > 0, got {brownout_burn}"
             )
         self.journal = journal
         self.stagnation_window = int(stagnation_window)
@@ -357,6 +403,10 @@ class Controller:
         )
         self.slo_wait_seconds = (
             None if slo_wait_seconds is None else float(slo_wait_seconds)
+        )
+        self.slo = slo
+        self.brownout_burn = (
+            None if brownout_burn is None else float(brownout_burn)
         )
         if grace is None:
             grace = max(
@@ -748,6 +798,18 @@ class Controller:
         }
 
         def act() -> str:
+            if self.slo is not None and self.brownout_burn is not None:
+                # Formalized-objective trigger: the worst burn rate rides
+                # the journaled evidence (the decide stays pure over it;
+                # exit hysteresis at half the entry burn, matching the
+                # pressure convention).
+                worst = self.slo.worst()
+                evidence["burn_rate"] = (
+                    None if worst is None else worst.burn_rate
+                )
+                evidence["burn_enter"] = self.brownout_burn
+                evidence["burn_exit"] = self.brownout_burn / 2.0
+                evidence["slo"] = None if worst is None else worst.slo.name
             action = decide_brownout(evidence)
             if action != "hold":
                 self._emit(
@@ -788,6 +850,15 @@ class Controller:
         }
 
         def act() -> int:
+            if self.slo is not None:
+                # The class's worst error-budget standing rides the
+                # evidence: an exhausted budget halves the shed
+                # threshold (decide_shed stays pure over it).
+                worst = self.slo.worst(tenant_class=tenant_class)
+                evidence["budget_remaining"] = (
+                    None if worst is None else worst.budget_remaining
+                )
+                evidence["slo"] = None if worst is None else worst.slo.name
             budget = decide_shed(evidence)
             if self._shed_cache.get(tenant_class) != budget:
                 self._shed_cache[tenant_class] = budget

@@ -23,6 +23,20 @@ Three pillars, one import:
 * **Program introspection** (:mod:`~evox_tpu.obs.xla`) — XLA
   cost/memory analysis captured per AOT-compiled segment program, live
   device-memory gauges, and the shared achieved-vs-peak roofline math.
+* **Fleet aggregation** (:mod:`~evox_tpu.obs.aggregate`) — per-host
+  registry snapshots riding heartbeat beats merged into ONE fleet-level
+  registry: counters summed (relaunch-monotone via cursor deltas),
+  gauges re-labeled ``{process_index=}``, histograms merged bucket-wise,
+  dead hosts' series marked ``stale="true"``.
+* **SLOs** (:mod:`~evox_tpu.obs.slo`) — declarative objectives per
+  tenant class (segment latency, tenant throughput, admission
+  availability) tracked as rolling-window burn rates with error-budget
+  gauges, consumed by the control plane as journaled shed/brown-out
+  evidence.
+* **Introspection endpoint** (:mod:`~evox_tpu.obs.endpoint`) — a
+  read-only stdlib HTTP server (own daemon thread, fail-safe handlers)
+  exposing ``/metrics``, ``/healthz`` (non-200 on unhealthy),
+  ``/statusz``, and ``/flightz/<tenant_id>``.
 
 The :class:`Observability` facade bundles them; instrumented subsystems
 take it as a single ``obs=`` parameter.  Every exported artifact
@@ -39,6 +53,8 @@ bit-identity of instrumented vs uninstrumented runs).
 """
 
 from . import xla
+from .aggregate import FleetAggregator
+from .endpoint import IntrospectionEndpoint
 from .events import (
     CallbackSink,
     Event,
@@ -60,9 +76,11 @@ from .metrics import (
     Histogram,
     MetricsRegistry,
     default_registry,
+    parse_series,
     reset_default_registry,
 )
 from .plane import Observability
+from .slo import SLO, SLOStatus, SLOTracker, default_slos
 from .trace import CounterSample, Span, Tracer
 from .version import OBS_SCHEMA_VERSION
 
@@ -78,11 +96,18 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "default_registry",
+    "parse_series",
     "reset_default_registry",
     "Span",
     "CounterSample",
     "Tracer",
     "Observability",
+    "FleetAggregator",
+    "IntrospectionEndpoint",
+    "SLO",
+    "SLOStatus",
+    "SLOTracker",
+    "default_slos",
     "FlightRecorder",
     "finalize_row",
     "flight_signals",

@@ -46,6 +46,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "default_registry",
+    "parse_series",
     "reset_default_registry",
 ]
 
@@ -80,6 +81,61 @@ def _label_suffix(labels: Mapping[str, str]) -> str:
 
 def _escape(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _unescape(value: str) -> str:
+    out: list[str] = []
+    i = 0
+    while i < len(value):
+        ch = value[i]
+        if ch == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append("\n" if nxt == "n" else nxt)
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def parse_series(series: str) -> tuple[str, dict[str, str]]:
+    """Invert :func:`_label_suffix`: split a label-qualified series name
+    (``name{k="v",...}``) back into ``(name, labels)``.  The ONE parser
+    for the canonical series-string key shared by snapshots, heartbeat
+    payloads, and the fleet aggregator — which needs the label set back
+    to re-label per-host gauges with ``process_index``."""
+    brace = series.find("{")
+    if brace < 0:
+        return series, {}
+    name = series[:brace]
+    inner = series[brace:]
+    if not inner.endswith("}"):
+        raise ValueError(f"malformed series {series!r}")
+    labels: dict[str, str] = {}
+    rest = inner[1:-1]
+    pos = 0
+    while pos < len(rest):
+        eq = rest.find('="', pos)
+        if eq < 0:
+            raise ValueError(f"malformed series {series!r}")
+        key = rest[pos:eq]
+        # Find the closing quote, skipping escaped ones.
+        scan = eq + 2
+        while True:
+            close = rest.find('"', scan)
+            if close < 0:
+                raise ValueError(f"malformed series {series!r}")
+            backslashes = 0
+            while rest[close - 1 - backslashes] == "\\":
+                backslashes += 1
+            if backslashes % 2 == 0:
+                break
+            scan = close + 1
+        labels[key] = _unescape(rest[eq + 2 : close])
+        pos = close + 1
+        if pos < len(rest) and rest[pos] == ",":
+            pos += 1
+    return name, labels
 
 
 class _Metric:
@@ -177,6 +233,33 @@ class Histogram(_Metric):
                 if value <= bound:
                     self._bucket_counts[i] += 1
             self._bucket_counts[-1] += 1
+
+    def merge(
+        self,
+        bucket_deltas: Iterable[float],
+        sum_delta: float,
+        count_delta: float,
+    ) -> None:
+        """Fold another histogram's (delta) distribution into this one —
+        the fleet aggregator's bucket-wise merge.  ``bucket_deltas`` must
+        match this histogram's bucket count (bounds + ``+Inf``); negative
+        deltas are a ValueError (a shrinking cumulative distribution is a
+        counter reset, which the caller must detect and re-base first)."""
+        deltas = [float(d) for d in bucket_deltas]
+        if len(deltas) != len(self._bucket_counts):
+            raise ValueError(
+                f"histogram {self.name} merge expects "
+                f"{len(self._bucket_counts)} bucket deltas, got {len(deltas)}"
+            )
+        if any(d < 0 for d in deltas) or count_delta < 0:
+            raise ValueError(
+                f"histogram {self.name} merge deltas cannot be negative"
+            )
+        with self._registry._lock:
+            for i, d in enumerate(deltas):
+                self._bucket_counts[i] += d
+            self._sum += float(sum_delta)
+            self._count += int(count_delta)
 
     @property
     def count(self) -> int:
@@ -317,6 +400,47 @@ class MetricsRegistry:
                 else:
                     out.update(metric._sample())
             return out
+
+    def fleet_payload(self) -> dict[str, Any]:
+        """The typed snapshot that rides a
+        :class:`~evox_tpu.parallel.HostHeartbeat` beat for fleet-level
+        aggregation (:class:`~evox_tpu.obs.FleetAggregator`): counters
+        and gauges as flat ``{series: value}`` sections, histograms with
+        their full bucket arrays (``bounds``/``counts``/``sum``/``count``)
+        — the flat :meth:`heartbeat_payload` cannot be merged bucket-wise.
+        All JSON-serializable; ``schema`` stamps the obs schema version."""
+        with self._lock:
+            counters: dict[str, float] = {}
+            gauges: dict[str, float] = {}
+            histograms: dict[str, dict[str, Any]] = {}
+            for metric in self._metrics.values():
+                if isinstance(metric, Histogram):
+                    histograms[metric.series] = {
+                        "bounds": list(metric.bounds),
+                        "counts": [float(c) for c in metric._bucket_counts],
+                        "sum": metric._sum,
+                        "count": float(metric._count),
+                    }
+                elif isinstance(metric, Counter):
+                    counters[metric.series] = metric._value
+                else:
+                    gauges[metric.series] = metric._value
+            return {
+                "schema": OBS_SCHEMA_VERSION,
+                "counters": counters,
+                "gauges": gauges,
+                "histograms": histograms,
+            }
+
+    def remove_series(self, name: str, **labels: Any) -> bool:
+        """Drop exactly one series (by name + label set); returns whether
+        it existed.  The fleet aggregator re-labels a stale host's gauges
+        (``stale="true"``) by removing the fresh series and publishing the
+        marked one — series identity is the label set, so the swap is a
+        remove + re-register."""
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+        with self._lock:
+            return self._metrics.pop(key, None) is not None
 
     def to_prometheus(self) -> str:
         """The Prometheus text exposition format (``# HELP``/``# TYPE``

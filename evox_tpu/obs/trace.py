@@ -70,6 +70,11 @@ class Tracer:
         segment of device-level profiling; ``None`` disables).
     :param profile_dir: where the profiler window writes its trace
         (defaults to ``profile_trace`` under the working directory).
+    :param process_index: the fleet process index stamped as the Chrome
+        trace ``pid`` (and into ``otherData``).  Defaults to the OS pid
+        — fine for one host, but two hosts' OS pids can collide, so
+        fleet workers pass their ``jax.process_index()`` here and
+        ``tools/merge_traces.py`` gets one clean lane per host.
     """
 
     def __init__(
@@ -77,12 +82,16 @@ class Tracer:
         *,
         profile_segment: int | None = None,
         profile_dir: Union[str, Path, None] = None,
+        process_index: int | None = None,
     ):
         if profile_segment is not None and profile_segment < 0:
             raise ValueError(
                 f"profile_segment must be >= 0, got {profile_segment}"
             )
         self.profile_segment = profile_segment
+        self.process_index = (
+            None if process_index is None else int(process_index)
+        )
         self.profile_dir = Path(profile_dir) if profile_dir else Path("profile_trace")
         self._lock = threading.Lock()
         self._spans: list[Span] = []
@@ -172,7 +181,11 @@ class Tracer:
     # -- export --------------------------------------------------------------
     def to_chrome_trace(self) -> dict[str, Any]:
         """The Chrome-trace (Perfetto-loadable) JSON object."""
-        pid = os.getpid()
+        pid = (
+            self.process_index
+            if self.process_index is not None
+            else os.getpid()
+        )
         events = [
             {
                 "name": span.name,
@@ -203,6 +216,7 @@ class Tracer:
                 "schema": OBS_SCHEMA_VERSION,
                 "wall_anchor": self._wall0,
                 "producer": "evox_tpu.obs",
+                "process_index": self.process_index,
             },
         }
 

@@ -392,10 +392,13 @@ class HostHeartbeat:
             to self-report per-host deadline trips to the supervisor).
         :param metrics: optional
             :class:`~evox_tpu.obs.MetricsRegistry`: every beat carries
-            the registry's flat counters-and-gauges snapshot under a
+            the registry's typed ``fleet_payload()`` snapshot (counters,
+            gauges, and histograms with full bucket arrays) under a
             ``"metrics"`` key, so a supervisor reading the heartbeat
             plane (:func:`read_heartbeats`) sees per-host metrics with
-            no extra transport.  Publish failures follow the beat
+            no extra transport — and a
+            :class:`~evox_tpu.obs.FleetAggregator` can merge them into
+            one fleet-level registry.  Publish failures follow the beat
             contract: warn and drop, never kill the liveness thread.
         """
         self.directory = Path(directory)
@@ -435,7 +438,16 @@ class HostHeartbeat:
                 payload["extra_error"] = repr(e)
         if self._metrics is not None:
             try:
-                payload["metrics"] = self._metrics.heartbeat_payload()
+                # The typed payload (counters/gauges/histograms with
+                # bucket arrays) so a FleetAggregator can merge
+                # histograms bucket-wise; registries without it (duck-
+                # typed stand-ins) fall back to the flat legacy dict.
+                fleet_payload = getattr(self._metrics, "fleet_payload", None)
+                payload["metrics"] = (
+                    fleet_payload()
+                    if fleet_payload is not None
+                    else self._metrics.heartbeat_payload()
+                )
             except Exception as e:  # pragma: no cover - broken registry
                 payload["metrics_error"] = repr(e)
         # Swallow EVERYTHING (not just OSError): a non-JSON-serializable
@@ -567,6 +579,32 @@ class FleetReport:
         return sorted(
             set(self.dead_hosts) | set(self.wedged_hosts) | set(self.slow_hosts)
         )
+
+    def to_json(self) -> dict[str, Any]:
+        """The ``/healthz`` body shape: per-host verdicts + the
+        dead/wedged/slow index lists.  ONE definition — the daemon's and
+        the supervisor's introspection endpoints both serve it, and
+        ``FleetSupervisor(healthz_url=)`` consumes exactly these keys;
+        a second hand-rolled copy would silently diverge."""
+        return {
+            "healthy": self.healthy,
+            "hosts": {
+                str(i): {
+                    "alive": v.alive,
+                    "dead": v.dead,
+                    "wedged": v.wedged,
+                    "slow": v.slow,
+                    "generation": v.generation,
+                    "beat_age": v.beat_age,
+                    "reasons": list(v.reasons),
+                }
+                for i, v in self.verdicts.items()
+            },
+            "dead": list(self.dead_hosts),
+            "wedged": list(self.wedged_hosts),
+            "slow": list(self.slow_hosts),
+            "reasons": list(self.reasons),
+        }
 
 
 class FleetHealth:

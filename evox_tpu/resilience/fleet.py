@@ -58,6 +58,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Mapping, Sequence, Union
 
+from ..obs.aggregate import FleetAggregator
+from ..obs.endpoint import IntrospectionEndpoint
 from ..obs.plane import Observability, resolve_obs
 from ..parallel.multihost import (
     FLEET_ENV_ATTEMPT,
@@ -256,6 +258,10 @@ class FleetSupervisor:
         on_event: Callable[[str], None] | None = None,
         spawn: Callable[..., Any] | None = None,
         obs: Union["Observability", bool, None] = None,
+        endpoint: Union[int, bool, None] = None,
+        endpoint_host: str = "127.0.0.1",
+        healthz_url: str | None = None,
+        healthz_timeout: float = 2.0,
     ):
         """
         :param command: maps a :class:`WorkerSpec` to the argv of one
@@ -312,6 +318,25 @@ class FleetSupervisor:
             host deaths, quarantines, world size) feed the plane's
             registry.  ``None`` builds a default plane; ``False``
             disables instrumentation.
+        :param endpoint: arm the supervisor's own introspection endpoint
+            (:class:`~evox_tpu.obs.IntrospectionEndpoint`, serving for
+            the duration of :meth:`run`): an ``int`` binds that port,
+            ``True`` an OS-assigned one.  ``/metrics`` is the
+            fleet-aggregated view — every worker's heartbeat metrics
+            merged by a :class:`~evox_tpu.obs.FleetAggregator` into the
+            supervisor's registry (counters summed relaunch-monotone,
+            gauges per ``process_index``, dead hosts ``stale="true"``) —
+            ``/healthz`` renders the live per-host verdicts (non-200 on
+            dead/wedged/slow), ``/statusz`` the supervision record.
+        :param endpoint_host: endpoint bind address (default loopback).
+        :param healthz_url: optional external ``/healthz`` to CONSUME:
+            each watch poll GETs it, and a non-200 response's
+            ``dead``/``wedged``/``slow`` host lists merge into this
+            supervisor's own verdicts — the seam that lets a daemon's
+            (or any sidecar's) health view drive supervision.
+            Unreachable endpoints warn once and are ignored: losing the
+            health sidecar must never take down the fleet it watches.
+        :param healthz_timeout: per-poll timeout for ``healthz_url``.
         """
         if num_processes < 1:
             raise ValueError(
@@ -356,6 +381,28 @@ class FleetSupervisor:
         self.obs = resolve_obs(obs, run_id=Path(checkpoint_dir).name)
         self._metric_cursor: dict[str, float] = {}
         self.stats = FleetStats()
+        self.healthz_url = healthz_url
+        self.healthz_timeout = float(healthz_timeout)
+        self._healthz_warned = False
+        # Fleet aggregation merges INTO the supervisor's registry (one
+        # scrape = supervisor series + every host's), safe because the
+        # supervisor never publishes the host-side series names itself.
+        self.aggregator = FleetAggregator(
+            registry=self.obs.registry if self.obs is not None else None
+        )
+        self._health: FleetHealth | None = None
+        self.endpoint: IntrospectionEndpoint | None = None
+        if endpoint is not None and endpoint is not False:
+            self.endpoint = IntrospectionEndpoint(
+                metrics=self._metrics_text,
+                healthz=self._healthz,
+                statusz=self._statusz,
+                instrument=(
+                    self.obs.registry if self.obs is not None else None
+                ),
+                host=endpoint_host,
+                port=0 if endpoint is True else int(endpoint),
+            )
 
     # -- events --------------------------------------------------------------
     # Supervisor decisions that mean something broke vs routine lifecycle.
@@ -365,6 +412,7 @@ class FleetSupervisor:
         "straggler",
         "fleet-stall",
         "stop",
+        "healthz-unreachable",
     )
 
     def _event(self, attempt: int, kind: str, detail: str) -> None:
@@ -413,6 +461,100 @@ class FleetSupervisor:
                 "evox_fleet_world_size",
                 "Process count of the current fleet attempt.",
             ).set(s.world_sizes[-1])
+
+    # -- introspection (read-only providers + the consumed sidecar) ----------
+    def _metrics_text(self) -> str:
+        """The fleet-aggregated Prometheus text: fold the current beats
+        (with the live attempt's verdicts for staleness) into the
+        aggregator, then export.  Endpoint handler thread only."""
+        from ..parallel.multihost import read_heartbeats
+
+        beats = read_heartbeats(self.heartbeat_dir)
+        report = self._health.check() if self._health is not None else None
+        self.aggregator.update(beats, report)
+        return self.aggregator.to_prometheus()
+
+    def _healthz(self) -> tuple[bool, dict[str, Any]]:
+        payload: dict[str, Any] = {
+            "attempt": max(0, self.stats.attempts - 1),
+            "world_size": self.stats.final_world_size,
+            "completed": self.stats.completed,
+        }
+        if self._health is None:
+            return True, payload
+        report = self._health.check()
+        payload.update(report.to_json())
+        return report.healthy, payload
+
+    def _statusz(self) -> dict[str, Any]:
+        s = self.stats
+        return {
+            "attempts": s.attempts,
+            "completed": s.completed,
+            "world_sizes": list(s.world_sizes),
+            "host_deaths": s.host_deaths,
+            "hosts_quarantined": s.hosts_quarantined,
+            "removed_hosts": [list(r) for r in s.removed_hosts],
+            "events": [
+                {"attempt": e.attempt, "kind": e.kind, "detail": e.detail}
+                for e in list(s.events)[-50:]
+            ],
+        }
+
+    def _poll_healthz(self) -> dict[str, Any] | None:
+        """GET the consumed ``healthz_url``; returns its JSON body (from
+        a 200 or a 503 — the 503 body carries the verdicts) or ``None``
+        when unreachable/unparseable (warned once: the sidecar dying must
+        never fail the fleet)."""
+        import urllib.error
+        import urllib.request
+
+        try:
+            try:
+                resp = urllib.request.urlopen(
+                    self.healthz_url, timeout=self.healthz_timeout
+                )
+                body, status = resp.read(), resp.status
+            except urllib.error.HTTPError as e:
+                body, status = e.read(), e.code
+            import json
+
+            out = dict(json.loads(body))
+            out["status"] = int(status)
+            return out
+        except Exception as e:  # noqa: BLE001 - observation must not kill
+            if not self._healthz_warned:
+                self._healthz_warned = True
+                self._event(
+                    max(0, self.stats.attempts - 1),
+                    "healthz-unreachable",
+                    f"consumed healthz {self.healthz_url} failed "
+                    f"({type(e).__name__}: {e}); continuing on heartbeat "
+                    f"verdicts alone",
+                )
+            return None
+
+    def _remote_verdicts(self) -> dict[int, str]:
+        """Hosts the consumed ``/healthz`` names unhealthy, as
+        ``{process_index: verdict kind}`` — empty when the endpoint is
+        healthy, unarmed, or unreachable."""
+        if self.healthz_url is None:
+            return {}
+        body = self._poll_healthz()
+        if body is None or body.get("status") == 200:
+            return {}
+        out: dict[int, str] = {}
+        for key, kind in (
+            ("dead", "host-death"),
+            ("wedged", "wedged"),
+            ("slow", "straggler"),
+        ):
+            for host in body.get(key, ()) or ():
+                try:
+                    out.setdefault(int(host), kind)
+                except (TypeError, ValueError):
+                    continue
+        return out
 
     # -- world planning ------------------------------------------------------
     def plan_relaunch(self, world: int, removed: set[int]) -> int:
@@ -562,6 +704,15 @@ class FleetSupervisor:
             # already exited 0 is complete, not dead, however stale its
             # final beat looks by now.
             bad -= {i for i, rc in codes.items() if rc == 0}
+            # The consumed /healthz sidecar's verdicts merge in under the
+            # same exit-code rule (hosts outside this attempt's world are
+            # ignored — a stale sidecar must not remove a host twice).
+            remote = {
+                h: k
+                for h, k in self._remote_verdicts().items()
+                if 0 <= h < len(workers) and codes.get(h) != 0 and h not in bad
+            }
+            bad |= set(remote)
             live = {i for i, rc in codes.items() if rc is None}
             if (
                 bad
@@ -589,14 +740,29 @@ class FleetSupervisor:
             if bad:
                 for i in sorted(bad):
                     v = report.verdicts.get(i)
-                    reason = (
-                        "; ".join(v.reasons) if v is not None else "unhealthy"
-                    )
-                    kind = (
-                        "straggler"
-                        if v is not None and v.slow and not (v.dead or v.wedged)
-                        else ("wedged" if v is not None and v.wedged else "host-death")
-                    )
+                    if i in remote:
+                        kind = remote[i]
+                        reason = (
+                            f"consumed healthz {self.healthz_url} named "
+                            f"host {i} {kind}"
+                        )
+                    else:
+                        reason = (
+                            "; ".join(v.reasons)
+                            if v is not None
+                            else "unhealthy"
+                        )
+                        kind = (
+                            "straggler"
+                            if v is not None
+                            and v.slow
+                            and not (v.dead or v.wedged)
+                            else (
+                                "wedged"
+                                if v is not None and v.wedged
+                                else "host-death"
+                            )
+                        )
                     if kind == "straggler":
                         self.stats.hosts_quarantined += 1
                     elif kind == "wedged":
@@ -627,43 +793,70 @@ class FleetSupervisor:
         self._metric_cursor = {}
         world = self.num_processes
         attempt = 0
-        while True:
-            self.stats.attempts = attempt + 1
-            self.stats.world_sizes.append(world)
-            health = FleetHealth(
-                self.heartbeat_dir,
-                world,
-                dead_after=self.dead_after,
-                stall_after=self.stall_after,
-                eval_deadline=self.eval_deadline,
-                start_grace=self.start_grace,
-            )
-            workers, _specs = self._launch(world, attempt)
-            try:
-                removed = self._watch(workers, health, attempt)
-            finally:
-                # Whatever happened, never leak live workers past the
-                # attempt: completion leaves nothing to stop, every other
-                # path must tear the fleet down before relaunch/raise.
-                self._stop_attempt(workers, attempt)
-            if removed is None:
-                self._event(
-                    attempt, "complete", f"all {world} worker(s) exited 0"
-                )
-                self.stats.completed = True
-                return self.stats
-            next_world = self.plan_relaunch(world, removed)
-            if attempt + 1 > self.max_relaunches:
-                raise FleetError(
-                    f"relaunch budget of {self.max_relaunches} spent after "
-                    f"attempt {attempt} removed host(s) {sorted(removed)}",
-                    self.stats,
-                )
+        if self.endpoint is not None and not self.endpoint.started:
+            self.endpoint.start()
             self._event(
-                attempt,
-                "relaunch",
-                f"resuming on {next_world} surviving host(s) (was {world}; "
-                f"removed {sorted(removed)})",
+                0,
+                "endpoint",
+                f"introspection serving at {self.endpoint.url} "
+                f"(/metrics /healthz /statusz)",
             )
-            world = next_world
-            attempt += 1
+        try:
+            while True:
+                self.stats.attempts = attempt + 1
+                self.stats.world_sizes.append(world)
+                health = FleetHealth(
+                    self.heartbeat_dir,
+                    world,
+                    dead_after=self.dead_after,
+                    stall_after=self.stall_after,
+                    eval_deadline=self.eval_deadline,
+                    start_grace=self.start_grace,
+                )
+                # The endpoint's /healthz and /metrics staleness render
+                # through the live attempt's verdict configuration.
+                self._health = health
+                workers, _specs = self._launch(world, attempt)
+                try:
+                    removed = self._watch(workers, health, attempt)
+                finally:
+                    # Whatever happened, never leak live workers past the
+                    # attempt: completion leaves nothing to stop, every
+                    # other path must tear the fleet down before
+                    # relaunch/raise.
+                    self._stop_attempt(workers, attempt)
+                if removed is None:
+                    self._event(
+                        attempt, "complete", f"all {world} worker(s) exited 0"
+                    )
+                    self.stats.completed = True
+                    # One final fold WITHOUT a staleness report: the
+                    # workers exited 0, so their last beats are final
+                    # totals to absorb, not dead hosts to mark — a
+                    # post-run scrape of the supervisor registry then
+                    # holds the fleet's complete counters.
+                    from ..parallel.multihost import read_heartbeats
+
+                    self.aggregator.update(
+                        read_heartbeats(self.heartbeat_dir)
+                    )
+                    return self.stats
+                next_world = self.plan_relaunch(world, removed)
+                if attempt + 1 > self.max_relaunches:
+                    raise FleetError(
+                        f"relaunch budget of {self.max_relaunches} spent "
+                        f"after attempt {attempt} removed host(s) "
+                        f"{sorted(removed)}",
+                        self.stats,
+                    )
+                self._event(
+                    attempt,
+                    "relaunch",
+                    f"resuming on {next_world} surviving host(s) "
+                    f"(was {world}; removed {sorted(removed)})",
+                )
+                world = next_world
+                attempt += 1
+        finally:
+            if self.endpoint is not None:
+                self.endpoint.stop()

@@ -56,6 +56,16 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Sequence, Union
 
+from ..obs.aggregate import FleetAggregator
+from ..obs.endpoint import IntrospectionEndpoint
+from ..obs.metrics import MetricsRegistry
+from ..obs.slo import (
+    SIGNAL_ADMISSION,
+    SIGNAL_SEGMENT_SECONDS,
+    SIGNAL_TENANT_GENS,
+    SLOTracker,
+)
+from ..obs.version import OBS_SCHEMA_VERSION
 from ..resilience.preemption import Preempted, PreemptionGuard
 from ..utils.checkpoint import CheckpointStore, ReadOnlyCheckpointStore
 from ..utils.exec_cache import ExecutableCache, enable_xla_compilation_cache
@@ -184,6 +194,32 @@ class ServiceDaemon:
         the decision sequence bit-for-bit from the journaled evidence
         (``tests/test_control.py``).  Decision records carry no ``uid``,
         so :meth:`start`'s tenant fold skips them by construction.
+    :param slos: declarative service-level objectives — a sequence of
+        :class:`~evox_tpu.obs.SLO` (or a pre-built
+        :class:`~evox_tpu.obs.SLOTracker`).  The daemon feeds them live:
+        round wall seconds score the latency objectives, per-running-
+        tenant generation throughput the gen/s floors, and every
+        admission/shed the availability objectives; burn-rate and
+        error-budget gauges (``evox_slo_*``) publish each round.  When a
+        ``controller`` is attached the tracker is handed to it (first
+        binder wins): burn rates become the journaled evidence behind
+        brown-out entry (``Controller(brownout_burn=)``) and exhausted
+        budgets halve the class shed thresholds.
+    :param endpoint: arm the live introspection endpoint
+        (:class:`~evox_tpu.obs.IntrospectionEndpoint`): an ``int`` binds
+        that TCP port, ``True`` an OS-assigned one (``daemon.endpoint.url``
+        after :meth:`start`).  Serves ``/metrics`` (fleet-aggregated
+        when ``<root>/heartbeats`` carries beats, process-local
+        otherwise), ``/healthz`` (non-200 on a dead/wedged/slow host
+        verdict), ``/statusz`` (tenants, per-class queue depths,
+        decision tail, exec-cache hit rates, SLO standings), and
+        ``/flightz/<tenant_id>`` (the tenant's flight ring).  Read-only
+        and fail-safe: a handler exception is a 500 response, never a
+        touched serving path.
+    :param endpoint_host: bind address (default loopback).
+    :param fleet_dead_after: heartbeat staleness (seconds) after which
+        the endpoint's fleet view declares a host dead (``/healthz``
+        non-200, its ``/metrics`` series marked ``stale="true"``).
     :param service_kwargs: everything else
         (:class:`~evox_tpu.service.OptimizationService` surface:
         ``health``, ``max_restarts``, ``checkpoint_every``,
@@ -213,6 +249,10 @@ class ServiceDaemon:
         preemption: Union[PreemptionGuard, bool, None] = True,
         on_event: Callable[[str], None] | None = None,
         controller: Any | None = None,
+        slos: Any | None = None,
+        endpoint: Union[int, bool, None] = None,
+        endpoint_host: str = "127.0.0.1",
+        fleet_dead_after: float = 5.0,
         **service_kwargs: Any,
     ):
         if brownout_factor < 1:
@@ -269,9 +309,46 @@ class ServiceDaemon:
             controller=controller,
             **service_kwargs,
         )
-        self.journal = RequestJournal(
-            self.root / self.JOURNAL_NAME, store=store
+        self._registry: MetricsRegistry | None = (
+            self.service.obs.registry if self.service.obs is not None else None
         )
+        self.journal = RequestJournal(
+            self.root / self.JOURNAL_NAME,
+            store=store,
+            registry=self._registry,
+        )
+        if slos is None:
+            self.slo: SLOTracker | None = None
+        elif isinstance(slos, SLOTracker):
+            self.slo = slos
+        else:
+            self.slo = SLOTracker(list(slos), registry=self._registry)
+        if (
+            controller is not None
+            and self.slo is not None
+            and getattr(controller, "slo", None) is None
+        ):
+            # The formalized objectives become the controller's journaled
+            # brown-out / shed evidence (first binder wins).
+            controller.slo = self.slo
+        self.endpoint: IntrospectionEndpoint | None = None
+        if endpoint is not None and endpoint is not False:
+            self.endpoint = IntrospectionEndpoint(
+                metrics=self._metrics_text,
+                healthz=self._healthz,
+                statusz=self._statusz,
+                flight=self._flight_window,
+                instrument=self._registry,
+                host=endpoint_host,
+                port=0 if endpoint is True else int(endpoint),
+            )
+        self.fleet_dead_after = float(fleet_dead_after)
+        # A PRIVATE fleet registry (not the live process one): this
+        # daemon's own series arrive through its own beat; merging them
+        # into the process registry would double-count.  Constructed
+        # eagerly — endpoint handler threads race a lazy build.
+        self._aggregator = FleetAggregator()
+        self._fleet_health: Any | None = None
         if controller is not None and controller.journal is None:
             # Decisions ride the daemon's own request journal (advisory
             # appends; the tenant fold skips uid-less records).  A
@@ -329,6 +406,150 @@ class ServiceDaemon:
         if self.service.obs is not None:
             self.service.obs.counter(name, help, **labels).inc()
 
+    # -- introspection endpoint providers (read-only, fail-safe) -------------
+    # Every provider runs on an endpoint handler thread and must never
+    # mutate serving state; snapshots are taken as list() copies so a
+    # boundary mutating a dict mid-scrape cannot break iteration.
+    def _fleet_beats(self) -> dict[int, dict[str, Any]]:
+        hb = self.root / "heartbeats"
+        if not hb.is_dir():
+            return {}
+        from ..parallel.multihost import read_heartbeats
+
+        return read_heartbeats(hb)
+
+    def _fleet_report(self, beats: dict[int, dict[str, Any]]) -> Any | None:
+        if not beats:
+            return None
+        from ..parallel.multihost import FleetHealth
+
+        world = max(beats) + 1
+        if (
+            self._fleet_health is None
+            or self._fleet_health.num_processes != world
+        ):
+            # Every expected host here HAS beaten (the world is derived
+            # from observed beats), so the start-grace path is inert.
+            self._fleet_health = FleetHealth(
+                self.root / "heartbeats",
+                world,
+                dead_after=self.fleet_dead_after,
+            )
+        return self._fleet_health.check()
+
+    def _metrics_text(self) -> str:
+        beats = self._fleet_beats()
+        if beats:
+            self._aggregator.update(beats, self._fleet_report(beats))
+            return self._aggregator.to_prometheus()
+        if self._registry is not None:
+            return self._registry.to_prometheus()
+        return MetricsRegistry().to_prometheus()  # header-only: obs is off
+
+    def _healthz(self) -> tuple[bool, dict[str, Any]]:
+        payload: dict[str, Any] = {
+            "started": self.started,
+            "brownout": self.brownout,
+            "tenants": len(self.service._tenants),
+            "queued": len(self.service._queue),
+        }
+        healthy = True
+        beats = self._fleet_beats()
+        report = self._fleet_report(beats)
+        if report is not None:
+            payload.update(report.to_json())
+            healthy = report.healthy
+        return healthy, payload
+
+    def _statusz(self) -> dict[str, Any]:
+        tenants: dict[str, Any] = {}
+        counts: dict[str, int] = {}
+        for tid, rec in list(self.service._tenants.items()):
+            status = rec.status.value
+            counts[status] = counts.get(status, 0) + 1
+            tenants[tid] = {
+                "status": status,
+                "uid": rec.uid,
+                "lane": rec.lane,
+                "generations": rec.generations,
+                "n_steps": int(rec.spec.n_steps),
+                "class": self._class_by_uid.get(rec.uid, "standard"),
+            }
+        queue = {
+            name: self._class_depth(name) for name in sorted(self.classes)
+        }
+        out: dict[str, Any] = {
+            "schema": OBS_SCHEMA_VERSION,
+            "time": time.time(),
+            "started": self.started,
+            "brownout": self.brownout,
+            "segment_steps": self.service.segment_steps,
+            "round_seconds": self._last_segment_seconds,
+            "queue_depth": queue,
+            "queue_budget": {
+                name: c.queue_budget for name, c in sorted(self.classes.items())
+            },
+            "tenants": tenants,
+            "tenant_counts": counts,
+            "stats": {
+                "segments_run": self.service.stats.segments_run,
+                "submitted": self.service.stats.submitted,
+                "admitted": self.service.stats.admitted,
+                "completed": self.service.stats.completed,
+                "rejections": len(self.service.stats.rejections),
+                "restarts": self.service.stats.restarts,
+                "quarantines": self.service.stats.quarantines,
+                "sheds": self.stats.sheds,
+                "brownout_entries": self.stats.brownout_entries,
+                "replayed_tenants": self.stats.replayed_tenants,
+                "journal_append_failures": self.stats.journal_append_failures,
+            },
+        }
+        if self.exec_cache is not None:
+            cache = self.exec_cache.stats
+            hits = int(getattr(cache, "hits", 0))
+            misses = int(getattr(cache, "misses", 0))
+            out["exec_cache"] = {
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": (
+                    hits / (hits + misses) if (hits + misses) else None
+                ),
+                "quarantines": int(getattr(cache, "quarantines", 0)),
+            }
+        if self.controller is not None:
+            # The decision-journal tail: newest last, manifests only —
+            # a READ of the controller's record, never a consult (a
+            # scrape must not mint decisions).
+            out["decisions"] = [
+                d.to_manifest()
+                for d in list(self.controller.decisions)[-20:]
+            ]
+        if self.slo is not None:
+            out["slo"] = self.slo.describe()
+        return out
+
+    def _flight_window(self, tenant_id: str) -> list[dict[str, float]] | None:
+        record = self.service._tenants.get(tenant_id)
+        if record is not None and record.flight is not None:
+            return record.flight.rows()
+        obs = self.service.obs
+        if (
+            record is None
+            and obs is not None
+            and obs.flight is not None
+            and tenant_id == "__service__"
+        ):
+            return obs.flight.rows()
+        return None
+
+    def _slo_admission(self, tenant_class: str, accepted: bool) -> None:
+        if self.slo is not None:
+            self.slo.record(
+                SIGNAL_ADMISSION, accepted, tenant_class=tenant_class
+            )
+            self.slo.publish()
+
     # -- journal ------------------------------------------------------------
     def _journal(self, kind: str, *, required: bool, **data: Any) -> bool:
         """Append one lifecycle record.  ``required=True`` (the ack path:
@@ -367,6 +588,12 @@ class ServiceDaemon:
         if self.started:
             return 0
         self.started = True
+        if self.endpoint is not None and not self.endpoint.started:
+            self.endpoint.start()
+            self._event(
+                f"introspection endpoint serving at {self.endpoint.url} "
+                f"(/metrics /healthz /statusz /flightz/<tenant_id>)"
+            )
         records, damage = self.journal.replay(quarantine=self.primary)
         if damage is not None:
             self.stats.journal_damage.append(damage.reason)
@@ -569,6 +796,7 @@ class ServiceDaemon:
                 spec.tenant_id,
                 to_status=TenantStatus.EVICTED if readmission else None,
             )
+            self._slo_admission(cls.name, False)
             self.service._reject(
                 spec,
                 "journal-failed",
@@ -577,6 +805,7 @@ class ServiceDaemon:
             )
         self._journaled_complete.discard(record.uid)
         self._class_by_uid[record.uid] = cls.name
+        self._slo_admission(cls.name, True)
         self._gauge(
             "evox_daemon_queue_depth",
             self._class_depth(cls.name),
@@ -588,13 +817,18 @@ class ServiceDaemon:
 
     def _class_depth(self, name: str) -> int:
         """Queued tenants of one class (unregistered uids — pre-daemon
-        journal rows — count as ``standard``)."""
-        return sum(
-            1
-            for tid in self.service._queue
-            if self._class_by_uid.get(self.service.tenant(tid).uid, "standard")
-            == name
-        )
+        journal rows — count as ``standard``).  Snapshot-safe: also
+        called from endpoint handler threads mid-boundary, so the queue
+        is copied and a tenant withdrawn between the copy and the lookup
+        is simply skipped."""
+        count = 0
+        for tid in list(self.service._queue):
+            record = self.service._tenants.get(tid)
+            if record is None:
+                continue
+            if self._class_by_uid.get(record.uid, "standard") == name:
+                count += 1
+        return count
 
     def _retry_after(self, cls: TenantClass) -> int:
         """Segments until a retry plausibly lands: the nearest running
@@ -612,9 +846,9 @@ class ServiceDaemon:
         is armed (``slo_wait_seconds`` on the controller, fed by the
         measured segment cadence).  A changed effective budget is one
         journaled ``shed-threshold`` decision."""
-        if (
-            self.controller is None
-            or self.controller.slo_wait_seconds is None
+        if self.controller is None or (
+            self.controller.slo_wait_seconds is None
+            and getattr(self.controller, "slo", None) is None
         ):
             return cls.queue_budget
         return self.controller.shed_threshold(
@@ -631,6 +865,7 @@ class ServiceDaemon:
         budget = cls.queue_budget if budget is None else budget
         hint = self._retry_after(cls)
         self.stats.sheds += 1
+        self._slo_admission(cls.name, False)
         self._inc(
             "evox_daemon_sheds_total",
             "Submissions shed at a class budget, by class.",
@@ -750,8 +985,36 @@ class ServiceDaemon:
                 self._last_segment_seconds,
                 "Wall seconds of the last scheduling round.",
             )
+            self._observe_slos(self._last_segment_seconds)
         self._journal_completions()
         return progressed
+
+    def _observe_slos(self, round_seconds: float) -> None:
+        """Score one scheduling round against the declared objectives:
+        round wall seconds against every class's latency SLO, and the
+        realized per-tenant generation rate against each running
+        tenant's class throughput floor."""
+        if self.slo is None:
+            return
+        for name in self.classes:
+            self.slo.observe(
+                SIGNAL_SEGMENT_SECONDS, round_seconds, tenant_class=name
+            )
+        if round_seconds > 0:
+            gens_per_sec = self.service.segment_steps / round_seconds
+            running: dict[str, int] = {}
+            for rec in list(self.service._tenants.values()):
+                if rec.status is TenantStatus.RUNNING:
+                    cls = self._class_by_uid.get(rec.uid, "standard")
+                    running[cls] = running.get(cls, 0) + 1
+            for cls, n in running.items():
+                self.slo.observe(
+                    SIGNAL_TENANT_GENS,
+                    gens_per_sec,
+                    tenant_class=cls,
+                    n=n,
+                )
+        self.slo.publish()
 
     def _journal_completions(self) -> None:
         for record in self.service._tenants.values():
@@ -844,6 +1107,8 @@ class ServiceDaemon:
         return self.service.tenant(tenant_id)
 
     def close(self) -> None:
+        if self.endpoint is not None:
+            self.endpoint.stop()
         self.journal.close()
 
     # -- fleet --------------------------------------------------------------

@@ -104,6 +104,15 @@ class RequestJournal:
         ``EROFS``).
     :param durable: ``fsync`` after every record (default True — an
         un-fsync'd ack is a lie).
+    :param registry: optional metrics registry (duck-typed
+        :class:`~evox_tpu.obs.MetricsRegistry`): the durability hot path
+        publishes ``evox_journal_append_seconds`` /
+        ``evox_journal_fsync_seconds`` histograms and an
+        ``evox_journal_records_total{kind=}`` counter — the fsync is the
+        admission ack's latency floor, and it was unobserved.
+        Failure-isolated, same contract as
+        ``AsyncCheckpointWriter(registry=)``: a broken registry never
+        fails an append.
     """
 
     def __init__(
@@ -112,10 +121,12 @@ class RequestJournal:
         *,
         store: CheckpointStore | None = None,
         durable: bool = True,
+        registry: Any | None = None,
     ):
         self.path = Path(path)
         self.store = store if store is not None else CheckpointStore()
         self.durable = bool(durable)
+        self._registry = registry
         self.next_seq = 0
         self.records_appended = 0
         self.append_failures = 0
@@ -172,11 +183,15 @@ class RequestJournal:
                 f"unacknowledged"
             ) from e
         offset = f.tell()
+        t0 = time.perf_counter()
+        fsync_seconds = 0.0
         try:
             written = self.store.append_record(f, line)
             f.flush()
             if self.durable:
+                t_sync = time.perf_counter()
                 os.fsync(f.fileno())
+                fsync_seconds = time.perf_counter() - t_sync
         except (OSError, RuntimeError) as e:
             self.append_failures += 1
             self._heal(f, offset)
@@ -198,7 +213,34 @@ class RequestJournal:
             )
         self.next_seq += 1
         self.records_appended += 1
+        self._observe(kind, time.perf_counter() - t0, fsync_seconds)
         return body["seq"]
+
+    def _observe(
+        self, kind: str, append_seconds: float, fsync_seconds: float
+    ) -> None:
+        """Registry feed, failure-isolated (the AsyncCheckpointWriter
+        contract): the durability hot path must never fail on account of
+        its own observation."""
+        if self._registry is None:
+            return
+        try:
+            self._registry.histogram(
+                "evox_journal_append_seconds",
+                "Wall seconds per durable journal append (write + flush "
+                "+ fsync) — the admission ack's latency floor.",
+            ).observe(append_seconds)
+            self._registry.histogram(
+                "evox_journal_fsync_seconds",
+                "Wall seconds of the fsync alone within each append.",
+            ).observe(fsync_seconds)
+            self._registry.counter(
+                "evox_journal_records_total",
+                "Journal records durably appended, by record kind.",
+                kind=str(kind),
+            ).inc()
+        except Exception:  # pragma: no cover - broken registry
+            pass
 
     def _heal(self, f: Any, offset: int) -> None:
         """Cut a failed append's partial bytes back off.  If even that

@@ -77,19 +77,30 @@
 #                               per-generation telemetry on, postmortem
 #                               bundle schema, rollback/storm triggers,
 #                               per-tenant demux), the XLA-introspection
-#                               + bench-history analytics suites, then a
-#                               full graftlint sweep (no obs call site may
-#                               sit in compiled scope — GL002 stays
-#                               clean), the bench-history regression
-#                               check in report-only mode (CPU boxes hold
-#                               no TPU-anchored rows to gate), and the
-#                               two-floor overhead gate: plane-only
+#                               + bench-history analytics suites, the
+#                               fleet-telemetry suite (cross-host metric
+#                               aggregation w/ staleness + relaunch
+#                               monotonicity, SLO burn-rate fixtures,
+#                               introspection-endpoint routes/concurrency,
+#                               daemon+supervisor wiring, and the real
+#                               subprocess-fleet acceptance: /metrics ==
+#                               sum of per-host registries, /healthz
+#                               flips on SIGKILL, dead series marked
+#                               stale), then a full graftlint sweep (no
+#                               obs call site may sit in compiled scope —
+#                               GL002 stays clean), the bench-history
+#                               regression check in report-only mode (CPU
+#                               boxes hold no TPU-anchored rows to gate),
+#                               the two-floor overhead gate: plane-only
 #                               instrumentation (identical program) must
 #                               keep ≥98% of uninstrumented gen/s, the
 #                               FULLY instrumented run — flight recorder
 #                               on, a different compiled program — ≥85%
 #                               on the PSO Ackley config (artifact under
-#                               bench_artifacts/).
+#                               bench_artifacts/), and the endpoint
+#                               scrape gate: an instrumented daemon under
+#                               a 1 Hz external scraper keeps ≥98% of
+#                               unscraped per-tenant gen/s.
 #                               Runs under a HARD wall-clock timeout like
 #                               --multihost.
 #   ./run_tests.sh --control    closed-loop control-plane lane: the
@@ -184,11 +195,13 @@ fi
 if [ "$1" = "--obs" ]; then
   shift
   # Hard timeout (SIGKILL escalation), same pattern as --multihost: the
-  # chaos test delivers a real SIGTERM; a wedged run must fail loudly.
-  OBS_TIMEOUT="${EVOX_TPU_OBS_TIMEOUT:-1500}"
+  # chaos test delivers a real SIGTERM (and the telemetry acceptance runs
+  # real subprocess fleets); a wedged run must fail loudly.
+  OBS_TIMEOUT="${EVOX_TPU_OBS_TIMEOUT:-2100}"
   timeout -k 30 "$OBS_TIMEOUT" \
     "${CPU_ENV[@]}" python -m pytest \
     tests/test_obs.py tests/test_flight.py tests/test_bench_history.py \
+    tests/test_telemetry.py \
     -q "$@" || exit 1
   # No observability call site may land inside compiled scope: the full
   # graftlint sweep (GL002 et al.) must stay clean against its baselines.
@@ -199,7 +212,10 @@ if [ "$1" = "--obs" ]; then
   # containers — which hold no comparable TPU-anchored rows — pass
   # vacuously while a TPU box running this lane gates for real.
   python tools/check_bench_history.py || exit 1
-  exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_obs_overhead.py
+  timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_obs_overhead.py || exit 1
+  # Live-scrape cost: an instrumented daemon under a 1 Hz operator
+  # (separate scraper process) must keep >=98% of unscraped throughput.
+  exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_endpoint_overhead.py
 fi
 if [ "$1" = "--control" ]; then
   shift

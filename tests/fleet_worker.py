@@ -136,6 +136,18 @@ def main(argv):
     mon = EvalMonitor(full_fit_history=False)
     wf = StdWorkflow(PSO(pop, lb, ub), prob, monitor=mon)
 
+    # Opt-in metric transport (the fleet-telemetry acceptance): a private
+    # per-process registry rides every heartbeat beat so the test's
+    # FleetAggregator can merge the hosts, and the final per-host
+    # snapshot is dumped for value-for-value comparison.
+    registry = None
+    obs = None
+    if cfg.get("metrics"):
+        from evox_tpu.obs import MetricsRegistry, Observability
+
+        registry = MetricsRegistry()
+        obs = Observability(registry=registry)
+
     heartbeat = HostHeartbeat(
         os.environ[FLEET_ENV_HEARTBEAT_DIR],
         topo.process_index,
@@ -143,6 +155,7 @@ def main(argv):
         # Per-host straggler self-report: every eval-deadline expiry on
         # THIS host rides the beat payload into the supervisor's verdicts.
         extra=lambda: {"deadline_trips": prob.deadline_trips},
+        metrics=registry,
     ).start()
 
     runner = ResilientRunner(
@@ -151,6 +164,7 @@ def main(argv):
         checkpoint_every=int(cfg.get("checkpoint_every", 2)),
         preemption=True,  # supervisor SIGTERM -> graceful boundary stop
         heartbeat=heartbeat,
+        obs=obs if obs is not None else None,
         # A collective that lost its peer cannot be retried in-process:
         # fail fast and let the SUPERVISOR relaunch the surviving world.
         retry=RetryPolicy(max_retries=0),
@@ -161,6 +175,17 @@ def main(argv):
     except Preempted:
         return 75  # EX_PREEMPTED: resumable, not broken
     finally:
+        if registry is not None:
+            # One last beat AFTER the runner's final counter sync, so
+            # the beat on disk carries the registry's final totals, then
+            # the per-host snapshot for the aggregation acceptance.
+            heartbeat.beat()
+            with open(
+                checkpoint_dir
+                / f"host_registry_{topo.process_index:04d}.json",
+                "w",
+            ) as f:
+                json.dump(registry.fleet_payload(), f)
         heartbeat.stop()
 
     if topo.process_index == 0:

@@ -700,11 +700,16 @@ def test_async_writer_feeds_registry(tmp_path, key):
 def test_heartbeat_carries_registry_payload(tmp_path):
     reg = MetricsRegistry()
     reg.counter("evox_runner_retries_total").inc(3)
+    reg.histogram("evox_seg_seconds", buckets=[1.0]).observe(0.5)
     hb = HostHeartbeat(tmp_path, 0, metrics=reg)
     hb.beat(generation=5)
     beat = json.loads(hb.path.read_text())
     assert beat["generation"] == 5
-    assert beat["metrics"]["evox_runner_retries_total"] == 3
+    # Schema 3: the typed fleet payload (counters/gauges/histograms with
+    # bucket arrays) so a FleetAggregator can merge the beats.
+    assert beat["metrics"]["counters"]["evox_runner_retries_total"] == 3
+    hist = beat["metrics"]["histograms"]["evox_seg_seconds"]
+    assert hist["bounds"] == [1.0] and hist["counts"] == [1.0, 1.0]
 
 
 def test_compile_sentinel_feeds_registry(key):

@@ -1,0 +1,1147 @@
+"""Fleet-wide telemetry plane (ISSUE 13).
+
+Three layers under test:
+
+* **Aggregation** (`evox_tpu.obs.aggregate`) — per-host heartbeat metric
+  payloads merged into one fleet registry: counters summed and monotone
+  across relaunches (cursor-delta re-base), gauges re-labeled per host,
+  histograms merged bucket-wise, dead hosts' series marked
+  ``stale="true"`` instead of silently frozen.
+* **SLOs** (`evox_tpu.obs.slo`) — rolling-window burn-rate math against
+  hand-computed fixtures, and the controller's journaled burn/budget
+  evidence behind brown-out and shed decisions.
+* **Endpoints** (`evox_tpu.obs.endpoint`) — the read-only introspection
+  server: route semantics, fail-safety (broken provider = 500, never a
+  crash), internally-consistent snapshots under concurrent mutation, and
+  the daemon/supervisor wiring.
+
+The slow half is the acceptance: a REAL multi-process fleet (the
+loopback-gloo subprocess pattern from ``test_multihost.py``) whose
+``/metrics`` equals the sum of per-host registries value-for-value, and
+whose ``/healthz`` flips non-200 within one staleness window of a host
+SIGKILL, with the dead host's series marked stale.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from evox_tpu.control import (
+    Controller,
+    decide_brownout,
+    decide_shed,
+)
+from evox_tpu.obs import (
+    FleetAggregator,
+    IntrospectionEndpoint,
+    MetricsRegistry,
+    OBS_SCHEMA_VERSION,
+    SLO,
+    SLOTracker,
+    Tracer,
+    default_slos,
+    parse_series,
+)
+
+# ---------------------------------------------------------------------------
+# series parsing + typed heartbeat payload
+# ---------------------------------------------------------------------------
+
+
+def test_parse_series_round_trips_escaped_labels():
+    reg = MetricsRegistry()
+    reg.counter("c_total", tenant_id='a"b\\c,d', note="x\ny").inc()
+    (series,) = reg.snapshot()
+    name, labels = parse_series(series)
+    assert name == "c_total"
+    assert labels == {"tenant_id": 'a"b\\c,d', "note": "x\ny"}
+    assert parse_series("plain") == ("plain", {})
+    with pytest.raises(ValueError):
+        parse_series("bad{oops}")
+
+
+def test_fleet_payload_carries_bucket_arrays():
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(3)
+    reg.gauge("g").set(7)
+    reg.histogram("h_seconds", buckets=[0.1, 1.0]).observe(0.5)
+    payload = reg.fleet_payload()
+    assert payload["schema"] == OBS_SCHEMA_VERSION
+    assert payload["counters"] == {"c_total": 3.0}
+    assert payload["gauges"] == {"g": 7.0}
+    hist = payload["histograms"]["h_seconds"]
+    assert hist["bounds"] == [0.1, 1.0]
+    assert hist["counts"] == [0.0, 1.0, 1.0]  # cumulative + the +Inf bucket
+    assert hist["count"] == 1.0 and hist["sum"] == pytest.approx(0.5)
+    assert json.loads(json.dumps(payload)) == payload  # beat-serializable
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation
+# ---------------------------------------------------------------------------
+
+
+def _beat(pid, reg):
+    return {"pid": pid, "metrics": reg.fleet_payload()}
+
+
+def test_aggregator_merges_counters_gauges_histograms():
+    h0, h1 = MetricsRegistry(), MetricsRegistry()
+    h0.counter("evox_gens_total").inc(10)
+    h1.counter("evox_gens_total").inc(5)
+    h0.gauge("evox_queue").set(3)
+    h1.gauge("evox_queue").set(7)
+    h0.histogram("evox_seg_seconds", buckets=[1.0]).observe(0.5)
+    h1.histogram("evox_seg_seconds", buckets=[1.0]).observe(2.0)
+    agg = FleetAggregator()
+    agg.update({0: _beat(100, h0), 1: _beat(200, h1)})
+    snap = agg.snapshot()
+    assert snap["evox_gens_total"] == 15
+    assert snap['evox_queue{process_index="0"}'] == 3
+    assert snap['evox_queue{process_index="1"}'] == 7
+    assert snap['evox_seg_seconds_bucket{le="1.0"}'] == 1
+    assert snap['evox_seg_seconds_bucket{le="+Inf"}'] == 2
+    assert snap["evox_seg_seconds_sum"] == pytest.approx(2.5)
+    # Idempotent re-fold: same payload again adds nothing (cursor delta).
+    agg.update({0: _beat(100, h0), 1: _beat(200, h1)})
+    assert agg.snapshot()["evox_gens_total"] == 15
+
+
+def test_aggregator_counters_resume_monotone_across_relaunch():
+    h0 = MetricsRegistry()
+    h0.counter("evox_gens_total").inc(10)
+    agg = FleetAggregator()
+    agg.update({0: _beat(100, h0)})
+    # Relaunched attempt: new pid, counters restart from zero.
+    h0b = MetricsRegistry()
+    h0b.counter("evox_gens_total").inc(4)
+    agg.update({0: _beat(101, h0b)})
+    assert agg.snapshot()["evox_gens_total"] == 14
+    # Same-pid value regression (a restart the pid check missed) also
+    # re-bases on the full new value instead of going backwards.
+    h0c = MetricsRegistry()
+    h0c.counter("evox_gens_total").inc(2)
+    agg.update({0: _beat(101, h0c)})
+    assert agg.snapshot()["evox_gens_total"] == 16
+    # Histograms re-base the same way.
+    hh = MetricsRegistry()
+    hh.histogram("evox_h", buckets=[1.0]).observe(0.5)
+    agg.update({0: {"pid": 101, "metrics": hh.fleet_payload()}})
+    hh2 = MetricsRegistry()
+    hh2.histogram("evox_h", buckets=[1.0]).observe(0.5)
+    agg.update({0: {"pid": 102, "metrics": hh2.fleet_payload()}})
+    assert agg.snapshot()["evox_h_count"] == 2
+
+
+def test_aggregator_marks_dead_host_series_stale():
+    h0, h1 = MetricsRegistry(), MetricsRegistry()
+    h0.gauge("evox_queue").set(1)
+    h1.gauge("evox_queue").set(9)
+    h1.counter("evox_gens_total").inc(5)
+    agg = FleetAggregator()
+    beats = {0: _beat(1, h0), 1: _beat(2, h1)}
+    agg.update(beats)
+    # Host 1 dies: its beat may still sit on disk, but the verdict says
+    # dead — the series must say so too.
+    agg.update(beats, stale_hosts=[1])
+    snap = agg.snapshot()
+    assert snap['evox_queue{process_index="1",stale="true"}'] == 9
+    assert 'evox_queue{process_index="1"}' not in snap
+    assert snap['evox_fleet_host_up{process_index="1"}'] == 0
+    assert snap['evox_fleet_host_up{process_index="0"}'] == 1
+    assert snap["evox_gens_total"] == 5  # counters keep their total
+    # The host comes back (relaunch): stale series retire, fresh return.
+    h1.gauge("evox_queue").set(4)
+    agg.update({0: _beat(1, h0), 1: _beat(3, h1)})
+    snap = agg.snapshot()
+    assert 'evox_queue{process_index="1",stale="true"}' not in snap
+    assert snap['evox_queue{process_index="1"}'] == 4
+    assert snap['evox_fleet_host_up{process_index="1"}'] == 1
+    # A host whose beat vanishes entirely is stale without any report.
+    agg.update({0: _beat(1, h0)})
+    assert (
+        agg.snapshot()['evox_fleet_host_up{process_index="1"}'] == 0
+    )
+
+
+def test_aggregator_skips_conflicting_histogram_bounds_with_warning():
+    h0, h1 = MetricsRegistry(), MetricsRegistry()
+    h0.histogram("evox_h", buckets=[1.0]).observe(0.5)
+    h1.histogram("evox_h", buckets=[2.0]).observe(0.5)
+    agg = FleetAggregator()
+    agg.update({0: _beat(1, h0)})
+    with pytest.warns(UserWarning, match="conflict"):
+        agg.update({0: _beat(1, h0), 1: _beat(2, h1)})
+    assert agg.snapshot()["evox_h_count"] == 1  # host 1 skipped, not blended
+
+
+def test_aggregator_legacy_flat_payload_best_effort():
+    agg = FleetAggregator()
+    agg.update(
+        {0: {"pid": 1, "metrics": {"evox_gens_total": 5.0, "evox_queue": 2.0}}}
+    )
+    snap = agg.snapshot()
+    assert snap["evox_gens_total"] == 5
+    assert snap['evox_queue{process_index="0"}'] == 2
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate math
+# ---------------------------------------------------------------------------
+
+
+def test_slo_burn_rate_matches_hand_computed_fixture():
+    slo = SLO(
+        "lat", "segment_seconds", target=0.9, threshold=1.0,
+        window_seconds=100.0,
+    )
+    tracker = SLOTracker([slo], clock=lambda: 0.0)
+    for i in range(16):
+        tracker.observe("segment_seconds", 0.5, at=float(i))
+    for i in range(4):
+        tracker.observe("segment_seconds", 2.0, at=float(16 + i))
+    st = tracker.status(slo, now=20.0)
+    # 4 bad / 20 total = 20% error rate against a 10% budget: burn 2.0,
+    # the whole window budget spent twice over.
+    assert (st.good, st.bad) == (16, 4)
+    assert st.burn_rate == pytest.approx(2.0)
+    assert st.budget_remaining == pytest.approx(-1.0)
+    # Window expiry: at t=116.5 only events from t>16.5 remain (3 bad).
+    st = tracker.status(slo, now=116.5)
+    assert (st.good, st.bad) == (0, 3)
+    assert st.burn_rate == pytest.approx(10.0)
+    # Empty window: no evidence, not good news and not bad news.
+    st = tracker.status(slo, now=1000.0)
+    assert st.burn_rate is None and st.budget_remaining is None
+
+
+def test_slo_ge_comparison_and_prejudged_events():
+    floor = SLO(
+        "gens", "tenant_gens_per_sec", target=0.5, threshold=10.0,
+        comparison="ge", window_seconds=60.0,
+    )
+    adm = SLO("adm", "admission", target=0.5, window_seconds=60.0)
+    tracker = SLOTracker([floor, adm], clock=lambda: 0.0)
+    tracker.observe("tenant_gens_per_sec", 12.0, at=0.0)   # good
+    tracker.observe("tenant_gens_per_sec", 8.0, at=1.0)    # bad
+    st = tracker.status(floor, now=2.0)
+    assert (st.good, st.bad) == (1, 1)
+    assert st.burn_rate == pytest.approx(1.0)
+    tracker.record("admission", True, at=0.0)
+    tracker.record("admission", False, at=1.0, n=3)
+    st = tracker.status(adm, now=2.0)
+    assert (st.good, st.bad) == (1, 3)
+    worst = tracker.worst(now=2.0)
+    assert worst.slo.name == "adm"
+    # Class filtering: no declared SLO for this class -> nothing.
+    assert tracker.worst(tenant_class="nonexistent", now=2.0) is None
+
+
+def test_slo_validation_and_gauge_publish():
+    with pytest.raises(ValueError, match="target"):
+        SLO("x", "s", target=1.5)
+    with pytest.raises(ValueError, match="comparison"):
+        SLO("x", "s", target=0.9, comparison="eq")
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOTracker([
+            SLO("x", "s", target=0.9, threshold=1.0),
+            SLO("x", "t", target=0.9, threshold=1.0),
+        ])
+    reg = MetricsRegistry()
+    tracker = SLOTracker(
+        default_slos(window_seconds=60.0), registry=reg, clock=lambda: 0.0
+    )
+    tracker.observe("segment_seconds", 10.0, at=0.0)  # over the bound
+    tracker.publish(now=1.0)
+    snap = reg.snapshot()
+    key = (
+        'evox_slo_burn_rate{slo="segment-latency",tenant_class="standard"'
+        ',window="1m"}'
+    )
+    assert snap[key] == pytest.approx(100.0)  # 100% bad vs a 1% budget
+    assert (
+        snap[key.replace("burn_rate", "budget_remaining")]
+        == pytest.approx(-99.0)
+    )
+
+
+# ---------------------------------------------------------------------------
+# controller consumption: burn/budget as journaled evidence
+# ---------------------------------------------------------------------------
+
+
+def test_decide_brownout_burn_evidence_matrix():
+    base = {"pressure": 0.1, "enter": 0.75, "exit": 0.375, "active": False}
+    # Pre-SLO evidence reproduces the original hysteresis bit-for-bit.
+    assert decide_brownout(base) == "hold"
+    assert decide_brownout({**base, "pressure": 0.8}) == "enter"
+    assert decide_brownout(
+        {**base, "pressure": 0.2, "active": True}
+    ) == "exit"
+    # Burn trigger: low pressure, burning budget -> enter.
+    burn = {**base, "burn_rate": 3.0, "burn_enter": 2.0, "burn_exit": 1.0}
+    assert decide_brownout(burn) == "enter"
+    # Exit needs EVERY armed signal calm.
+    active = {**burn, "active": True, "pressure": 0.1}
+    assert decide_brownout(active) == "hold"          # burn still high
+    assert decide_brownout({**active, "burn_rate": 0.5}) == "exit"
+    assert (
+        decide_brownout({**active, "burn_rate": 0.5, "pressure": 0.9})
+        == "hold"
+    )  # pressure still high
+
+
+def test_decide_shed_budget_exhaustion_halves():
+    base = {
+        "queue_budget": 16, "slo_wait_seconds": None,
+        "segment_seconds": None, "lanes": 4,
+    }
+    assert decide_shed(base) == 16                      # pre-SLO unchanged
+    assert decide_shed({**base, "budget_remaining": 0.5}) == 16
+    assert decide_shed({**base, "budget_remaining": 0.0}) == 8
+    assert decide_shed({**base, "budget_remaining": -2.0}) == 8
+    # Composes with the wait-time tightening.
+    timed = {
+        **base, "slo_wait_seconds": 4.0, "segment_seconds": 1.0,
+        "budget_remaining": -1.0,
+    }
+    assert decide_shed(timed) == 8  # min(16, 4*4)=16 -> halved
+
+
+def test_controller_feeds_slo_evidence_into_brownout_and_shed(tmp_path):
+    tracker = SLOTracker(
+        [SLO("lat", "segment_seconds", target=0.9, threshold=1.0,
+             window_seconds=60.0)],
+        clock=lambda: 0.0,
+    )
+    for i in range(10):
+        tracker.observe("segment_seconds", 5.0, at=float(i))  # all bad
+    ctrl = Controller(brownout_burn=2.0, slo_wait_seconds=100.0, slo=tracker)
+    action = ctrl.brownout(pressure=0.0, active=False, enter=0.9)
+    assert action == "enter"
+    decision = ctrl.decisions[-1]
+    assert decision.kind == "brownout"
+    assert decision.evidence["burn_rate"] == pytest.approx(10.0)
+    assert decision.evidence["burn_enter"] == 2.0
+    # Replay purity: the journaled evidence alone reproduces the action.
+    assert decide_brownout(decision.evidence) == "enter"
+    # Shed: exhausted budget halves the class threshold.
+    budget = ctrl.shed_threshold(
+        queue_budget=8, segment_seconds=1.0, lanes=2, tenant_class="standard"
+    )
+    assert budget == 4  # min(8, 100*2)=8 -> halved by budget_remaining<=0
+    shed = [d for d in ctrl.decisions if d.kind == "shed-threshold"][-1]
+    # 100% bad against a 10% budget: burn 10, budget remaining 1-10=-9.
+    assert shed.evidence["budget_remaining"] == pytest.approx(-9.0)
+    assert decide_shed(shed.evidence) == 4
+
+
+def test_controller_slo_failure_degrades_not_crashes():
+    class Broken:
+        def worst(self, **kw):
+            raise RuntimeError("boom")
+
+    ctrl = Controller(brownout_burn=2.0, slo=Broken())
+    assert ctrl.brownout(pressure=0.99, active=False, enter=0.9) == "hold"
+    assert ctrl.degraded
+    assert any(d.kind == "degrade" for d in ctrl.decisions)
+
+
+# ---------------------------------------------------------------------------
+# introspection endpoint
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    try:
+        resp = urllib.request.urlopen(url, timeout=10)
+        return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_endpoint_routes_and_fail_safety():
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(2)
+    calls = {"boom": 0}
+
+    def broken_statusz():
+        calls["boom"] += 1
+        raise RuntimeError("provider exploded")
+
+    ep = IntrospectionEndpoint(
+        registry=reg,
+        healthz=lambda: (False, {"dead": [1], "note": "host 1 gone"}),
+        statusz=broken_statusz,
+        flight=lambda tid: [{"generation": 1}] if tid == "a" else None,
+        instrument=reg,
+    ).start()
+    try:
+        status, text = _get(ep.url + "/metrics")
+        assert status == 200 and "c_total 2" in text
+        status, text = _get(ep.url + "/healthz")
+        assert status == 503
+        body = json.loads(text)
+        assert body["dead"] == [1] and body["healthy"] is False
+        # Broken provider: 500, and the server keeps serving afterwards.
+        status, text = _get(ep.url + "/statusz")
+        assert status == 500 and "provider exploded" in text
+        status, _ = _get(ep.url + "/metrics")
+        assert status == 200
+        status, text = _get(ep.url + "/flightz/a")
+        assert status == 200
+        assert json.loads(text)["rows"] == [{"generation": 1}]
+        assert _get(ep.url + "/flightz/unknown")[0] == 404
+        assert _get(ep.url + "/nope")[0] == 404
+        assert _get(ep.url + "/")[0] == 200
+        snap = reg.snapshot()
+        assert snap['evox_endpoint_requests_total{path="/metrics"}'] == 2
+        assert snap['evox_endpoint_requests_total{path="/flightz"}'] == 2
+    finally:
+        ep.stop()
+    # Stopped: the port refuses.
+    with pytest.raises(OSError):
+        urllib.request.urlopen(ep.url + "/metrics", timeout=2)
+
+
+def _parse_prom(text):
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        series, value = line.rsplit(" ", 1)
+        out[series] = float(value)
+    return out
+
+
+def test_endpoint_concurrent_scrapes_are_internally_consistent():
+    """Parallel scrapes during rapid registry mutation must each see an
+    internally-consistent snapshot: cumulative histogram buckets
+    non-decreasing in ``le`` with ``_count`` equal to the +Inf bucket,
+    and counters never going backwards between successive scrapes."""
+    reg = MetricsRegistry()
+    ep = IntrospectionEndpoint(registry=reg).start()
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def mutate():
+        i = 0
+        while not stop.is_set():
+            reg.counter("m_total").inc()
+            reg.histogram("m_seconds", buckets=[0.1, 1.0, 10.0]).observe(
+                [0.05, 0.5, 5.0, 50.0][i % 4]
+            )
+            reg.gauge("m_gauge", shard=str(i % 3)).set(i)
+            i += 1
+
+    def scrape():
+        last_counter = 0.0
+        for _ in range(40):
+            status, text = _get(ep.url + "/metrics")
+            if status != 200:
+                errors.append(f"scrape status {status}")
+                return
+            snap = _parse_prom(text)
+            counter = snap.get("m_total", 0.0)
+            if counter < last_counter:
+                errors.append("counter went backwards across scrapes")
+            last_counter = counter
+            buckets = [
+                (series, v)
+                for series, v in snap.items()
+                if series.startswith("m_seconds_bucket")
+            ]
+            counts = [v for _, v in buckets]  # ascending-le export order
+            if counts != sorted(counts):
+                errors.append(f"buckets not cumulative: {buckets}")
+            if buckets and counts[-1] != snap.get("m_seconds_count"):
+                errors.append("+Inf bucket != _count in one snapshot")
+
+    mutator = threading.Thread(target=mutate, daemon=True)
+    scrapers = [threading.Thread(target=scrape) for _ in range(4)]
+    mutator.start()
+    try:
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=120)
+    finally:
+        stop.set()
+        mutator.join(timeout=10)
+        ep.stop()
+    assert not errors, errors
+
+
+# ---------------------------------------------------------------------------
+# journal durability metrics (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_publishes_append_and_fsync_histograms(tmp_path):
+    from evox_tpu.service import RequestJournal
+
+    reg = MetricsRegistry()
+    journal = RequestJournal(tmp_path / "j.jsonl", registry=reg)
+    journal.append("submit", uid=1)
+    journal.append("submit", uid=2)
+    journal.append("evict", uid=1)
+    journal.close()
+    snap = reg.snapshot()
+    assert snap["evox_journal_append_seconds_count"] == 3
+    assert snap["evox_journal_fsync_seconds_count"] == 3
+    assert snap['evox_journal_records_total{kind="submit"}'] == 2
+    assert snap['evox_journal_records_total{kind="evict"}'] == 1
+    assert snap["evox_journal_append_seconds_sum"] >= (
+        snap["evox_journal_fsync_seconds_sum"]
+    )
+
+
+def test_journal_metrics_are_failure_isolated(tmp_path):
+    from evox_tpu.service import RequestJournal
+
+    class BrokenRegistry:
+        def histogram(self, *a, **k):
+            raise RuntimeError("broken")
+
+        counter = histogram
+
+    journal = RequestJournal(tmp_path / "j.jsonl", registry=BrokenRegistry())
+    assert journal.append("submit", uid=1) == 0  # append survives
+    journal.close()
+    records, damage = journal.replay()
+    assert damage is None and len(records) == 1
+
+
+# ---------------------------------------------------------------------------
+# trace merging (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_stamps_process_index_as_pid(tmp_path):
+    tracer = Tracer(process_index=7)
+    with tracer.span("segment"):
+        pass
+    trace = tracer.to_chrome_trace()
+    assert all(ev["pid"] == 7 for ev in trace["traceEvents"])
+    assert trace["otherData"]["process_index"] == 7
+
+
+def test_merge_traces_one_lane_per_host_clocks_aligned(tmp_path):
+    import sys
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        from merge_traces import merge_traces
+    finally:
+        sys.path.pop(0)
+    paths = []
+    for host, anchor in ((0, 100.0), (1, 100.5)):
+        tracer = Tracer(process_index=host)
+        with tracer.span("execute", host=host):
+            pass
+        path = tmp_path / f"host{host}.json"
+        tracer.write(path)
+        # Pin the wall anchors so the shift is hand-checkable.
+        trace = json.loads(path.read_text())
+        trace["otherData"]["wall_anchor"] = anchor
+        path.write_text(json.dumps(trace))
+        paths.append(path)
+    merged = merge_traces(paths)
+    assert merged["otherData"]["hosts"] == [0, 1]
+    names = {(ev["pid"], ev["name"]) for ev in merged["traceEvents"]}
+    assert (0, "process_name") in names and (1, "process_name") in names
+    spans = [
+        ev for ev in merged["traceEvents"] if ev["name"] == "execute"
+    ]
+    assert {ev["pid"] for ev in spans} == {0, 1}
+    h0 = next(ev for ev in spans if ev["pid"] == 0)
+    h1 = next(ev for ev in spans if ev["pid"] == 1)
+    # Host 1's clock is 0.5s behind the merged origin (host 0's anchor):
+    # its events shift +5e5 us relative to its own recorded ts.
+    t0_own = json.loads(paths[0].read_text())["traceEvents"][0]["ts"]
+    t1_own = json.loads(paths[1].read_text())["traceEvents"][0]["ts"]
+    assert h0["ts"] == pytest.approx(t0_own)
+    assert h1["ts"] == pytest.approx(t1_own + 5e5)
+    # Duplicate lanes are refused, not interleaved.
+    with pytest.raises(ValueError, match="duplicate process_index"):
+        merge_traces([paths[0], paths[0]])
+
+
+# ---------------------------------------------------------------------------
+# evoxtop (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_evoxtop_renders_and_probes(tmp_path):
+    import sys
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import evoxtop
+    finally:
+        sys.path.pop(0)
+    status = {
+        "brownout": True,
+        "round_seconds": 0.42,
+        "segment_steps": 16,
+        "queue_depth": {"standard": 3},
+        "queue_budget": {"standard": 8},
+        "stats": {"segments_run": 5, "admitted": 4, "completed": 1,
+                  "restarts": 0, "sheds": 2, "rejections": 2},
+        "exec_cache": {"hits": 3, "misses": 1, "hit_rate": 0.75},
+        "slo": [{"slo": "lat", "tenant_class": "standard", "window": "5m",
+                 "burn_rate": 2.0, "budget_remaining": -1.0,
+                 "good": 8, "bad": 2}],
+        "decisions": [{"seq": 0, "kind": "brownout", "action": "enter"}],
+        "tenants": {
+            "alice-1": {"status": "running", "generations": 32,
+                        "n_steps": 100, "lane": 0, "class": "standard"},
+            "bob-2": {"status": "queued", "generations": 0,
+                      "n_steps": 100, "lane": None, "class": "standard"},
+        },
+        "tenant_counts": {"running": 1, "queued": 1},
+    }
+    health = {"hosts": {"0": {"dead": False, "wedged": False, "slow": False,
+                              "generation": 32}}}
+    screen = evoxtop.render(status, 200, health)
+    assert "brownout: ON" in screen
+    assert "standard 3/8" in screen
+    assert "burn 2.00" in screen and "budget -1.00" in screen
+    assert "75% hit rate" in screen
+    assert "alice-1" in screen and "running" in screen
+    assert "0:ok@gen32" in screen
+    # Probe semantics against a live endpoint: rc 0 healthy, 2 unhealthy.
+    ep = IntrospectionEndpoint(
+        statusz=lambda: status, healthz=lambda: (False, {"dead": [0]})
+    ).start()
+    try:
+        assert evoxtop.main([ep.url]) == 2
+    finally:
+        ep.stop()
+
+
+# ---------------------------------------------------------------------------
+# daemon wiring (fast: single process, no fleet)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def daemon_bits(tmp_path):
+    import jax.numpy as jnp
+
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.problems.numerical import Ackley
+    from evox_tpu.service import ServiceDaemon, TenantSpec
+
+    lb, ub = -5.0 * jnp.ones(4), 5.0 * jnp.ones(4)
+
+    def spec(tid, n_steps=8):
+        return TenantSpec(tid, PSO(8, lb, ub), Ackley(), n_steps=n_steps)
+
+    def build(**kwargs):
+        kwargs.setdefault("lanes_per_pack", 2)
+        kwargs.setdefault("segment_steps", 4)
+        kwargs.setdefault("preemption", False)
+        kwargs.setdefault("endpoint", True)
+        return ServiceDaemon(tmp_path / "root", seed=0, **kwargs)
+
+    return build, spec
+
+
+def test_daemon_statusz_healthz_metrics_roundtrip(daemon_bits):
+    build, spec = daemon_bits
+    daemon = build(
+        slos=default_slos(window_seconds=600.0),
+        controller=Controller(slo_wait_seconds=60.0, brownout_burn=100.0),
+    )
+    daemon.start()
+    try:
+        daemon.submit(spec("a1"))
+        daemon.submit(spec("a2"))
+        daemon.run()
+        base = daemon.endpoint.url
+        status, text = _get(base + "/metrics")
+        assert status == 200
+        assert "evox_journal_records_total" in text
+        assert "evox_slo_burn_rate" in text
+        status, text = _get(base + "/statusz")
+        assert status == 200
+        body = json.loads(text)
+        assert body["schema"] == OBS_SCHEMA_VERSION
+        assert body["tenants"]["a1"]["status"] == "completed"
+        assert body["queue_depth"] == {"standard": 0}
+        assert body["stats"]["segments_run"] > 0
+        assert body["exec_cache"]["hits"] + body["exec_cache"]["misses"] > 0
+        assert {s["slo"] for s in body["slo"]} == {
+            "segment-latency", "tenant-throughput", "admission",
+        }
+        status, text = _get(base + "/healthz")
+        assert status == 200 and json.loads(text)["healthy"] is True
+        assert _get(base + "/flightz/a1")[0] == 404  # no flight recorder
+    finally:
+        daemon.close()
+
+
+def test_daemon_flightz_serves_tenant_ring(daemon_bits, tmp_path):
+    from evox_tpu.obs import FlightRecorder, Observability
+
+    build, spec = daemon_bits
+    daemon = build(
+        obs=Observability(
+            registry=MetricsRegistry(),
+            flight=FlightRecorder(tmp_path / "flight"),
+        )
+    )
+    daemon.start()
+    try:
+        daemon.submit(spec("a1"))
+        daemon.run()
+        status, text = _get(daemon.endpoint.url + "/flightz/a1")
+        assert status == 200
+        rows = json.loads(text)["rows"]
+        assert rows and all("generation" in r for r in rows)
+        gens = [r["generation"] for r in rows]
+        assert gens == sorted(gens)
+    finally:
+        daemon.close()
+
+
+def test_daemon_shed_feeds_admission_slo(daemon_bits):
+    from evox_tpu.service import AdmissionError, TenantClass
+
+    build, spec = daemon_bits
+    daemon = build(
+        classes=[TenantClass("standard", 0)],  # everything sheds
+        slos=default_slos(window_seconds=600.0),
+    )
+    daemon.start()
+    try:
+        with pytest.raises(AdmissionError, match="queue budget") as exc:
+            daemon.submit(spec("a1"))
+        assert exc.value.reason == "shed"
+        st = daemon.slo.worst(tenant_class="standard")
+        assert st is not None and st.slo.name == "admission"
+        assert st.bad == 1 and st.burn_rate > 1.0
+    finally:
+        daemon.close()
+
+
+# ---------------------------------------------------------------------------
+# supervisor consumes /healthz (fake workers, no subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_consumes_external_healthz(tmp_path):
+    from test_multihost import FakeWorker
+
+    from evox_tpu.obs import Observability
+    from evox_tpu.resilience.fleet import FleetSupervisor
+
+    verdict = {"healthy": True, "dead": []}
+    ep = IntrospectionEndpoint(
+        healthz=lambda: (verdict["healthy"], dict(verdict))
+    ).start()
+    spawned = []
+
+    def spawn(argv, env, spec):
+        w = FakeWorker(rc=None if spec.attempt == 0 else 0)
+        spawned.append((spec.attempt, spec.process_id))
+        return w
+
+    sup = FleetSupervisor(
+        lambda spec: ["true"],
+        2,
+        checkpoint_dir=tmp_path / "ckpt",
+        spawn=spawn,
+        poll_interval=0.01,
+        grace_seconds=0.05,
+        start_grace=1000.0,
+        healthz_url=ep.url + "/healthz",
+        obs=Observability(registry=MetricsRegistry()),
+    )
+    results: list = []
+    try:
+        runner = threading.Thread(target=lambda: results.append(sup.run()))
+        runner.start()
+        time.sleep(0.3)  # attempt 0 is hung (rc=None) and healthy
+        verdict.update(healthy=False, dead=[1])  # the sidecar names host 1
+        runner.join(timeout=60)
+        assert not runner.is_alive()
+    finally:
+        ep.stop()
+    stats = results[0]
+    assert stats.completed
+    assert stats.world_sizes == [2, 1]
+    assert stats.removed_hosts[0][1] == 1
+    assert "consumed healthz" in stats.removed_hosts[0][2]
+
+
+def test_supervisor_unreachable_healthz_warns_once_and_continues(tmp_path):
+    from evox_tpu.obs import Observability
+    from evox_tpu.resilience.fleet import FleetSupervisor
+
+    from test_multihost import FakeWorker
+
+    def spawn(argv, env, spec):
+        # Complete only after a few watch polls, so the supervisor
+        # actually consults (and fails to reach) the sidecar first.
+        t0 = time.monotonic()
+
+        class LateWorker(FakeWorker):
+            def poll(self):
+                if self.rc is None and time.monotonic() - t0 > 0.5:
+                    self.rc = 0
+                return self.rc
+
+        return LateWorker(rc=None)
+
+    sup = FleetSupervisor(
+        lambda spec: ["true"],
+        1,
+        checkpoint_dir=tmp_path / "ckpt",
+        spawn=spawn,
+        poll_interval=0.01,
+        start_grace=1000.0,
+        healthz_url="http://127.0.0.1:9/healthz",  # port 9: nothing there
+        healthz_timeout=0.2,
+        obs=Observability(registry=MetricsRegistry()),
+    )
+    stats = sup.run()
+    assert stats.completed  # the dead sidecar never fails the fleet
+    assert any(e.kind == "healthz-unreachable" for e in stats.events)
+
+
+def test_supervisor_endpoint_serves_fleet_view(tmp_path):
+    """The supervisor's own endpoint: /healthz renders live verdicts from
+    the heartbeat plane, /metrics the aggregated view (synthetic beats —
+    the real-fleet half is the slow acceptance below)."""
+    from test_multihost import FakeWorker
+
+    from evox_tpu.obs import Observability
+    from evox_tpu.resilience.fleet import FleetSupervisor
+
+    hb = tmp_path / "ckpt" / "heartbeats"
+    hb.mkdir(parents=True)
+    reg = MetricsRegistry()
+    reg.counter("evox_runner_generations_total").inc(12)
+    done = threading.Event()  # the test decides when the worker completes
+
+    def spawn(argv, env, spec):
+        # The worker "publishes" one beat carrying metrics, then hangs
+        # until the test has scraped the supervisor's endpoint.
+        (hb / "host_0000.json").write_text(
+            json.dumps(
+                {
+                    "process_index": 0,
+                    "pid": 77,
+                    "time": time.time() + 3600,  # stays fresh
+                    "generation": 12,
+                    "metrics": reg.fleet_payload(),
+                }
+            )
+        )
+
+        class GatedWorker(FakeWorker):
+            def poll(self):
+                if self.rc is None and done.is_set():
+                    self.rc = 0
+                return self.rc
+
+        return GatedWorker(rc=None)
+
+    sup = FleetSupervisor(
+        lambda spec: ["true"],
+        1,
+        checkpoint_dir=tmp_path / "ckpt",
+        spawn=spawn,
+        poll_interval=0.02,
+        start_grace=1000.0,
+        endpoint=True,
+        obs=Observability(registry=MetricsRegistry()),
+    )
+    results: list = []
+    runner = threading.Thread(target=lambda: results.append(sup.run()))
+    runner.start()
+    try:
+        deadline = time.monotonic() + 60
+        scraped = None
+        while time.monotonic() < deadline:
+            try:
+                if sup.endpoint.started:
+                    status, text = _get(sup.endpoint.url + "/metrics")
+                    if (
+                        status == 200
+                        and "evox_runner_generations_total" in text
+                    ):
+                        scraped = text
+                        break
+            except OSError:
+                pass
+            time.sleep(0.05)
+        assert scraped is not None, "never scraped the aggregated view"
+        snap = _parse_prom(scraped)
+        assert snap["evox_runner_generations_total"] == 12
+        status, text = _get(sup.endpoint.url + "/healthz")
+        assert status == 200
+        assert json.loads(text)["hosts"]["0"]["alive"] is True
+        status, text = _get(sup.endpoint.url + "/statusz")
+        assert json.loads(text)["attempts"] == 1
+    finally:
+        done.set()
+        runner.join(timeout=60)
+    assert results and results[0].completed
+    # run()'s finally released the port.
+    with pytest.raises(OSError):
+        urllib.request.urlopen(sup.endpoint.url + "/metrics", timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance: a real multi-process fleet (slow; skips without plumbing)
+# ---------------------------------------------------------------------------
+
+
+def _sum_host_dumps(ckpt):
+    """Sum the per-host registry dumps the fleet workers wrote."""
+    counters: dict = {}
+    hists: dict = {}
+    gauges: dict = {}
+    for path in sorted(ckpt.glob("host_registry_*.json")):
+        host = int(path.stem.rsplit("_", 1)[1])
+        payload = json.loads(path.read_text())
+        for series, value in payload["counters"].items():
+            counters[series] = counters.get(series, 0.0) + value
+        for series, value in payload["gauges"].items():
+            gauges[(host, series)] = value
+        for series, hist in payload["histograms"].items():
+            agg = hists.setdefault(
+                series,
+                {"counts": [0.0] * len(hist["counts"]), "sum": 0.0,
+                 "count": 0.0},
+            )
+            agg["counts"] = [
+                a + b for a, b in zip(agg["counts"], hist["counts"])
+            ]
+            agg["sum"] += hist["sum"]
+            agg["count"] += hist["count"]
+    return counters, gauges, hists
+
+
+@pytest.mark.slow
+def test_fleet_metrics_aggregation_value_for_value(tmp_path):
+    """A real 2-process gloo fleet serves /metrics (via the supervisor's
+    endpoint) whose fleet-aggregated counters equal the sum of the
+    per-host registries value-for-value."""
+    import test_multihost as mh
+
+    if mh._fleet_unavailable() is not None:
+        pytest.skip(f"fleet harness unavailable: {mh._fleet_unavailable()}")
+    from evox_tpu.obs import Observability
+    from evox_tpu.resilience.fleet import FleetSupervisor
+
+    ckpt = tmp_path / "fleet"
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(
+        json.dumps(
+            {"n_steps": 8, "pop": 24, "dim": 4, "checkpoint_every": 2,
+             "seed": 0, "metrics": True}
+        )
+    )
+    import sys
+
+    sup = FleetSupervisor(
+        lambda spec: [
+            sys.executable, str(mh._WORKER), spec.checkpoint_dir,
+            str(cfg_path),
+        ],
+        2,
+        checkpoint_dir=ckpt,
+        env=mh._worker_env(),
+        poll_interval=0.1,
+        dead_after=20.0,
+        grace_seconds=6.0,
+        start_grace=300.0,
+        attempt_timeout=600.0,
+        endpoint=True,
+        obs=Observability(registry=MetricsRegistry()),
+    )
+    scrapes: list = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                status, text = _get(sup.endpoint.url + "/metrics")
+                if status == 200:
+                    scrapes.append(text)
+            except OSError:
+                pass
+            time.sleep(0.25)
+
+    poller = threading.Thread(target=scraper, daemon=True)
+    poller.start()
+    try:
+        stats = sup.run()
+    finally:
+        stop.set()
+        poller.join(timeout=10)
+    assert stats.completed and stats.attempts == 1
+    assert scrapes, "/metrics was never successfully served mid-run"
+    # Value-for-value: the aggregated registry (after run()'s final
+    # fold) vs the sum of the per-host dumps each worker wrote at exit.
+    counters, gauges, hists = _sum_host_dumps(ckpt)
+    assert counters, "workers dumped no registries"
+    snap = sup.aggregator.snapshot()
+    for series, expected in counters.items():
+        # Counters keep their original series name; the fleet value is
+        # the sum across hosts, exactly.
+        assert snap.get(series) == pytest.approx(expected), series
+    for (host, series), expected in gauges.items():
+        # Gauges are re-labeled per host; reconstruct the canonical
+        # fleet series name through a probe registry.
+        name, labels = parse_series(series)
+        labels["process_index"] = str(host)
+        probe = MetricsRegistry()
+        probe.gauge(name, **labels).set(0)
+        (fleet_series,) = probe.snapshot()
+        assert snap.get(fleet_series) == pytest.approx(expected), (
+            fleet_series
+        )
+    for series, expected in hists.items():
+        name, labels = parse_series(series)
+        assert snap.get(f"{name}_count") == pytest.approx(
+            expected["count"]
+        ), series
+        assert snap.get(f"{name}_sum") == pytest.approx(
+            expected["sum"], rel=1e-6
+        )
+    # Both hosts fed the view and are up.
+    assert snap.get('evox_fleet_host_up{process_index="0"}') == 1
+    assert snap.get('evox_fleet_host_up{process_index="1"}') == 1
+
+
+@pytest.mark.slow
+def test_fleet_healthz_flips_on_sigkill_and_marks_stale(tmp_path):
+    """SIGKILL one host of a real fleet: the endpoint's /healthz flips
+    non-200 within one staleness window (the dead host named), and the
+    aggregated /metrics marks the dead host's series stale="true"."""
+    import sys
+
+    import test_multihost as mh
+
+    if mh._fleet_unavailable() is not None:
+        pytest.skip(f"fleet harness unavailable: {mh._fleet_unavailable()}")
+    from evox_tpu.parallel.multihost import FleetHealth
+    from evox_tpu.resilience.fleet import FleetError, FleetSupervisor
+
+    ckpt = tmp_path / "fleet"
+    hb = ckpt / "heartbeats"
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(
+        json.dumps(
+            {
+                "n_steps": 40, "pop": 24, "dim": 4, "checkpoint_every": 2,
+                "seed": 0, "metrics": True,
+                "faults": {"0": {"kill": {"1": [10]}}},
+            }
+        )
+    )
+    DEAD_AFTER = 3.0
+    agg = FleetAggregator()
+    health = FleetHealth(hb, 2, dead_after=DEAD_AFTER, start_grace=600.0)
+
+    def metrics_text():
+        agg.update_from_dir(hb, health)
+        return agg.to_prometheus()
+
+    def healthz():
+        report = health.check()
+        return report.healthy, {
+            "dead": report.dead_hosts,
+            "wedged": report.wedged_hosts,
+            "slow": report.slow_hosts,
+            "hosts": {
+                str(i): {"beat_age": v.beat_age, "dead": v.dead}
+                for i, v in report.verdicts.items()
+            },
+        }
+
+    ep = IntrospectionEndpoint(metrics=metrics_text, healthz=healthz).start()
+    # The supervisor would normally relaunch; pin it to zero relaunches so
+    # the kill ends the run (the telemetry plane is what's under test).
+    sup = FleetSupervisor(
+        lambda spec: [
+            sys.executable, str(mh._WORKER), spec.checkpoint_dir,
+            str(cfg_path),
+        ],
+        2,
+        checkpoint_dir=ckpt,
+        env=mh._worker_env(),
+        poll_interval=0.1,
+        dead_after=60.0,   # the ENDPOINT is the detector under test
+        grace_seconds=6.0,
+        start_grace=300.0,
+        attempt_timeout=600.0,
+        max_relaunches=0,
+    )
+    results: list = []
+
+    def run():
+        try:
+            results.append(sup.run())
+        except FleetError as e:
+            results.append(e)
+
+    runner = threading.Thread(target=run)
+    runner.start()
+    try:
+        # Scrape /metrics while both hosts are alive so their REAL series
+        # (not just host_up) are folded fresh — the stale marking needs
+        # prior fresh series to mark.
+        deadline = time.monotonic() + 300
+        fed = False
+        while time.monotonic() < deadline:
+            _, text = _get(ep.url + "/metrics")
+            snap = _parse_prom(text)
+            if any(
+                'process_index="1"' in k
+                and not k.startswith("evox_fleet_host_up")
+                for k in snap
+            ):
+                fed = True
+                break
+            time.sleep(0.25)
+        assert fed, "host 1's series never fed the aggregated view"
+        # Now wait for the SIGKILL verdict: /healthz flips 503 naming 1.
+        # Keep folding /metrics meanwhile so the view tracks the fleet
+        # right up to (and past) the death.
+        flipped = None
+        while time.monotonic() < deadline:
+            _get(ep.url + "/metrics")
+            status, text = _get(ep.url + "/healthz")
+            if status != 200:
+                flipped = json.loads(text)
+                break
+            time.sleep(0.2)
+        assert flipped is not None, "/healthz never flipped non-200"
+        assert 1 in flipped["dead"]
+        # Within one staleness window: the verdict fired as soon as the
+        # beat aged past dead_after (+ generous scheduling slack).
+        age = flipped["hosts"]["1"]["beat_age"]
+        assert age is not None and age >= DEAD_AFTER
+        assert age <= DEAD_AFTER + 30.0, (
+            f"dead verdict took {age:.1f}s of staleness — detection "
+            f"lagged far past one window"
+        )
+        # And the aggregated export marks the dead host's series stale.
+        _, text = _get(ep.url + "/metrics")
+        assert 'process_index="1",stale="true"' in text
+        assert 'evox_fleet_host_up{process_index="1"} 0' in text
+    finally:
+        runner.join(timeout=600)
+        ep.stop()
+    assert results  # the supervisor ended (FleetError: budget of 0 spent)

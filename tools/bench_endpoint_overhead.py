@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""CPU microbenchmark: live-scrape cost on an instrumented serving daemon.
+
+The introspection endpoint's contract is that observation is free-ish:
+a fleet operator pointing Prometheus (1 Hz) and an ``evoxtop`` at a
+serving daemon must not tax the tenants it serves.  This gate runs ONE
+warmed, fully instrumented :class:`~evox_tpu.service.ServiceDaemon`
+(endpoint + SLO tracker + journal metrics armed — the ISSUE-13 plane)
+and measures per-tenant throughput over identical tenant batches in two
+interleaved conditions:
+
+* **unscraped** — the endpoint is up but idle;
+* **scraped** — a separate scraper PROCESS (like the Prometheus /
+  evoxtop it stands in for) GETs ``/metrics`` + ``/statusz`` +
+  ``/healthz`` once per second, the cadence an operator actually runs.
+
+Gate: scraped throughput >= 98% of unscraped (best-of-N per side — the
+endpoint cost is deterministic host work; one-sided scheduler noise is
+shed by the minimum).  The daemon and its compiled programs are shared
+by both sides, so the comparison isolates exactly the scrape handling.
+
+FAILS (exit 1) when the floor is violated.  Artifact:
+``bench_artifacts/endpoint_overhead.<backend>.json`` (CPU-provisional in
+BENCH_HISTORY like every bench since PR 6 — no TPU attachment here).
+
+Run via::
+
+    ./run_tests.sh --obs      # suite + the other obs gates + this one
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_endpoint_overhead.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.algorithms import PSO  # noqa: E402
+from evox_tpu.obs import OBS_SCHEMA_VERSION, default_slos  # noqa: E402
+from evox_tpu.problems.numerical import Ackley  # noqa: E402
+from evox_tpu.service import ServiceDaemon, TenantSpec  # noqa: E402
+
+TENANTS = 8
+LANES = 8
+POP, DIM = 8, 4          # the dispatch-bound service gate config (PR 8)
+SEGMENT = 16
+N_STEPS = 512            # per tenant per repeat: ~seconds of wall on CPU,
+                         # enough for several 1 Hz scrapes to land
+REPEATS = 3
+FLOOR = 0.98
+SCRAPE_HZ = 1.0
+
+LB = -5.0 * jnp.ones(DIM)
+UB = 5.0 * jnp.ones(DIM)
+
+
+def _submit_batch(daemon: ServiceDaemon, round_id: int) -> None:
+    for i in range(TENANTS):
+        daemon.submit(
+            TenantSpec(
+                f"bench-r{round_id}-t{i}",
+                PSO(POP, LB, UB),
+                Ackley(),
+                n_steps=N_STEPS,
+            )
+        )
+
+
+def _timed_round(daemon: ServiceDaemon, round_id: int) -> float:
+    _submit_batch(daemon, round_id)
+    t0 = time.perf_counter()
+    daemon.run()
+    seconds = time.perf_counter() - t0
+    for i in range(TENANTS):  # retire so records/namespaces stay bounded
+        daemon.forget(f"bench-r{round_id}-t{i}")
+    return seconds
+
+
+_SCRAPER_SRC = """
+import json, sys, time, urllib.request
+url, hz = sys.argv[1], float(sys.argv[2])
+scrapes = failures = 0
+try:
+    while True:
+        time.sleep(1.0 / hz)
+        for path in ("/metrics", "/statusz", "/healthz"):
+            try:
+                urllib.request.urlopen(url + path, timeout=5).read()
+                scrapes += 1
+            except Exception:
+                failures += 1
+            sys.stdout.write(json.dumps({"s": scrapes, "f": failures}) + "\\n")
+            sys.stdout.flush()
+except KeyboardInterrupt:
+    pass
+"""
+
+
+class _Scraper:
+    """A 1 Hz operator in its OWN process — like the real Prometheus /
+    evoxtop it stands in for.  (An in-process scraper thread would also
+    charge the daemon for the CLIENT half of every request through the
+    GIL, which no deployment pays.)"""
+
+    def __init__(self, url: str):
+        import subprocess
+
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", _SCRAPER_SRC, url, str(SCRAPE_HZ)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+        )
+        self.scrapes = 0
+        self.failures = 0
+
+    def stop(self) -> None:
+        self.proc.terminate()
+        out, _ = self.proc.communicate(timeout=30)
+        lines = [l for l in out.decode().splitlines() if l.strip()]
+        if lines:
+            last = json.loads(lines[-1])
+            self.scrapes = int(last["s"])
+            self.failures = int(last["f"])
+
+
+def main() -> int:
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    workdir = tempfile.mkdtemp(prefix="evox_endpoint_bench_", dir=base)
+    try:
+        daemon = ServiceDaemon(
+            os.path.join(workdir, "root"),
+            lanes_per_pack=LANES,
+            segment_steps=SEGMENT,
+            seed=0,
+            preemption=False,
+            endpoint=True,
+            slos=default_slos(
+                segment_seconds=60.0, gens_per_sec=0.001, window_seconds=300.0
+            ),
+        )
+        daemon.start()
+        _timed_round(daemon, 99)  # warm: compiles + exec-cache amortized out
+        seconds = {"unscraped": [], "scraped": []}
+        scrapes = failures = 0
+        for r in range(REPEATS):
+            seconds["unscraped"].append(_timed_round(daemon, 2 * r))
+            scraper = _Scraper(daemon.endpoint.url)
+            try:
+                seconds["scraped"].append(_timed_round(daemon, 2 * r + 1))
+            finally:
+                scraper.stop()
+            scrapes += scraper.scrapes
+            failures += scraper.failures
+        daemon.close()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    per_tenant = {
+        side: N_STEPS / min(times) for side, times in seconds.items()
+    }
+    ratio = per_tenant["scraped"] / per_tenant["unscraped"]
+    result = {
+        "bench": "endpoint_scrape_overhead",
+        "obs_schema_version": OBS_SCHEMA_VERSION,
+        "backend": jax.default_backend(),
+        "tenants": TENANTS,
+        "lanes": LANES,
+        "pop_size": POP,
+        "dim": DIM,
+        "segment_steps": SEGMENT,
+        "n_steps": N_STEPS,
+        "repeats": REPEATS,
+        "scrape_hz": SCRAPE_HZ,
+        "scrapes_served": scrapes,
+        "scrape_failures": failures,
+        "seconds": seconds,
+        "per_tenant_gens_per_sec": per_tenant,
+        "throughput_ratio": ratio,
+        "floor_ratio": FLOOR,
+        "within_budget": ratio >= FLOOR and failures == 0 and scrapes > 0,
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(
+        out_dir, f"endpoint_overhead.{jax.default_backend()}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"endpoint scrape overhead ({TENANTS} tenants x {N_STEPS} gens, "
+        f"{SCRAPE_HZ:.0f} Hz scraper, best-of-{REPEATS}):\n"
+        f"  unscraped {per_tenant['unscraped']:7.1f} gen/s/tenant\n"
+        f"  scraped   {per_tenant['scraped']:7.1f} gen/s/tenant = "
+        f"{ratio * 100:5.1f}% (floor {FLOOR * 100:.0f}%)\n"
+        f"  {scrapes} scrapes served, {failures} failures"
+    )
+    print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+    if scrapes == 0:
+        print(
+            "FAIL: the scraper never landed a scrape — the measurement is "
+            "vacuous (rounds too short?)",
+            file=sys.stderr,
+        )
+        return 1
+    if failures:
+        print(
+            f"FAIL: {failures} scrape(s) failed against a live daemon",
+            file=sys.stderr,
+        )
+        return 1
+    if ratio < FLOOR:
+        print(
+            f"FAIL: scraped throughput {ratio * 100:.1f}% is under the "
+            f"{FLOOR * 100:.0f}% floor",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""evoxtop: terminal snapshot of a serving daemon / fleet, over HTTP.
+
+A curses-free ``top`` for operators: fetches a daemon's (or fleet
+supervisor's) introspection endpoint — ``/statusz`` + ``/healthz`` — and
+renders one readable screen: health verdicts, queue depths per admission
+class, SLO burn rates, the decision tail, and the tenant table.
+
+Usage::
+
+    python tools/evoxtop.py http://127.0.0.1:8080           # one snapshot
+    python tools/evoxtop.py http://127.0.0.1:8080 -n 2      # refresh every 2s
+    python tools/evoxtop.py http://127.0.0.1:8080 --tenants 40
+
+jax-free and stdlib-only: runs anywhere the endpoint is reachable.
+Exit code 0 on a healthy scrape, 2 when ``/healthz`` reports unhealthy
+(so the one-shot mode doubles as a probe), 1 when the endpoint is
+unreachable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+__all__ = ["fetch", "render", "main"]
+
+_STATUS_ORDER = ["running", "queued", "evicted", "quarantined", "completed"]
+
+
+def fetch(url: str, timeout: float = 5.0) -> tuple[int, dict]:
+    """GET ``url`` and parse the JSON body; returns (status, body).
+    A 503 from /healthz still carries the verdict body."""
+    try:
+        resp = urllib.request.urlopen(url, timeout=timeout)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except (ValueError, OSError):
+            return e.code, {}
+
+
+def _fmt(value, digits: int = 2) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:.{digits}f}"
+    return str(value)
+
+
+def render(
+    status: dict, health_code: int, health: dict, *, max_tenants: int = 20
+) -> str:
+    """One screenful from a /statusz + /healthz pair."""
+    lines: list[str] = []
+    healthy = health_code == 200
+    stamp = time.strftime("%H:%M:%S")
+    lines.append(
+        f"evoxtop  {stamp}   health: "
+        + ("OK" if healthy else f"UNHEALTHY (HTTP {health_code})")
+        + (
+            f"   brownout: {'ON' if status.get('brownout') else 'off'}"
+            f"   round: {_fmt(status.get('round_seconds'), 3)}s"
+            f"   segment: {_fmt(status.get('segment_steps'))} gens"
+        )
+    )
+    hosts = health.get("hosts")
+    if hosts:
+        bad = []
+        for idx in sorted(hosts, key=int):
+            v = hosts[idx]
+            verdict = (
+                "dead"
+                if v.get("dead")
+                else "wedged"
+                if v.get("wedged")
+                else "slow"
+                if v.get("slow")
+                else "ok"
+            )
+            bad.append(f"{idx}:{verdict}@gen{_fmt(v.get('generation'))}")
+        lines.append(f"hosts ({len(hosts)}): " + "  ".join(bad))
+    queue = status.get("queue_depth") or {}
+    budget = status.get("queue_budget") or {}
+    if queue:
+        lines.append(
+            "queue: "
+            + "  ".join(
+                f"{cls} {depth}/{_fmt(budget.get(cls))}"
+                for cls, depth in sorted(queue.items())
+            )
+        )
+    stats = status.get("stats") or {}
+    if stats:
+        lines.append(
+            f"stats: segments {_fmt(stats.get('segments_run'))}"
+            f"  admitted {_fmt(stats.get('admitted'))}"
+            f"  completed {_fmt(stats.get('completed'))}"
+            f"  restarts {_fmt(stats.get('restarts'))}"
+            f"  sheds {_fmt(stats.get('sheds'))}"
+            f"  rejections {_fmt(stats.get('rejections'))}"
+        )
+    cache = status.get("exec_cache")
+    if cache:
+        rate = cache.get("hit_rate")
+        lines.append(
+            f"exec cache: {_fmt(cache.get('hits'))} hits / "
+            f"{_fmt(cache.get('misses'))} misses"
+            + (f"  ({rate * 100:.0f}% hit rate)" if rate is not None else "")
+        )
+    for slo in status.get("slo") or ():
+        lines.append(
+            f"slo {slo.get('slo')}[{slo.get('tenant_class')}"
+            f"/{slo.get('window')}]: burn {_fmt(slo.get('burn_rate'))}"
+            f"  budget {_fmt(slo.get('budget_remaining'))}"
+            f"  ({_fmt(slo.get('good'))} good / {_fmt(slo.get('bad'))} bad)"
+        )
+    decisions = status.get("decisions") or []
+    if decisions:
+        tail = decisions[-3:]
+        lines.append(
+            "decisions: "
+            + "  ".join(
+                f"#{d.get('seq')} {d.get('kind')}={d.get('action')}"
+                for d in tail
+            )
+        )
+    tenants = status.get("tenants") or {}
+    counts = status.get("tenant_counts") or {}
+    if counts:
+        lines.append(
+            f"tenants ({len(tenants)}): "
+            + "  ".join(
+                f"{s} {counts[s]}"
+                for s in _STATUS_ORDER + sorted(set(counts) - set(_STATUS_ORDER))
+                if s in counts
+            )
+        )
+    if tenants:
+        lines.append(
+            f"  {'id':<24} {'status':<12} {'gens':>6} {'of':>6} "
+            f"{'lane':>4}  class"
+        )
+        shown = 0
+        # Running first, then queued — the rows an operator acts on.
+        order = sorted(
+            tenants.items(),
+            key=lambda kv: (
+                _STATUS_ORDER.index(kv[1].get("status"))
+                if kv[1].get("status") in _STATUS_ORDER
+                else len(_STATUS_ORDER),
+                kv[0],
+            ),
+        )
+        for tid, t in order:
+            if shown >= max_tenants:
+                lines.append(f"  ... {len(tenants) - shown} more")
+                break
+            lines.append(
+                f"  {tid[:24]:<24} {t.get('status', '?'):<12} "
+                f"{_fmt(t.get('generations')):>6} {_fmt(t.get('n_steps')):>6} "
+                f"{_fmt(t.get('lane')):>4}  {t.get('class', '-')}"
+            )
+            shown += 1
+    return "\n".join(lines)
+
+
+def main(argv: list | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Terminal snapshot view over an evox_tpu introspection "
+        "endpoint (/statusz + /healthz)."
+    )
+    parser.add_argument(
+        "url", help="endpoint base URL, e.g. http://127.0.0.1:8080"
+    )
+    parser.add_argument(
+        "-n",
+        "--interval",
+        type=float,
+        default=None,
+        help="refresh every N seconds (default: one snapshot and exit)",
+    )
+    parser.add_argument(
+        "--tenants",
+        type=int,
+        default=20,
+        help="max tenant rows to show (default 20)",
+    )
+    parser.add_argument(
+        "--timeout", type=float, default=5.0, help="per-request timeout"
+    )
+    args = parser.parse_args(argv)
+    base = args.url.rstrip("/")
+    while True:
+        try:
+            _, status = fetch(base + "/statusz", args.timeout)
+            health_code, health = fetch(base + "/healthz", args.timeout)
+        except (OSError, ValueError) as e:
+            print(f"evoxtop: {base} unreachable ({e})", file=sys.stderr)
+            return 1
+        screen = render(
+            status, health_code, health, max_tenants=args.tenants
+        )
+        if args.interval is None:
+            print(screen)
+            return 0 if health_code == 200 else 2
+        # ANSI clear + home: a poor man's top, no curses dependency.
+        sys.stdout.write("\x1b[2J\x1b[H" + screen + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

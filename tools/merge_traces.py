@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Merge per-host Chrome traces into one Perfetto-loadable fleet trace.
+
+Every fleet worker's :class:`~evox_tpu.obs.Tracer` writes its own
+Chrome-trace JSON with timestamps relative to its own ``perf_counter``
+origin.  Loading N of those side by side in Perfetto is useless: the
+lanes collide (OS pids can repeat across hosts) and the clocks share no
+origin.  This tool builds the fleet view:
+
+* **one lane per host** — each input's events are stamped with
+  ``pid = process_index`` (the trace's own ``otherData.process_index``
+  when the worker passed ``Tracer(process_index=...)``, else the input's
+  position on the command line), plus a ``process_name`` metadata event
+  so Perfetto labels the lane ``host <i>``;
+* **clocks aligned** — every tracer records a ``wall_anchor`` (the wall
+  clock at its monotonic origin — the same wall clock its heartbeat
+  beats are stamped with, so lanes line up with the beat timeline a
+  supervisor recorded).  Events are shifted onto the earliest anchor:
+  ``ts' = ts + (wall_anchor - min_anchor) * 1e6``.
+
+Usage::
+
+    python tools/merge_traces.py host0.json host1.json ... -o fleet.json
+
+jax-free and stdlib-only: runs on an operator box with nothing but the
+trace files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+__all__ = ["merge_traces", "main"]
+
+
+def _load(path: Path) -> dict:
+    with open(path) as f:
+        trace = json.load(f)
+    if "traceEvents" not in trace:
+        raise ValueError(f"{path} is not a Chrome trace (no traceEvents)")
+    return trace
+
+
+def merge_traces(paths: list, *, strict: bool = False) -> dict:
+    """Merge the Chrome traces at ``paths`` into one trace object.
+
+    Hosts are identified by each trace's ``otherData.process_index``
+    (fallback: position in ``paths``).  Traces without a ``wall_anchor``
+    (non-evox producers) keep their own origin — with ``strict=True``
+    that is an error instead.
+    """
+    traces = []
+    for i, path in enumerate(paths):
+        trace = _load(Path(path))
+        other = trace.get("otherData") or {}
+        host = other.get("process_index")
+        traces.append((i if host is None else int(host), trace))
+    seen: dict[int, int] = {}
+    for host, _ in traces:
+        seen[host] = seen.get(host, 0) + 1
+    dupes = sorted(h for h, n in seen.items() if n > 1)
+    if dupes:
+        raise ValueError(
+            f"duplicate process_index {dupes} across inputs — two hosts "
+            f"sharing a lane would interleave their spans; re-export with "
+            f"Tracer(process_index=...) set per host"
+        )
+    anchors = [
+        (t.get("otherData") or {}).get("wall_anchor") for _, t in traces
+    ]
+    known = [a for a in anchors if a is not None]
+    if strict and len(known) != len(traces):
+        raise ValueError(
+            "some inputs carry no wall_anchor; their clocks cannot be "
+            "aligned (re-record with evox_tpu.obs.Tracer, or drop --strict)"
+        )
+    origin = min(known) if known else 0.0
+    events = []
+    schema = None
+    for (host, trace), anchor in zip(traces, anchors):
+        shift_us = 0.0 if anchor is None else (float(anchor) - origin) * 1e6
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": host,
+                "tid": 0,
+                "args": {"name": f"host {host}"},
+            }
+        )
+        for ev in trace["traceEvents"]:
+            out = dict(ev)
+            out["pid"] = host
+            if "ts" in out:
+                out["ts"] = float(out["ts"]) + shift_us
+            events.append(out)
+        schema = schema or (trace.get("otherData") or {}).get("schema")
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "schema": schema,
+            "producer": "evox_tpu.tools.merge_traces",
+            "wall_anchor": origin,
+            "hosts": sorted(h for h, _ in traces),
+        },
+    }
+
+
+def main(argv: list | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Merge per-host Chrome traces into one fleet trace "
+        "(one Perfetto lane per process_index, clocks aligned on the "
+        "recorded wall anchors)."
+    )
+    parser.add_argument("inputs", nargs="+", help="per-host trace JSON files")
+    parser.add_argument(
+        "-o", "--out", required=True, help="merged trace output path"
+    )
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="fail on inputs without a wall_anchor instead of leaving "
+        "their clocks unaligned",
+    )
+    args = parser.parse_args(argv)
+    try:
+        merged = merge_traces(args.inputs, strict=args.strict)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"merge_traces: {e}", file=sys.stderr)
+        return 1
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(merged, f)
+        f.write("\n")
+    n = len(merged["traceEvents"])
+    print(
+        f"merged {len(args.inputs)} trace(s) -> {out} "
+        f"({n} events, hosts {merged['otherData']['hosts']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
