@@ -955,6 +955,70 @@ def bench_service_pack(n_steps, profile_dir=None):
     }
 
 
+def bench_hpo_ladder(n_steps, profile_dir=None):
+    """evosax-style meta-batched ES ladder (ROADMAP item 3's acceptance
+    bench): outer 64 candidates x inner pop 1024 x 32 inner generations
+    per outer evaluation, on one mesh.  Each outer ask-eval-tell's
+    evaluate is ONE XLA program — a ``jax.vmap`` of the inner workflow's
+    fused segment program (``evox_tpu.hpo.NestedProblem``).  Value is
+    whole-ladder inner generations/sec; per-candidate gen/s rides in the
+    artifact."""
+    del profile_dir
+    import jax
+    import jax.numpy as jnp
+
+    from evox_tpu.algorithms import PSO, OpenES
+    from evox_tpu.hpo import HPOFitnessMonitor, NestedProblem
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    candidates, inner_pop, iterations, dim = 64, 1024, 32, 32
+    inner = StdWorkflow(
+        OpenES(
+            inner_pop, jnp.zeros(dim), learning_rate=0.05, noise_stdev=0.1
+        ),
+        Sphere(),
+        monitor=HPOFitnessMonitor(),
+    )
+    nested = NestedProblem(
+        inner, iterations=iterations, num_candidates=candidates
+    )
+    outer = StdWorkflow(
+        PSO(candidates, lb=1e-3 * jnp.ones(2), ub=0.5 * jnp.ones(2)),
+        nested,
+        solution_transform=lambda x: {
+            "algorithm.lr": jnp.clip(x[:, 0], 1e-3, 0.5),
+            "algorithm.noise_stdev": jnp.clip(x[:, 1], 1e-3, 0.5),
+        },
+    )
+    state = outer.init(jax.random.key(0))
+    state = jax.jit(outer.init_step)(state)
+    step = jax.jit(outer.step)
+    state = step(state)
+    jax.block_until_ready(state)  # warm: one compiled outer generation
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state = step(state)
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+    inner_gens = n_steps * candidates * iterations
+    return {
+        "metric": (
+            "HPO meta-ladder inner generations/sec (outer 64 x inner "
+            "1024 x 32 gens, PSO-over-OpenES, Sphere d=32)"
+        ),
+        "value": round(inner_gens / elapsed, 3),
+        "unit": "inner generations/sec",
+        "outer_gens_per_sec": round(n_steps / elapsed, 4),
+        "per_candidate_gens_per_sec": round(
+            inner_gens / elapsed / candidates, 3
+        ),
+        "candidates": candidates,
+        "inner_pop": inner_pop,
+        "iterations": iterations,
+    }
+
+
 def bench_distributed_8dev(n_steps, profile_dir=None):
     """Population-sharded evaluation over all local devices (the reference's
     `torchrun` + NCCL all_gather path, here shard_map + one XLA all-gather).
@@ -1132,6 +1196,7 @@ CONFIGS = {
     "vmapped_instances": (bench_vmapped_instances, 200, 50),
     "vmapped_instances_resilient": (bench_vmapped_instances_resilient, 200, 50),
     "service_pack": (bench_service_pack, 200, 50),
+    "hpo_ladder": (bench_hpo_ladder, 20, 2),
     "distributed_8dev": (bench_distributed_8dev, 100, 10),
     "distributed_8dev_resilient": (bench_distributed_8dev_resilient, 100, 10),
     "scaling": (bench_scaling, 100, 10),

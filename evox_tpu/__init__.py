@@ -12,6 +12,7 @@ from . import (
     algorithms,
     control,
     core,
+    hpo,
     metrics,
     obs,
     operators,
@@ -40,6 +41,7 @@ __all__ = [
     "algorithms",
     "control",
     "core",
+    "hpo",
     "metrics",
     "obs",
     "operators",

@@ -35,6 +35,7 @@ from .controller import (
     decide,
     decide_brownout,
     decide_cadence,
+    decide_hpo_grow,
     decide_shed,
     decide_tenant,
     decide_trend,
@@ -48,6 +49,7 @@ __all__ = [
     "decide",
     "decide_brownout",
     "decide_cadence",
+    "decide_hpo_grow",
     "decide_shed",
     "decide_tenant",
     "decide_trend",

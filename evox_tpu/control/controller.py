@@ -56,6 +56,7 @@ __all__ = [
     "decide",
     "decide_brownout",
     "decide_cadence",
+    "decide_hpo_grow",
     "decide_shed",
     "decide_tenant",
     "decide_trend",
@@ -228,12 +229,50 @@ def decide_tenant(evidence: Mapping[str, Any]) -> str:
     return "restart" if used < budget else "quarantine"
 
 
+def decide_hpo_grow(evidence: Mapping[str, Any]) -> str:
+    """Elastic inner-population growth for a meta-optimization ladder
+    (``evox_tpu.hpo``): ``"hold"``, or the target inner population as a
+    decimal string.  Grows when the triggering candidate's *inner*
+    best-fitness slope projects less than ``stagnation_tol`` total
+    improvement over the windowed span (minimizing frame — the
+    ``decide_trend`` stagnation form, applied to the inner series), the
+    span has reached ``stagnation_window`` inner generations, and the
+    ladder has headroom (``inner_pop * growth_factor``, capped at
+    ``max_inner_pop``, still exceeds the current population).  Missing
+    signals hold — growth is advisory, never load-bearing."""
+    tol = _num(evidence, "stagnation_tol")
+    min_span = _num(evidence, "stagnation_window")
+    slope = _num(evidence, "best_slope")
+    span = _num(evidence, "span") or 0.0
+    if (
+        tol is None
+        or min_span is None
+        or min_span <= 0
+        or slope is None
+        or span < min_span
+        or (-slope) * span > tol
+    ):
+        return "hold"
+    pop = int(_num(evidence, "inner_pop") or 0)
+    if pop < 1:
+        return "hold"
+    factor = _num(evidence, "growth_factor") or 2.0
+    new_pop = max(int(round(pop * factor)), pop + 1)
+    cap = _num(evidence, "max_inner_pop")
+    if cap is not None:
+        new_pop = min(new_pop, int(cap))
+    if new_pop <= pop:
+        return "hold"
+    return str(new_pop)
+
+
 _DECIDERS: dict[str, Callable[[Mapping[str, Any]], Any]] = {
     "trend": lambda e: decide_trend(e) or "",
     "cadence": lambda e: str(decide_cadence(e)),
     "brownout": decide_brownout,
     "shed-threshold": lambda e: str(decide_shed(e)),
     "tenant": decide_tenant,
+    "hpo-grow": decide_hpo_grow,
     "degrade": lambda e: "threshold-probes",
 }
 
@@ -770,6 +809,46 @@ class Controller:
             ),
             generation=generation,
         )
+
+    def hpo_grow(
+        self,
+        *,
+        evidence: Mapping[str, Any],
+        generation: int,
+        tenant_id: str | None = None,
+    ):
+        """Consult the elastic inner-population ladder
+        (:mod:`evox_tpu.hpo`) with one grow-evidence dict (built by
+        :func:`evox_tpu.hpo.grow_evidence` — the triggering candidate's
+        windowed inner best-fitness slope plus the ladder thresholds in
+        force).  Returns the journaled ``hpo-grow``
+        :class:`~evox_tpu.control.Decision` when
+        :func:`decide_hpo_grow` says grow, ``None`` on hold.  Fired
+        growths observe the same per-key quiet window as trend verdicts
+        (the regrown ladder's fresh series must not instantly re-trip).
+        Never raises — failures degrade the ``hpo-grow`` plane to "no
+        growth" with one structured warning, and the meta-run continues
+        on its threshold probes."""
+
+        def act():
+            key = f"hpo-grow:{tenant_id or '__run__'}"
+            if generation <= self._quiet_until.get(key, -1):
+                return None
+            action = decide_hpo_grow(evidence)
+            if action == "hold":
+                return None
+            self._quiet_until[key] = int(generation) + self.grace
+            return self._emit(
+                "hpo-grow",
+                action,
+                generation=generation,
+                evidence=evidence,
+                policy="hpo-grow",
+                tenant_id=tenant_id,
+                warn=True,
+            )
+
+        return self._guard("hpo-grow", act, generation=generation)
 
     def brownout(
         self,

@@ -1,0 +1,55 @@
+"""Meta-optimization as a first-class workload (ROADMAP item 3).
+
+The ``evox_tpu.hpo`` subsystem makes hyper-parameter optimization — an
+entire inner workflow batch evaluated as the outer problem — a production
+workload rather than a wrapper:
+
+* :class:`NestedProblem` — the **fused nested runner**: one outer
+  evaluate is ONE ``jax.vmap`` of the inner workflow's fused segment
+  program, so ``outer_pop × inner_pop × inner_generations`` is a single
+  XLA program, with identity-keyed (``fold_in(outer_key, candidate_uid)``)
+  nested PRNG isolation and per-candidate inner telemetry batched out;
+* :class:`HPORunner` — **resumable nested state**: outer + the full
+  batch of inner states checkpoint through the existing resilient store,
+  manifests record the inner algorithm/bucket metadata plus the
+  per-candidate history ring, and a SIGTERM/SIGKILL mid-meta-run resumes
+  bit-identically;
+* :class:`GrowthLadder` / :class:`HPOGrowPolicy` — **elastic inner
+  populations**: inner-run stagnation trends fire journaled
+  ``Decision(kind="hpo-grow")`` records that regrow the ladder at
+  segment boundaries, replayable bit-for-bit;
+* the **service workload type** — ``TenantSpec(workload="hpo")`` packs
+  meta-runs into :class:`~evox_tpu.service.OptimizationService` /
+  :class:`~evox_tpu.service.ServiceDaemon` beside ordinary tenants with
+  full bulkhead isolation, journal durability, exec-cache prewarm of the
+  nested program, and per-tenant ``evox_hpo_*`` metrics.
+
+:class:`HPOMonitor` / :class:`HPOFitnessMonitor` (the inner-run scoring
+contract) live here too;
+:mod:`evox_tpu.problems.hpo_wrapper` remains as a thin back-compat shim
+over this subsystem.
+"""
+
+from .elastic import (
+    GrowthLadder,
+    HPOGrowPolicy,
+    grow_evidence,
+    validate_ladder_window,
+)
+from .monitor import HPO_REPEAT_AXIS, HPOFitnessMonitor, HPOMonitor
+from .nested import NestedProblem, candidate_series, find_nested
+from .runner import HPORunner
+
+__all__ = [
+    "HPO_REPEAT_AXIS",
+    "HPOFitnessMonitor",
+    "HPOMonitor",
+    "NestedProblem",
+    "HPORunner",
+    "GrowthLadder",
+    "HPOGrowPolicy",
+    "candidate_series",
+    "find_nested",
+    "grow_evidence",
+    "validate_ladder_window",
+]

@@ -1,0 +1,162 @@
+"""HPO inner-run monitors: how an inner workflow reports its score.
+
+The meta-optimization contract: the inner workflow's monitor must expose
+the run's final score via ``tell_fitness(state)`` — that scalar (or
+per-objective vector) is the outer problem's fitness for the
+hyper-parameter set the run evaluated (reference
+``hpo_wrapper.py:41-58``).
+
+``num_repeats`` semantics match the reference exactly: with repeats, the
+*algorithm* in each repeat lane adapts on its own raw fitness, while the
+*monitor* aggregates fitness across repeats **inside every generation**
+(mean by default) before updating its best — "best of per-generation
+mean" (reference ``hpo_wrapper.py:19-38`` custom-op aggregation +
+``:83-96``).  The reference needs a vmap-aware ``torch.library`` custom
+op for that cross-lane mean; in JAX it is a named-axis collective: the
+repeat vmap carries ``axis_name=HPO_REPEAT_AXIS`` and the monitor
+reduces over it with ``lax.all_gather``.  The simpler end-of-run
+estimator (aggregate each lane's final best) remains available as
+``aggregation="final"`` on the wrapping problem.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core import Monitor, State
+
+__all__ = ["HPOMonitor", "HPOFitnessMonitor", "HPO_REPEAT_AXIS"]
+
+#: vmap axis name carried by the repeats axis inside
+#: :meth:`NestedProblem.evaluate <evox_tpu.hpo.NestedProblem.evaluate>`;
+#: HPO monitors reduce over it.
+HPO_REPEAT_AXIS = "hpo_repeat"
+
+#: Trace-scoped repeat wiring ``(num_repeats, fit_aggregation)`` installed by
+#: :meth:`NestedProblem.evaluate` for the duration of its trace.  A
+#: ``ContextVar`` (not attribute mutation on the shared monitor object) so
+#: that (a) concurrent traces in different threads/contexts cannot observe
+#: each other's wiring, and (b) nested wrappers (HPO-of-HPO) save/restore
+#: correctly via token reset.
+_REPEAT_WIRING: contextvars.ContextVar[tuple[int, Callable] | None] = (
+    contextvars.ContextVar("hpo_repeat_wiring", default=None)
+)
+
+
+def _reduce_axis(fn: Callable, arr: jax.Array, axis: int) -> jax.Array:
+    """Apply a repeats reduction.  Preferred contract is ``fn(arr, axis=...)``
+    (like ``jnp.mean``); 1-D reducers ``fn(vec) -> scalar`` are accepted for
+    back-compat and applied along ``axis``."""
+    try:
+        return fn(arr, axis=axis)
+    except TypeError:
+        return jnp.apply_along_axis(fn, axis, arr)
+
+
+class HPOMonitor(Monitor):
+    """Base monitor for HPO inner workflows: must expose the inner run's
+    final score via ``tell_fitness`` (reference ``hpo_wrapper.py:41-58``).
+
+    Subclasses aggregate each generation's fitness across repeats by
+    calling :meth:`aggregate_repeats` in ``pre_tell`` — never by reading
+    ``self.num_repeats`` directly: when the monitor runs inside a
+    :class:`~evox_tpu.hpo.NestedProblem` evaluation, the wrapper's
+    trace-scoped wiring (repeat count + reduction) takes precedence over
+    the constructor values, and only ``aggregate_repeats`` sees it.
+
+    :param num_repeats: repeat count used when the monitor runs standalone
+        (outside a wrapper's trace).
+    :param fit_aggregation: reduction over the repeats axis, called as
+        ``fit_aggregation(stacked, axis=0)`` (default ``jnp.mean`` — the
+        reference's mean-of-repeats, ``hpo_wrapper.py:19-38``).
+    """
+
+    def __init__(
+        self,
+        num_repeats: int = 1,
+        fit_aggregation: Callable = jnp.mean,
+    ):
+        self.num_repeats = num_repeats
+        self.fit_aggregation = fit_aggregation
+
+    def aggregate_repeats(self, fitness: jax.Array) -> jax.Array:
+        """Cross-repeat aggregation of this generation's fitness.  Inside the
+        wrapper's repeat vmap this is a collective over the named axis: every
+        lane receives the same aggregated tensor (the JAX-native equivalent
+        of the reference's vmap-registered mean custom op).
+
+        Repeat wiring installed by a surrounding
+        :meth:`NestedProblem.evaluate` trace (via the context-local
+        ``_REPEAT_WIRING``) takes precedence over the constructor
+        attributes, so one monitor instance can serve several wrappers."""
+        wiring = _REPEAT_WIRING.get()
+        num_repeats, fit_aggregation = (
+            wiring if wiring is not None
+            else (self.num_repeats, self.fit_aggregation)
+        )
+        if num_repeats <= 1:
+            return fitness
+        try:
+            stacked = jax.lax.all_gather(fitness, HPO_REPEAT_AXIS, axis=0)
+        except NameError:
+            # The repeat axis is only bound inside NestedProblem's
+            # per-generation vmap; running the same (already-wired) monitor
+            # standalone or under "final" aggregation traces with no such
+            # axis — degrade to the raw per-lane fitness.
+            return fitness
+        return _reduce_axis(fit_aggregation, stacked, 0)
+
+    def tell_fitness(self, state: State) -> jax.Array:
+        """The scalar (or per-objective) fitness this inner run reports to
+        the outer algorithm.  Abstract: subclasses define what "fitness of
+        a run" means (e.g. best-so-far)."""
+        raise NotImplementedError(
+            "`tell_fitness` function is not implemented. It must be overwritten."
+        )
+
+
+class HPOFitnessMonitor(HPOMonitor):
+    """Tracks the best fitness value seen by the inner workflow
+    (reference ``hpo_wrapper.py:61-103``)."""
+
+    def __init__(
+        self,
+        multi_obj_metric: Callable | None = None,
+        num_repeats: int = 1,
+        fit_aggregation: Callable = jnp.mean,
+    ):
+        """
+        :param multi_obj_metric: scalarizing metric for multi-objective inner
+            problems, e.g. ``lambda f: igd(f, problem.pf())``; unused for
+            single-objective.
+        """
+        if multi_obj_metric is not None and not callable(multi_obj_metric):
+            raise ValueError(
+                f"Expect `multi_obj_metric` to be `None` or callable, got "
+                f"{multi_obj_metric}"
+            )
+        super().__init__(num_repeats, fit_aggregation)
+        self.multi_obj_metric = multi_obj_metric
+
+    def setup(self, key: jax.Array) -> State:
+        del key
+        return State(best_fitness=jnp.asarray(jnp.inf))
+
+    def pre_tell(self, state: State, fitness: jax.Array) -> State:
+        fitness = self.aggregate_repeats(fitness)
+        if fitness.ndim == 1:
+            value = jnp.min(fitness)
+        else:
+            value = self.multi_obj_metric(fitness)
+        return state.replace(
+            best_fitness=jnp.minimum(value, state.best_fitness)
+        )
+
+    def tell_fitness(self, state: State) -> jax.Array:
+        """Best fitness seen over the inner run (the wrapped workflow's
+        objective value for these hyper-parameters)."""
+        return state.best_fitness

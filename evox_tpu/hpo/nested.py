@@ -1,0 +1,453 @@
+"""The fused nested runner: an entire inner workflow batch as ONE program.
+
+:class:`NestedProblem` is the meta-optimization core (ROADMAP item 3,
+EvoX's ``HPOProblemWrapper`` capability): the outer population is a batch
+of hyper-parameter sets, and evaluating it runs ``num_candidates``
+independent copies of an inner :class:`~evox_tpu.workflows.StdWorkflow`
+for ``iterations`` generations — as **one** XLA program.  Where the seed
+prototype (``problems/hpo_wrapper.py``) looped a plain ``fori_loop`` of
+``step``, the evaluate here is one ``jax.vmap`` of the inner workflow's
+fused segment program (:meth:`StdWorkflow._segment_program
+<evox_tpu.workflows.StdWorkflow._segment_program>` — the PR-6 ``lax.scan``
+with quarantine and monitor counters inside the compiled body), so
+``outer_pop × inner_pop × segment_generations`` compiles and dispatches as
+a single program **and** every inner run's per-generation best-fitness
+series rides out as telemetry the meta-layers consume:
+
+* :class:`~evox_tpu.hpo.HPORunner` re-ingests it per candidate at every
+  checkpoint boundary (host-side inner histories, persisted in manifests);
+* the elastic-growth ladder (:mod:`evox_tpu.hpo.elastic`) reads it for
+  per-candidate stagnation trends behind journaled ``hpo-grow`` decisions;
+* the service layer publishes it as per-tenant ``evox_hpo_*`` metrics.
+
+**Nested PRNG contract** (``prng="uid"``, the default): each candidate's
+inner instance keys derive by ``fold_in(outer_key, candidate_uid)`` — the
+GL006/identity-keyed discipline the service applies to tenants.  The uid
+is a *stable identity* carried in the problem state (``state.uids``),
+never a lane/batch position, so a candidate's inner randomness is
+invariant under re-packing, eviction/readmission, and population regrowth
+of its neighbors.  Repeat lanes fold the repeat index into the candidate
+key (a stable identity of the repeat lane) and compose with the
+:data:`~evox_tpu.hpo.HPO_REPEAT_AXIS` per-generation aggregation exactly
+like the seed wrapper.  ``prng="split"`` keeps the seed wrapper's
+``jax.random.split`` schedule for back-compat
+(:class:`~evox_tpu.problems.hpo_wrapper.HPOProblemWrapper` uses it, so
+its published semantics — and ``tests/test_hpo_wrapper.py`` — are
+unchanged).
+
+Inner states are consumed per evaluation: every evaluate starts from the
+identical init instances (the reference's ``copy_init_state`` behavior),
+so the problem state the outer workflow threads is static search
+infrastructure plus the latest evaluation's telemetry.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Literal, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from ..core import Problem, State, Workflow, get_params, set_params
+from .monitor import HPO_REPEAT_AXIS, HPOMonitor, _REPEAT_WIRING, _reduce_axis
+
+__all__ = ["NestedProblem", "candidate_series", "find_nested"]
+
+
+def candidate_series(problem_state: Any) -> dict[int, Any]:
+    """Per-candidate inner best-fitness series from a nested problem
+    sub-state's telemetry (repeat lanes averaged), keyed by the stable
+    candidate uid — the host-side evidence feed for histories and the
+    growth ladder.  ONE definition shared by
+    :meth:`HPORunner._hpo_boundary <evox_tpu.hpo.HPORunner>` and the
+    service's per-tenant grow consult, so both compute identical
+    evidence.  Empty dict when the state carries no usable telemetry."""
+    import numpy as np
+
+    if (
+        problem_state is None
+        or "telemetry" not in problem_state
+        or "uids" not in problem_state
+    ):
+        return {}
+    tel = jax.device_get(problem_state["telemetry"])
+    if "best_fitness" not in tel:
+        return {}
+    series = np.asarray(tel["best_fitness"])
+    if series.ndim == 3:  # (candidates, repeats, inner generations)
+        series = series.mean(axis=1)
+    uids = np.asarray(jax.device_get(problem_state["uids"]))
+    return {int(u): series[i] for i, u in enumerate(uids)}
+
+
+def find_nested(problem: Any) -> "NestedProblem | None":
+    """The :class:`NestedProblem` inside a problem wrapper chain (fault
+    injection, transforms), or ``None``.  Mirrors
+    ``parallel.find_sharded`` so the meta-layers detect the HPO surface
+    through any composition."""
+    from ..parallel import iter_problem_chain
+
+    for p in iter_problem_chain(problem):
+        if getattr(p, "hpo_nested", False):
+            return p
+    return None
+
+
+class NestedProblem(Problem):
+    """An inner workflow batch as an outer ``Problem`` — the fused nested
+    evaluate (see the module docstring for the program shape and PRNG
+    contract).
+
+    Usage::
+
+        inner = StdWorkflow(PSO(64, lb, ub), Sphere(),
+                            monitor=HPOFitnessMonitor())
+        nested = NestedProblem(inner, iterations=32, num_candidates=16)
+        outer = StdWorkflow(OpenES(...), nested,
+                            solution_transform=lambda x: {"algorithm.w": x[:, 0]})
+
+    :param workflow: the inner workflow; its monitor must be an
+        :class:`~evox_tpu.hpo.HPOMonitor` (``tell_fitness`` defines the
+        score of a run).
+    :param iterations: total inner generations per evaluation, including
+        the init and final steps (reference semantics; >= 2).  The middle
+        ``iterations - 2`` generations are the fused ``lax.scan``.
+    :param num_candidates: parallel inner-workflow instances = outer
+        population size.
+    :param num_repeats: independent repeats per candidate (distinct PRNG
+        streams); hyper-parameters are shared across repeats.
+    :param fit_aggregation: reduction over the repeats axis, called as
+        ``fit_aggregation(stacked, axis=0)``; default ``jnp.mean``.
+    :param aggregation: ``"per_generation"`` (reference-faithful: the
+        monitor sees repeat-aggregated fitness every generation and
+        tracks best-of-mean) or ``"final"`` (each repeat lane tracks its
+        own best; the lanes' final scores are aggregated once).
+    :param prng: ``"uid"`` (default — identity-keyed
+        ``fold_in(outer_key, candidate_uid)`` instance streams, the
+        GL006 discipline) or ``"split"`` (the seed wrapper's
+        ``jax.random.split`` schedule, kept for back-compat).
+    :param telemetry: carry each evaluation's inner telemetry
+        (per-generation best-fitness series, executed counts) in the
+        problem state (``state.telemetry``) for the meta-layers to read
+        at boundaries.  Costs ``num_candidates × num_repeats ×
+        (iterations - 2)`` scalars of state; ``False`` drops it (the
+        back-compat shim's default).
+    :param base_uid: first candidate uid (uids are
+        ``base_uid .. base_uid + num_candidates - 1``); offset it when
+        several nested problems share one outer key space.
+    """
+
+    #: Marker the service layer's ``workload="hpo"`` validation and the
+    #: meta-layers' wrapper-chain walk (:func:`find_nested`) key on.
+    hpo_nested = True
+
+    def __init__(
+        self,
+        workflow: Workflow,
+        iterations: int,
+        num_candidates: int,
+        *,
+        num_repeats: int = 1,
+        fit_aggregation: Callable = jnp.mean,
+        aggregation: Literal["per_generation", "final"] = "per_generation",
+        prng: Literal["uid", "split"] = "uid",
+        telemetry: bool = True,
+        base_uid: int = 0,
+    ):
+        if iterations < 2:
+            raise ValueError(
+                f"iterations must be at least 2 (init + final), got "
+                f"{iterations}"
+            )
+        if num_candidates < 1:
+            raise ValueError(
+                f"num_candidates must be >= 1, got {num_candidates}"
+            )
+        if num_repeats < 1:
+            raise ValueError(f"num_repeats must be >= 1, got {num_repeats}")
+        if aggregation not in ("per_generation", "final"):
+            raise ValueError(
+                f"aggregation must be 'per_generation' or 'final', got "
+                f"{aggregation!r}"
+            )
+        if prng not in ("uid", "split"):
+            raise ValueError(f"prng must be 'uid' or 'split', got {prng!r}")
+        if base_uid < 0:
+            raise ValueError(f"base_uid must be >= 0, got {base_uid}")
+        monitor = getattr(workflow, "monitor", None)
+        if not isinstance(monitor, HPOMonitor):
+            raise ValueError(
+                f"Expect workflow monitor to be `HPOMonitor`, got "
+                f"{type(monitor)}"
+            )
+        if not hasattr(workflow, "_segment_program"):
+            raise ValueError(
+                f"NestedProblem needs an inner workflow exposing the fused "
+                f"segment builder (_segment_program); got "
+                f"{type(workflow).__name__}"
+            )
+        self.workflow = workflow
+        self.iterations = int(iterations)
+        self.num_candidates = int(num_candidates)
+        self.num_repeats = int(num_repeats)
+        self.fit_aggregation = fit_aggregation
+        self.aggregation = aggregation
+        self.prng = prng
+        self.telemetry = bool(telemetry)
+        self.base_uid = int(base_uid)
+        self._seg_cfg = None
+
+    # -- pickling (the serving daemon journals specs) -----------------------
+    def __getstate__(self) -> dict:
+        d = dict(self.__dict__)
+        d["_seg_cfg"] = None  # NamedTuple, but rebuilt cheaply anyway
+        wf = copy.copy(d["workflow"])
+        # The inner workflow's cached jit wrapper holds compiled-program
+        # handles that cannot (and must not) cross a process boundary.
+        if hasattr(wf, "_segment_jit"):
+            wf._segment_jit = None
+        d["workflow"] = wf
+        return d
+
+    # -- derived configuration ----------------------------------------------
+    @property
+    def inner_pop(self) -> int:
+        """The inner algorithm's population size (the elastic-growth
+        ladder's regrow axis)."""
+        return int(getattr(self.workflow.algorithm, "pop_size", 0))
+
+    def inner_generations_per_eval(self) -> int:
+        """Inner generations one outer evaluation executes across all
+        candidates and repeats (``evox_hpo_inner_generations_total``'s
+        increment per outer generation)."""
+        return self.num_candidates * self.num_repeats * self.iterations
+
+    # Trace-time memoization of a STATIC config (hashable NamedTuple, the
+    # same value every trace) — the segment-jit-cache idiom, not state.
+    def _cfg(self):  # graftlint: disable=GL005
+        if self._seg_cfg is None:
+            # One shape for every nesting level: capture off (sink history
+            # belongs to the inner monitor's in-state score, not host
+            # callbacks — an io_callback under the candidate vmap could not
+            # be ordered anyway), metrics off (the per-generation
+            # best_fitness channel IS the meta-telemetry), barrier-free
+            # (the shape that vmaps; no early stop, so it changes nothing).
+            self._seg_cfg = self.workflow.segment_config(
+                capture_history=False,
+                metrics=False,
+                stop_on_unhealthy=False,
+                barrier=False,
+            )
+        return self._seg_cfg
+
+    # -- state construction ---------------------------------------------------
+    def _candidate_uids(self) -> jax.Array:
+        return jnp.arange(self.num_candidates, dtype=jnp.uint32) + jnp.uint32(
+            self.base_uid
+        )
+
+    def setup(self, key: jax.Array) -> State:
+        n, r = self.num_candidates, self.num_repeats
+        uids = self._candidate_uids()
+        if self.prng == "uid":
+            # Identity-keyed instance streams (the GL006 discipline): the
+            # candidate uid — a stable identity, never a lane position —
+            # keys the candidate; the repeat index (a stable identity of
+            # the repeat lane) keys the repeat.
+            cand_keys = jax.vmap(
+                lambda uid: jax.random.fold_in(key, uid)
+            )(uids)
+            if r > 1:
+                reps = jnp.arange(r, dtype=jnp.uint32)
+                keys = jax.vmap(
+                    lambda ck: jax.vmap(
+                        lambda rep: jax.random.fold_in(ck, rep)
+                    )(reps)
+                )(cand_keys)
+                stacked = jax.vmap(jax.vmap(self.workflow.setup))(keys)
+            else:
+                stacked = jax.vmap(self.workflow.setup)(cand_keys)
+        else:
+            # Back-compat: the seed wrapper's split schedule, bit-for-bit.
+            flat_keys = jax.random.split(key, n * r)
+            stacked = jax.vmap(self.workflow.setup)(flat_keys)
+            if r > 1:
+                stacked = jax.tree.map(
+                    lambda x: x.reshape((n, r) + x.shape[1:]), stacked
+                )
+        state = State(instances=stacked, uids=uids)
+        if self.telemetry:
+            state = state.replace(telemetry=self._zero_telemetry(stacked))
+        return state
+
+    def get_init_params(self, state: State) -> dict[str, jax.Array]:
+        """The stacked hyper-parameter dict of the inner workflow: every
+        ``Parameter``-labeled leaf, keyed by dotted path, with leading
+        ``(num_candidates,)`` axis (repeats share hyper-parameters)."""
+        params = get_params(state.instances)
+        if self.num_repeats > 1:
+            params = {k: v[:, 0] for k, v in params.items()}
+        return params
+
+    def get_params_keys(self, state: State) -> list[str]:
+        """Dotted paths of every tunable (``Parameter``-labeled) leaf."""
+        return list(self.get_init_params(state).keys())
+
+    # -- the fused nested evaluate --------------------------------------------
+    def _run_one(self, ws: State, hp: Mapping[str, Any]):
+        """One inner run: init, the fused multi-generation segment, final —
+        returns ``(tell_fitness, telemetry State)``."""
+        wf = self.workflow
+        ws = set_params(ws, hp)
+        ws = wf.init_step(ws)
+        inner = self.iterations - 2
+        if inner > 0:
+            ws, raw = wf._segment_program(ws, inner, self._cfg())
+        else:
+            raw = None
+        ws = wf.final_step(ws)
+        return wf.monitor.tell_fitness(ws.monitor), self._pack_telemetry(raw)
+
+    @staticmethod
+    def _pack_telemetry(raw: Any) -> State:
+        if raw is None:  # iterations == 2: no fused middle segment
+            return State(executed=jnp.int32(0))
+        out: dict[str, Any] = {
+            "executed": raw["executed"],
+            "stopped": raw["stopped"],
+        }
+        if "best_fitness" in raw:
+            out["best_fitness"] = raw["best_fitness"]
+        return State(**out)
+
+    def _run_batch(self, instances: State, hp: Mapping[str, Any]):
+        """The whole outer evaluation: ONE ``jax.vmap`` (two, with
+        repeats) of the fused inner run over candidates.  Returns
+        ``(fitness (num_candidates,), telemetry)``."""
+        hp = dict(hp)
+        if self.num_repeats == 1:
+            return jax.vmap(self._run_one)(instances, hp)
+        if self.aggregation == "per_generation":
+            # Repeat lanes run under a *named* vmap axis; the monitor's
+            # ``aggregate_repeats`` all-gathers over it each generation,
+            # so every lane's best tracks the aggregated (mean) fitness
+            # and the lanes' final tells are identical — read lane 0.
+            fit, tel = jax.vmap(
+                lambda ws, h: jax.vmap(
+                    lambda w: self._run_one(w, h),
+                    axis_name=HPO_REPEAT_AXIS,
+                )(ws)
+            )(instances, hp)
+            return fit[:, 0], tel
+        # "final": aggregate each lane's independent end-of-run best.
+        fit, tel = jax.vmap(
+            lambda ws, h: jax.vmap(lambda w: self._run_one(w, h))(ws)
+        )(instances, hp)
+        return _reduce_axis(self.fit_aggregation, fit, 1), tel
+
+    def _wiring(self) -> tuple[int, Callable]:
+        per_gen = self.aggregation == "per_generation" and self.num_repeats > 1
+        return (
+            (self.num_repeats, self.fit_aggregation)
+            if per_gen
+            else (1, jnp.mean)
+        )
+
+    def _zero_telemetry(self, instances: State):
+        """Zeros shaped like one evaluation's telemetry — the problem
+        state carries the telemetry from construction so its pytree
+        structure never changes across steps (a checkpoint/template
+        invariant).  Abstract (``jax.eval_shape``): no device code runs."""
+        params = get_params(instances)
+        if self.num_repeats > 1:
+            params = {k: v[:, 0] for k, v in params.items()}
+        token = _REPEAT_WIRING.set(self._wiring())
+        try:
+            struct = jax.eval_shape(
+                lambda inst, hp: self._run_batch(inst, hp)[1],
+                instances,
+                params,
+            )
+        finally:
+            _REPEAT_WIRING.reset(token)
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), struct
+        )
+
+    def evaluate(
+        self, state: State, hyper_parameters: Mapping[str, Any]
+    ) -> tuple[jax.Array, State]:
+        # Wire the monitor's repeat aggregation for the duration of this
+        # trace only, via the context-local ``_REPEAT_WIRING`` (several
+        # wrappers may share one workflow object, and concurrent traces
+        # must not observe each other's config, so nothing is mutated on
+        # the shared monitor).
+        token = _REPEAT_WIRING.set(self._wiring())
+        try:
+            fit, tel = self._run_batch(state.instances, hyper_parameters)
+        finally:
+            _REPEAT_WIRING.reset(token)
+        # The inner states are consumed per evaluation (fresh instances
+        # each call evaluate identical init states, matching the
+        # reference's copy_init_state behavior); only the telemetry of
+        # the latest evaluation threads forward.
+        if self.telemetry and "telemetry" in state:
+            state = state.replace(telemetry=tel)
+        return fit, state
+
+    # -- elastic growth surface ----------------------------------------------
+    def with_inner_workflow(self, workflow: Workflow) -> "NestedProblem":
+        """A copy of this configuration over a different inner workflow
+        (the elastic-growth re-key: a changed inner population changes
+        the compiled program, the bucket key, and every state shape)."""
+        return type(self)(
+            workflow,
+            self.iterations,
+            self.num_candidates,
+            num_repeats=self.num_repeats,
+            fit_aggregation=self.fit_aggregation,
+            aggregation=self.aggregation,
+            prng=self.prng,
+            telemetry=self.telemetry,
+            base_uid=self.base_uid,
+        )
+
+    def with_inner_pop(
+        self, pop_size: int, inner_factory: Callable[[int], Any]
+    ) -> "NestedProblem":
+        """A copy with the inner algorithm regrown to ``pop_size`` via
+        ``inner_factory`` — same inner problem/monitor/transforms, larger
+        population (the IPOP regrow axis)."""
+        from ..workflows import StdWorkflow
+
+        wf = self.workflow
+        new_wf = StdWorkflow(
+            inner_factory(int(pop_size)),
+            wf.problem,
+            monitor=wf.monitor,
+            opt_direction="min" if wf.opt_direction == 1 else "max",
+            solution_transform=wf.solution_transform,
+            fitness_transform=wf.fitness_transform,
+            quarantine_nonfinite=wf.quarantine_nonfinite,
+            nonfinite_penalty=wf.nonfinite_penalty,
+        )
+        return self.with_inner_workflow(new_wf)
+
+    def regrow_state(self, old_state: State, salt: int) -> State:
+        """A fresh problem sub-state for THIS (regrown) configuration,
+        derived deterministically from the old state's PRNG identity plus
+        ``salt`` — a pure function of ``(old state, salt)``, so a resumed
+        run replaying a journaled growth lineage rebuilds bit-identical
+        instances.  Candidate uids (and with them the identity-keyed
+        stream discipline) are preserved by construction."""
+        base = None
+        for leaf in jax.tree_util.tree_leaves(old_state):
+            if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+                leaf.dtype, jax.dtypes.prng_key
+            ):
+                base = leaf.reshape(-1)[0]
+                break
+        if base is None:
+            base = jax.random.key(0)
+        return self.setup(jax.random.fold_in(base, jnp.uint32(salt)))

@@ -1,168 +1,48 @@
-"""Hyper-parameter optimization (HPO) wrapper: a workflow as a Problem.
+"""Hyper-parameter optimization (HPO) wrapper — back-compat shim.
 
-TPU-native counterpart of the reference HPO machinery
-(``src/evox/problems/hpo_wrapper.py:41-362``).  The reference needs
-``use_state`` functionalization, ``torch.func.stack_module_state``, two
-nested vmaps with hand-managed randomness modes, and a custom op
-(``_hpo_evaluate_loop``) keeping the iteration loop outside the compiled
-graph.  Here the same capability is ~40 lines of actual logic: workflow
-states are already pytrees, so *N instances* is one ``jax.vmap``, the inner
-iterations are one ``lax.fori_loop``, and per-instance randomness is free
-because every instance carries its own PRNG key (SURVEY §3.3).
+The meta-optimization machinery lives in :mod:`evox_tpu.hpo` (the fused
+nested runner, resumable nested state, elastic growth, and the service
+workload type); this module keeps the original seed-era surface — the
+reference-parity names and the ``jax.random.split``-based key schedule —
+as a thin delegation so existing code and
+``tests/test_hpo_wrapper.py`` are untouched:
 
-``num_repeats`` semantics match the reference exactly: with repeats, the
-*algorithm* in each repeat lane adapts on its own raw fitness, while the
-*monitor* aggregates fitness across repeats **inside every generation**
-(mean by default) before updating its best — "best of per-generation mean"
-(reference ``hpo_wrapper.py:19-38`` custom-op aggregation + ``:83-96``).
-The reference needs a vmap-aware ``torch.library`` custom op for that
-cross-lane mean; in JAX it is a named-axis collective: the repeat vmap
-carries ``axis_name=HPO_REPEAT_AXIS`` and the monitor reduces over it with
-``lax.all_gather``.  The simpler end-of-run estimator (aggregate each lane's
-final best) remains available as ``aggregation="final"``.
+* :class:`HPOMonitor` / :class:`HPOFitnessMonitor` /
+  :data:`HPO_REPEAT_AXIS` re-export from :mod:`evox_tpu.hpo` verbatim;
+* :class:`HPOProblemWrapper` subclasses
+  :class:`~evox_tpu.hpo.NestedProblem` with the seed wrapper's defaults
+  (``prng="split"``: the original per-instance key schedule, so
+  published trajectories reproduce bit-for-bit; ``telemetry=False``: the
+  original lean problem state).  ``num_repeats`` aggregation semantics
+  are unchanged — they are :class:`~evox_tpu.hpo.NestedProblem`'s.
+
+The one *implementation* difference from the seed prototype: the inner
+iteration loop is no longer a plain ``fori_loop`` of ``step`` but the
+fused segment program (``StdWorkflow._segment_program``) — the same
+generations as one ``lax.scan``, which PR 6 pinned bit-identical to the
+``fori_loop`` shape.  New code should construct
+:class:`~evox_tpu.hpo.NestedProblem` directly (identity-keyed PRNG,
+telemetry, growth, service packing).
 """
 
 from __future__ import annotations
 
-import contextvars
-from typing import Any, Callable, Literal, Mapping
+from typing import Callable, Literal
 
-import jax
 import jax.numpy as jnp
 
-from ..core import Monitor, Problem, State, Workflow, get_params, set_params
+from ..core import Workflow
+from ..hpo.monitor import (  # noqa: F401 - re-exported reference surface
+    HPO_REPEAT_AXIS,
+    HPOFitnessMonitor,
+    HPOMonitor,
+)
+from ..hpo.nested import NestedProblem
 
 __all__ = ["HPOMonitor", "HPOFitnessMonitor", "HPOProblemWrapper", "HPO_REPEAT_AXIS"]
 
-#: vmap axis name carried by the repeats axis inside
-#: :meth:`HPOProblemWrapper.evaluate`; HPO monitors reduce over it.
-HPO_REPEAT_AXIS = "hpo_repeat"
 
-#: Trace-scoped repeat wiring ``(num_repeats, fit_aggregation)`` installed by
-#: :meth:`HPOProblemWrapper.evaluate` for the duration of its trace.  A
-#: ``ContextVar`` (not attribute mutation on the shared monitor object) so
-#: that (a) concurrent traces in different threads/contexts cannot observe
-#: each other's wiring, and (b) nested wrappers (HPO-of-HPO) save/restore
-#: correctly via token reset.
-_REPEAT_WIRING: contextvars.ContextVar[tuple[int, Callable] | None] = (
-    contextvars.ContextVar("hpo_repeat_wiring", default=None)
-)
-
-
-def _reduce_axis(fn: Callable, arr: jax.Array, axis: int) -> jax.Array:
-    """Apply a repeats reduction.  Preferred contract is ``fn(arr, axis=...)``
-    (like ``jnp.mean``); 1-D reducers ``fn(vec) -> scalar`` are accepted for
-    back-compat and applied along ``axis``."""
-    try:
-        return fn(arr, axis=axis)
-    except TypeError:
-        return jnp.apply_along_axis(fn, axis, arr)
-
-
-class HPOMonitor(Monitor):
-    """Base monitor for HPO inner workflows: must expose the inner run's
-    final score via ``tell_fitness`` (reference ``hpo_wrapper.py:41-58``).
-
-    Subclasses aggregate each generation's fitness across repeats by
-    calling :meth:`aggregate_repeats` in ``pre_tell`` — never by reading
-    ``self.num_repeats`` directly: when the monitor runs inside an
-    :class:`HPOProblemWrapper` evaluation, the wrapper's trace-scoped
-    wiring (repeat count + reduction) takes precedence over the
-    constructor values, and only ``aggregate_repeats`` sees it.
-
-    :param num_repeats: repeat count used when the monitor runs standalone
-        (outside a wrapper's trace).
-    :param fit_aggregation: reduction over the repeats axis, called as
-        ``fit_aggregation(stacked, axis=0)`` (default ``jnp.mean`` — the
-        reference's mean-of-repeats, ``hpo_wrapper.py:19-38``).
-    """
-
-    def __init__(
-        self,
-        num_repeats: int = 1,
-        fit_aggregation: Callable = jnp.mean,
-    ):
-        self.num_repeats = num_repeats
-        self.fit_aggregation = fit_aggregation
-
-    def aggregate_repeats(self, fitness: jax.Array) -> jax.Array:
-        """Cross-repeat aggregation of this generation's fitness.  Inside the
-        wrapper's repeat vmap this is a collective over the named axis: every
-        lane receives the same aggregated tensor (the JAX-native equivalent
-        of the reference's vmap-registered mean custom op).
-
-        Repeat wiring installed by a surrounding
-        :meth:`HPOProblemWrapper.evaluate` trace (via the context-local
-        ``_REPEAT_WIRING``) takes precedence over the constructor
-        attributes, so one monitor instance can serve several wrappers."""
-        wiring = _REPEAT_WIRING.get()
-        num_repeats, fit_aggregation = (
-            wiring if wiring is not None
-            else (self.num_repeats, self.fit_aggregation)
-        )
-        if num_repeats <= 1:
-            return fitness
-        try:
-            stacked = jax.lax.all_gather(fitness, HPO_REPEAT_AXIS, axis=0)
-        except NameError:
-            # The repeat axis is only bound inside HPOProblemWrapper's
-            # per-generation vmap; running the same (already-wired) monitor
-            # standalone or under "final" aggregation traces with no such
-            # axis — degrade to the raw per-lane fitness.
-            return fitness
-        return _reduce_axis(fit_aggregation, stacked, 0)
-
-    def tell_fitness(self, state: State) -> jax.Array:
-        """The scalar (or per-objective) fitness this inner run reports to
-        the outer algorithm.  Abstract: subclasses define what "fitness of
-        a run" means (e.g. best-so-far)."""
-        raise NotImplementedError(
-            "`tell_fitness` function is not implemented. It must be overwritten."
-        )
-
-
-class HPOFitnessMonitor(HPOMonitor):
-    """Tracks the best fitness value seen by the inner workflow
-    (reference ``hpo_wrapper.py:61-103``)."""
-
-    def __init__(
-        self,
-        multi_obj_metric: Callable | None = None,
-        num_repeats: int = 1,
-        fit_aggregation: Callable = jnp.mean,
-    ):
-        """
-        :param multi_obj_metric: scalarizing metric for multi-objective inner
-            problems, e.g. ``lambda f: igd(f, problem.pf())``; unused for
-            single-objective.
-        """
-        assert multi_obj_metric is None or callable(multi_obj_metric), (
-            f"Expect `multi_obj_metric` to be `None` or callable, got {multi_obj_metric}"
-        )
-        super().__init__(num_repeats, fit_aggregation)
-        self.multi_obj_metric = multi_obj_metric
-
-    def setup(self, key: jax.Array) -> State:
-        del key
-        return State(best_fitness=jnp.asarray(jnp.inf))
-
-    def pre_tell(self, state: State, fitness: jax.Array) -> State:
-        fitness = self.aggregate_repeats(fitness)
-        if fitness.ndim == 1:
-            value = jnp.min(fitness)
-        else:
-            value = self.multi_obj_metric(fitness)
-        return state.replace(
-            best_fitness=jnp.minimum(value, state.best_fitness)
-        )
-
-    def tell_fitness(self, state: State) -> jax.Array:
-        """Best fitness seen over the inner run (the wrapped workflow's
-        objective value for these hyper-parameters)."""
-        return state.best_fitness
-
-
-class HPOProblemWrapper(Problem):
+class HPOProblemWrapper(NestedProblem):
     """Turns an entire workflow into a Problem: the outer population is a
     batch of hyper-parameter sets; fitness is each instance's inner-run
     score (reference ``hpo_wrapper.py:161-362``).
@@ -179,6 +59,11 @@ class HPOProblemWrapper(Problem):
 
     Works as the problem of an outer ``StdWorkflow`` with a
     ``solution_transform`` mapping solution vectors to the params dict.
+
+    This is the back-compat spelling of
+    :class:`~evox_tpu.hpo.NestedProblem` (see the module docstring for
+    exactly what is pinned); ``num_instances`` is the original name of
+    ``num_candidates``.
     """
 
     def __init__(
@@ -207,92 +92,30 @@ class HPOProblemWrapper(Problem):
             own best; the lanes' final scores are aggregated once at the end
             — the estimator for "report mean of K independent runs").
         """
-        assert iterations >= 2, f"`iterations` should be at least 2, got {iterations}"
-        assert num_instances > 0
-        assert aggregation in ("per_generation", "final")
-        monitor = getattr(workflow, "monitor", None)
-        assert isinstance(monitor, HPOMonitor), (
-            f"Expect workflow monitor to be `HPOMonitor`, got {type(monitor)}"
+        super().__init__(
+            workflow,
+            iterations,
+            num_instances,
+            num_repeats=num_repeats,
+            fit_aggregation=fit_aggregation,
+            aggregation=aggregation,
+            prng="split",
+            telemetry=False,
         )
-        self.iterations = iterations
-        self.num_instances = num_instances
-        self.num_repeats = num_repeats
-        self.workflow = workflow
-        self.fit_aggregation = fit_aggregation
-        self.aggregation = aggregation
 
-    def setup(self, key: jax.Array) -> State:
-        n = self.num_instances * self.num_repeats
-        keys = jax.random.split(key, n)
-        stacked = jax.vmap(self.workflow.setup)(keys)
-        if self.num_repeats > 1:
-            stacked = jax.tree.map(
-                lambda x: x.reshape(
-                    (self.num_instances, self.num_repeats) + x.shape[1:]
-                ),
-                stacked,
-            )
-        return State(instances=stacked)
+    @property
+    def num_instances(self) -> int:
+        """The original name of ``num_candidates``."""
+        return self.num_candidates
 
-    def get_init_params(self, state: State) -> dict[str, jax.Array]:
-        """The stacked hyper-parameter dict of the inner workflow: every
-        ``Parameter``-labeled leaf, keyed by dotted path, with leading
-        ``(num_instances,)`` axis (repeats share hyper-parameters)."""
-        params = get_params(state.instances)
-        if self.num_repeats > 1:
-            params = {k: v[:, 0] for k, v in params.items()}
-        return params
-
-    def get_params_keys(self, state: State) -> list[str]:
-        """Dotted paths of every tunable (``Parameter``-labeled) leaf."""
-        return list(self.get_init_params(state).keys())
-
-    def evaluate(
-        self, state: State, hyper_parameters: Mapping[str, Any]
-    ) -> tuple[jax.Array, State]:
-        wf = self.workflow
-
-        def run_one(wf_state: State, hp: Mapping[str, Any]) -> jax.Array:
-            wf_state = set_params(wf_state, hp)
-            wf_state = wf.init_step(wf_state)
-            wf_state = jax.lax.fori_loop(
-                0, self.iterations - 2, lambda _, s: wf.step(s), wf_state
-            )
-            wf_state = wf.final_step(wf_state)
-            return wf.monitor.tell_fitness(wf_state.monitor)
-
-        # Wire the monitor's repeat aggregation for the duration of this
-        # trace only, via the context-local ``_REPEAT_WIRING`` (the reference
-        # wires it permanently at construction, ``hpo_wrapper.py:204`` — but
-        # several wrappers may share one workflow object, and concurrent
-        # traces must not observe each other's config, so nothing is mutated
-        # on the shared monitor).
-        per_gen = self.aggregation == "per_generation" and self.num_repeats > 1
-        token = _REPEAT_WIRING.set(
-            (self.num_repeats, self.fit_aggregation) if per_gen else (1, jnp.mean)
+    def with_inner_workflow(self, workflow: Workflow) -> "HPOProblemWrapper":
+        # The shim's constructor signature differs from NestedProblem's;
+        # regrowing through the shim keeps the shim type.
+        return type(self)(
+            self.iterations,
+            self.num_candidates,
+            workflow,
+            num_repeats=self.num_repeats,
+            fit_aggregation=self.fit_aggregation,
+            aggregation=self.aggregation,
         )
-        try:
-            if self.num_repeats == 1:
-                fit = jax.vmap(run_one)(state.instances, dict(hyper_parameters))
-            elif per_gen:
-                # Repeat lanes run under a *named* vmap axis; the monitor's
-                # ``aggregate_repeats`` all-gathers over it each generation,
-                # so every lane's best tracks the aggregated (mean) fitness
-                # and the lanes' final tells are identical — read lane 0.
-                fit = jax.vmap(
-                    lambda ws, hp: jax.vmap(
-                        lambda w: run_one(w, hp), axis_name=HPO_REPEAT_AXIS
-                    )(ws)
-                )(state.instances, dict(hyper_parameters))
-                fit = fit[:, 0]
-            else:  # "final": aggregate each lane's independent end-of-run best
-                fit = jax.vmap(
-                    lambda ws, hp: jax.vmap(lambda w: run_one(w, hp))(ws)
-                )(state.instances, dict(hyper_parameters))
-                fit = _reduce_axis(self.fit_aggregation, fit, 1)
-        finally:
-            _REPEAT_WIRING.reset(token)
-        # The inner states are consumed per evaluation (fresh instances each
-        # call evaluate identical init states, matching the reference's
-        # copy_init_state behavior).
-        return fit, state

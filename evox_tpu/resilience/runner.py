@@ -1853,6 +1853,24 @@ class ResilientRunner:
                 reasons=list(report.reasons),
             )
             return state, done
+        return self._fire_restart(state, done, n_steps, report, trend_decision)
+
+    def _fire_restart(
+        self,
+        state: State,
+        done: int,
+        n_steps: int,
+        report: HealthReport,
+        trend_decision: Any = None,
+    ) -> tuple[State, int]:
+        """Apply the restart policy to an unhealthy boundary verdict:
+        policy apply, lineage event, post-restart checkpoint + stale-future
+        invalidation, fleet lockstep.  Extracted from
+        :meth:`_health_boundary` so subclasses (the HPO runner's
+        elastic-growth ladder) can fire the identical machinery with their
+        own verdicts; callers guarantee a configured ``restart=`` policy
+        and an unspent ``max_restarts`` budget."""
+        reasons = "; ".join(report.reasons)
         # Restart policies read checkpoints from disk (rollback scans the
         # directory for candidates): flush the boundary's in-flight async
         # write first, so the policy sees the same directory a synchronous

@@ -52,7 +52,7 @@ import base64
 import pickle
 import time
 import warnings
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as dataclass_replace
 from pathlib import Path
 from typing import Any, Callable, Sequence, Union
 
@@ -659,13 +659,10 @@ class ServiceDaemon:
                             warn=True,
                         )
                         continue
-                    spec = TenantSpec(
-                        spec.tenant_id,
-                        spec.algorithm,
-                        spec.problem,
-                        n_steps=spec.n_steps,
-                        uid=uid,
-                    )
+                    # Pin the journaled uid; every other field (workload,
+                    # growth ladder, solution transform, budget) replays
+                    # exactly as submitted.
+                    spec = dataclass_replace(spec, uid=uid)
                     try:
                         record = self.service.submit(spec)
                     except AdmissionError as e:

@@ -66,7 +66,10 @@ def assign_fault_lane(state: State, uid: int) -> State:
             str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
         ]
         if names and names[-1] == "fault_lane":
-            return jnp.asarray(uid, leaf.dtype)
+            # full_like, not a scalar: nested (HPO) states carry the leaf
+            # per inner instance — every instance of the tenant shares the
+            # tenant's uid, and the leading candidate axis must survive.
+            return jnp.full_like(leaf, uid)
         return leaf
 
     return jax.tree_util.tree_map_with_path(stamp, state)

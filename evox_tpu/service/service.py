@@ -45,6 +45,7 @@ That is the bulkhead contract ``tests/test_service.py`` pins bit-exactly.
 
 from __future__ import annotations
 
+import dataclasses
 import warnings
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -412,6 +413,17 @@ class OptimizationService:
             if existing.lane is not None:
                 self._buckets[existing.bucket].pack.release(existing.lane)
                 existing.lane = None
+            if existing.grows and existing.spec.workload == "hpo":
+                # Applied growths outlive parking: the record's problem is
+                # the GROWN nested problem, while a resubmitted spec
+                # necessarily carries the original ungrown one (the grown
+                # instance is service-internal).  Keeping the grown
+                # problem is what lets readmission resume the grown-shape
+                # checkpoints instead of silently skipping them back past
+                # the growth at template validation.
+                spec = dataclasses.replace(
+                    spec, problem=existing.spec.problem
+                )
             existing.spec = spec
             existing.status = TenantStatus.QUEUED
             record = existing
@@ -728,7 +740,10 @@ class OptimizationService:
         if bucket is None:
             monitor = self.monitor_factory()
             workflow = StdWorkflow(
-                spec.algorithm, spec.problem, monitor=monitor
+                spec.algorithm,
+                spec.problem,
+                monitor=monitor,
+                solution_transform=spec.solution_transform,
             )
             pack = TenantPack(
                 workflow,
@@ -914,7 +929,10 @@ class OptimizationService:
             self._handle_preemption()
         self._admit_pending()
         stepped_any = False
-        for bucket in self._buckets.values():
+        # Snapshot: boundary work can CREATE buckets mid-iteration (the
+        # hpo-grow re-key admits the grown tenant into a new bucket); the
+        # new bucket steps from the next round.
+        for bucket in list(self._buckets.values()):
             if not bucket.pack.active_lanes():
                 continue
             telemetry = bucket.pack.run_segment(self.segment_steps)
@@ -995,6 +1013,23 @@ class OptimizationService:
                     meta_pairs, sinks, np.asarray(telemetry["executed"]),
                     lane=lane,
                 )
+            if record.spec.workload == "hpo" and executed[lane]:
+                from ..hpo.nested import find_nested
+
+                nested = find_nested(record.spec.problem)
+                if nested is not None:
+                    # One outer generation of an HPO tenant executes a
+                    # whole inner ladder: candidates x repeats x
+                    # iterations inner generations.
+                    self._inc(
+                        "evox_hpo_inner_generations_total",
+                        "Inner generations executed by packed HPO "
+                        "tenants (candidates x repeats x iterations per "
+                        "outer generation).",
+                        n=int(executed[lane])
+                        * nested.inner_generations_per_eval(),
+                        tenant_id=record.spec.tenant_id,
+                    )
             if (
                 record.flight is not None
                 and "flight" in telemetry
@@ -1036,6 +1071,20 @@ class OptimizationService:
             if record.generations >= record.spec.n_steps:
                 self._complete(bucket, record)
                 continue
+            if (
+                report.healthy
+                and record.spec.workload == "hpo"
+                and record.spec.grow is not None
+                and self.controller is not None
+            ):
+                # Elastic inner-population ladder (evox_tpu.hpo): a
+                # stagnating inner run fires a journaled hpo-grow
+                # decision and the tenant re-keys to the grown bucket at
+                # this boundary.  A fired growth IS this boundary's
+                # verdict for the tenant; otherwise it falls through to
+                # the ordinary trend/checkpoint handling below.
+                if self._maybe_grow_hpo(bucket, record):
+                    continue
             if (
                 report.healthy
                 and self.controller is not None
@@ -1210,6 +1259,133 @@ class OptimizationService:
         bucket.pack.set_frozen(record.lane, True)
         record.status = TenantStatus.QUARANTINED
         self.stats.quarantines += 1
+        self._quarantine_tail(bucket, record, reasons)
+
+    # -- elastic HPO growth (evox_tpu.hpo) -----------------------------------
+    def _maybe_grow_hpo(self, bucket: _Bucket, record: TenantRecord) -> bool:
+        """Consult the controller's ``hpo-grow`` plane for one healthy HPO
+        tenant; apply the bucket re-key + lane surgery when a growth
+        fires.  Returns whether the tenant was regrown (the caller then
+        skips ordinary boundary handling).  Never raises — any failure
+        leaves the tenant running on threshold verdicts with a warning."""
+        from ..hpo.elastic import grow_evidence
+        from ..hpo.nested import candidate_series, find_nested
+
+        nested = find_nested(record.spec.problem)
+        if nested is None:
+            return False
+        # Growths share the restart budget: a ladder at its budget
+        # quarantines like any other degenerating tenant instead of
+        # growing without bound.
+        if record.restarts + record.grows >= self.max_restarts:
+            return False
+        try:
+            state = bucket.pack.lane_state(record.lane)
+            series = candidate_series(
+                state["problem"] if "problem" in state else None
+            )
+            if not series:
+                return False
+            evidence = grow_evidence(
+                record.spec.grow, series, nested.inner_pop
+            )
+            if evidence is None:
+                return False
+            decision = self.controller.hpo_grow(
+                evidence=evidence,
+                generation=record.generations,
+                tenant_id=record.spec.tenant_id,
+            )
+        except Exception as e:  # noqa: BLE001 - never crash the boundary
+            self._note(
+                record,
+                f"hpo-grow consult failed ({type(e).__name__}: {e}); "
+                f"tenant continues ungrown",
+                warn=True,
+            )
+            return False
+        if decision is None or decision.action in ("", "hold"):
+            return False
+        return self._grow_hpo(bucket, record, decision, state)
+
+    def _grow_hpo(
+        self,
+        bucket: _Bucket,
+        record: TenantRecord,
+        decision: Any,
+        state: State,
+    ) -> bool:
+        """Apply one journaled ``hpo-grow`` decision: regrow the tenant's
+        nested problem to the decision's target inner population, re-key
+        its bucket (a changed inner pop is a different compiled program),
+        and move the tenant's state — outer search state preserved, inner
+        instances deterministically rebuilt at the grown size — into the
+        new bucket's pack (lane surgery, the PR-8 machinery)."""
+        from ..hpo.nested import find_nested
+
+        nested = find_nested(record.spec.problem)
+        if record.spec.problem is not nested:
+            # Re-keying would have to rebuild the wrapper chain around the
+            # grown problem; refuse rather than guess at wrapper state.
+            self._note(
+                record,
+                "hpo-grow decision not applied: the spec's problem wraps "
+                "the NestedProblem (growth needs the nested problem as "
+                "the spec problem itself)",
+                warn=True,
+            )
+            return False
+        new_pop = int(decision.action)
+        old_pop = nested.inner_pop
+        grown = nested.with_inner_pop(new_pop, record.spec.grow.inner_factory)
+        record.grows += 1
+        prob_state = grown.regrow_state(
+            state["problem"], record.spec.grow.salt + record.grows
+        )
+        new_state = state.replace(problem=prob_state)
+        # Lane surgery: out of the old bucket's pack...
+        bucket.pack.release(record.lane)
+        record.lane = None
+        self._templates.pop((record.bucket, record.uid), None)
+        record.spec = dataclasses.replace(record.spec, problem=grown)
+        # ... into the grown bucket's (the re-key: a new static signature
+        # is a new compilation bucket, created on first use).
+        new_bucket = self._bucket_for(record.spec)
+        record.bucket = new_bucket.key
+        self.health.reset_lane(record.uid)
+        self._inc(
+            "evox_hpo_grows_total",
+            "Elastic inner-population growths applied to HPO tenants.",
+            tenant_id=record.spec.tenant_id,
+        )
+        if new_bucket.pack.free_lanes():
+            record.lane = new_bucket.pack.admit(new_state, record.uid)
+            # The grown state is the tenant's first resume point at the
+            # new shape (older, smaller-shape archives in the namespace
+            # are skipped by template validation on any later resume).
+            self._checkpoint_tenant(record, new_state)
+            self._note(
+                record,
+                f"hpo-grow #{record.grows}: inner population {old_pop} -> "
+                f"{new_pop} (decision #{decision.seq}; bucket re-keyed, "
+                f"lane {record.lane})",
+                warn=True,
+            )
+        else:
+            self._checkpoint_tenant(record, new_state)
+            record.status = TenantStatus.EVICTED
+            self._note(
+                record,
+                f"hpo-grow #{record.grows}: inner population {old_pop} -> "
+                f"{new_pop}, but the grown bucket has no free lane — "
+                f"parked on the grown checkpoint (resubmit to resume)",
+                warn=True,
+            )
+        return True
+
+    def _quarantine_tail(
+        self, bucket: _Bucket, record: TenantRecord, reasons: str
+    ) -> None:
         self._inc(
             "evox_tenant_quarantines_total",
             "Lane freezes after a spent restart budget, per tenant.",

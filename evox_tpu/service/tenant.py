@@ -86,6 +86,25 @@ class TenantSpec:
         docstring); auto-assigned by submission order when ``None``.
         Supply it when a bit-exact cross-service comparison (the bulkhead
         tests) needs the same tenant identity in two service instances.
+    :param workload: ``"standard"`` (an ordinary optimization run) or
+        ``"hpo"`` (a meta-optimization run: ``problem`` must be — or
+        wrap — an :class:`~evox_tpu.hpo.NestedProblem`, whose fused
+        nested evaluate packs like any other program).  HPO tenants get
+        per-tenant ``evox_hpo_*`` metrics and, with ``grow=``, the
+        elastic inner-population ladder.
+    :param grow: optional :class:`~evox_tpu.hpo.GrowthLadder` for
+        ``workload="hpo"`` tenants — when the service carries a
+        :class:`~evox_tpu.control.Controller`, inner-run stagnation
+        trends fire journaled ``hpo-grow`` decisions that regrow this
+        tenant's inner population (bucket re-key + lane surgery at a
+        segment boundary).
+    :param solution_transform: optional solution transform for the
+        tenant's workflow (``StdWorkflow(solution_transform=)``) — HPO
+        tenants use it to map outer solution vectors onto the inner
+        hyper-parameter dict.  Part of the compiled program, so it
+        participates in the bucket key (by function code + closure
+        digest); must be a module-level function (not a lambda) for
+        daemon journal durability.
     """
 
     tenant_id: str
@@ -93,6 +112,9 @@ class TenantSpec:
     problem: Any
     n_steps: int
     uid: int | None = None
+    workload: str = "standard"
+    grow: Any = None
+    solution_transform: Any = None
 
     def __post_init__(self) -> None:
         if not re.fullmatch(r"[A-Za-z0-9._-]+", self.tenant_id or ""):
@@ -105,6 +127,33 @@ class TenantSpec:
             raise ValueError(f"n_steps must be >= 1, got {self.n_steps}")
         if self.uid is not None and self.uid < 0:
             raise ValueError(f"uid must be >= 0, got {self.uid}")
+        if self.workload not in ("standard", "hpo"):
+            raise ValueError(
+                f"workload must be 'standard' or 'hpo', got "
+                f"{self.workload!r}"
+            )
+        if self.workload == "hpo":
+            # Duck-typed (not an isinstance) so wrapper chains — fault
+            # injection around the nested problem — stay admissible; the
+            # marker is NestedProblem's class attribute.
+            from ..hpo.nested import find_nested
+
+            nested = find_nested(self.problem)
+            if nested is None:
+                raise ValueError(
+                    "workload='hpo' needs a problem whose chain contains "
+                    "an evox_tpu.hpo.NestedProblem (the fused nested "
+                    "evaluate is what the HPO workload packs)"
+                )
+            if self.grow is not None:
+                from ..hpo.elastic import validate_ladder_window
+
+                validate_ladder_window(self.grow, nested)
+        elif self.grow is not None:
+            raise ValueError(
+                "grow= (the elastic inner-population ladder) only applies "
+                "to workload='hpo' tenants"
+            )
 
 
 @dataclass
@@ -119,6 +168,10 @@ class TenantRecord:
     lane: int | None = None
     generations: int = 0
     restarts: int = 0
+    # Elastic inner-population growths applied to an HPO tenant (the
+    # deterministic-regrow salt index; bounded by the service's
+    # max_restarts budget alongside restarts).
+    grows: int = 0
     segments_since_checkpoint: int = 0
     # Human-readable lifecycle trail: admissions, verdicts, restarts,
     # evictions — the per-tenant analogue of RunStats.failures.
@@ -131,9 +184,39 @@ class TenantRecord:
     flight: Any | None = None
 
 
+def _hash_code(h: "hashlib._Hash", code: Any) -> None:
+    """Digest of a code object's behavior: bytecode alone is NOT enough —
+    constants and attribute/global names are referenced by index, so two
+    functions differing only in a string constant (e.g. which Parameter
+    path a solution transform writes) share identical ``co_code``.  Hash
+    names and constants too, recursing into nested code objects
+    (lambdas/compehensions defined inside the function)."""
+    h.update(code.co_code)
+    h.update(repr(code.co_names).encode())
+    for const in code.co_consts:
+        if hasattr(const, "co_code"):
+            _hash_code(h, const)
+        else:
+            h.update(repr(const).encode())
+
+
 def _hash_value(h: "hashlib._Hash", value: Any) -> None:
     if isinstance(value, (bool, int, float, str, bytes, type(None))):
         h.update(repr(value).encode())
+    elif callable(value) and hasattr(value, "__code__"):
+        # Plain functions (solution transforms, growth factories): hash
+        # by identity-of-behavior — qualified name + code digest (byte
+        # code AND names/constants) + closure contents — so two tenants
+        # with different transforms can never silently share a bucket,
+        # while re-imports of the same function (daemon journal replay in
+        # a fresh process) hash identically.
+        h.update(getattr(value, "__qualname__", "<fn>").encode())
+        _hash_code(h, value.__code__)
+        for cell in value.__closure__ or ():
+            try:
+                _hash_value(h, cell.cell_contents)
+            except ValueError:  # empty cell
+                h.update(b"<empty-cell>")
     elif isinstance(value, (tuple, list, frozenset, set)):
         h.update(b"(")
         for item in sorted(value, key=repr) if isinstance(
@@ -199,10 +282,16 @@ def static_signature(obj: Any) -> str:
 
 def bucket_key(spec: TenantSpec) -> tuple:
     """The compilation-shape bucket a tenant belongs to: algorithm class +
-    ``(pop, dim)`` + the static-configuration digests of algorithm and
-    problem.  Tenants sharing a key are safe to step through ONE traced
-    program with per-tenant state."""
+    ``(pop, dim)`` + the static-configuration digests of algorithm,
+    problem, and solution transform.  Tenants sharing a key are safe to
+    step through ONE traced program with per-tenant state."""
     algo = spec.algorithm
+    if spec.solution_transform is None:
+        transform = "no-transform"
+    else:
+        h = hashlib.sha256()
+        _hash_value(h, spec.solution_transform)
+        transform = h.hexdigest()
     return (
         type(algo).__name__,
         int(getattr(algo, "pop_size", 0)),
@@ -210,4 +299,5 @@ def bucket_key(spec: TenantSpec) -> tuple:
         type(spec.problem).__name__,
         static_signature(algo),
         static_signature(spec.problem),
+        transform,
     )

@@ -121,6 +121,24 @@
 #                               Ackley config (artifact under
 #                               bench_artifacts/).  Runs under a HARD
 #                               wall-clock timeout like --multihost.
+#   ./run_tests.sh --hpo        meta-optimization (HPO) lane: the nested-
+#                               workload suite (fused nested evaluate,
+#                               identity-keyed inner PRNG streams, the
+#                               SIGTERM resume bit-identity matrix for
+#                               PSO-over-OpenES and CMA-ES-over-PSO,
+#                               journaled hpo-grow elastic growth with
+#                               bit-for-bit decision replay, HPO tenants
+#                               packed beside NaN-bursting cotenants and
+#                               through a daemon kill-restart) + the
+#                               back-compat wrapper suite, then a full
+#                               graftlint sweep (nested GL001/GL006
+#                               scope stays clean), then
+#                               tools/bench_hpo_overhead.py asserting
+#                               the fused nested evaluate keeps >=90% of
+#                               a hand-rolled vmap-of-fori_loop ladder
+#                               (artifact under bench_artifacts/).  Runs
+#                               under a HARD wall-clock timeout like
+#                               --multihost.
 #   ./run_tests.sh --multihost  multi-host fleet lane: the fast multihost
 #                               suite (FleetTopology/bootstrap/heartbeat/
 #                               verdict plumbing, single-writer checkpoint
@@ -228,6 +246,22 @@ if [ "$1" = "--control" ]; then
   # graftlint sweep (GL002/GL003 et al.) must stay clean vs baselines.
   python -m tools.graftlint || exit 1
   exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_control_overhead.py
+fi
+if [ "$1" = "--hpo" ]; then
+  shift
+  # Hard timeout (SIGKILL escalation), same pattern as --multihost: the
+  # resume matrix delivers a real SIGTERM and the daemon test models a
+  # SIGKILL restart; a wedged meta-run must fail the lane loudly.
+  HPO_TIMEOUT="${EVOX_TPU_HPO_TIMEOUT:-1500}"
+  timeout -k 30 "$HPO_TIMEOUT" \
+    "${CPU_ENV[@]}" python -m pytest \
+    tests/test_hpo_workload.py tests/test_hpo_wrapper.py -q "$@" || exit 1
+  # Nested-workflow PRNG discipline: the full graftlint sweep (GL001
+  # vmapped-closure scope, GL006 lane-index taint) must stay clean.
+  python -m tools.graftlint || exit 1
+  # Fused nested evaluate must keep >=90% of a hand-rolled
+  # vmap-of-fori_loop inner loop on the fixed ladder config.
+  exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_hpo_overhead.py
 fi
 if [ "$1" = "--multihost" ]; then
   shift

@@ -1,0 +1,50 @@
+"""Nested-workflow (HPO) PRNG-discipline violations.
+
+Two families, both meta-optimization-shaped:
+
+* **GL001 nested scope** — an outer key closed over by a vmapped inner
+  function: every inner instance draws IDENTICAL randomness (the
+  N-copies-of-one-trajectory bug a nested HPO evaluate makes easy).
+* **GL006 nested scope** — an inner ``fold_in`` fed from the vmap LANE
+  index (an inline ``jnp.arange`` mapped over the batch) instead of a
+  stable candidate uid: the stream follows placement, so re-packing a
+  candidate into a different lane forks its randomness.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def setup_instances_shared_key(workflow, key, n):
+    # The mapped lambda closes over `key`: all n instances get one stream.
+    return jax.vmap(
+        lambda i: workflow.setup(
+            jax.random.normal(key, (4,))  # GL001 closure key in vmap
+        )
+    )(jnp.arange(n))
+
+
+def setup_instances_shared_key_def(workflow, key, n):
+    def build(i):
+        noise = jax.random.uniform(key, (4,))  # GL001 closure key in vmap
+        return workflow.setup(noise + i)
+
+    return jax.vmap(build)(jnp.arange(n))
+
+
+def candidate_keys_by_lane(key, n):
+    # The lane index (batch position) keys the stream: re-packing a
+    # candidate into another lane silently forks its trajectory.
+    return jax.vmap(
+        lambda lane: jax.random.fold_in(key, lane)  # GL006 lane-index fold
+    )(jnp.arange(n, dtype=jnp.uint32))
+
+
+def candidate_keys_by_lane_def(key, n):
+    def derive(lane, base):
+        salted = lane * 2 + 1
+        return jax.random.fold_in(base, salted)  # GL006 lane-index fold
+
+    return jax.vmap(derive, in_axes=(0, None))(
+        jnp.arange(n, dtype=jnp.uint32), key
+    )

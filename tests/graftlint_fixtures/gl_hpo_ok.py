@@ -1,0 +1,51 @@
+"""Disciplined nested-workflow (HPO) PRNG idioms — must stay clean.
+
+The sanctioned patterns :mod:`evox_tpu.hpo` is built on: per-instance
+splits mapped as parameters, identity-keyed ``fold_in`` over stable
+candidate uids (state/config data, or uid-named parameters), and
+key-transparent derivations inside vmapped functions.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def setup_instances_split(workflow, key, n):
+    # Per-instance keys are MAPPED parameters, not closures: each instance
+    # owns a distinct stream.
+    keys = jax.random.split(key, n)
+    return jax.vmap(workflow.setup)(keys)
+
+
+def setup_instances_per_param(workflow, key, n):
+    keys = jax.random.split(key, n)
+
+    def build(instance_key):
+        noise = jax.random.normal(instance_key, (4,))
+        return workflow.setup(noise)
+
+    return jax.vmap(build)(keys)
+
+
+def candidate_keys_by_uid(key, uids):
+    # Identity-keyed: the uids array is stable state/config data (it
+    # reaches the vmap as a name, not an inline batch-position iota), so
+    # a candidate's stream survives re-packing.
+    return jax.vmap(lambda uid: jax.random.fold_in(key, uid))(uids)
+
+
+def candidate_keys_by_uid_param(key, n, base_uid):
+    # Even an inline arange is sanctioned when the parameter NAME declares
+    # the identity contract (uids = base + arange, the hpo setup idiom).
+    def derive(candidate_uid):
+        return jax.random.fold_in(key, candidate_uid)
+
+    uids = jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(base_uid)
+    return jax.vmap(derive)(uids)
+
+
+def repeat_keys(candidate_key, r):
+    # fold_in is key-transparent derivation, not consumption — a closure
+    # candidate key folded per repeat lane is the repeat-stream idiom.
+    reps = jnp.arange(r, dtype=jnp.uint32)
+    return jax.vmap(lambda rep: jax.random.fold_in(candidate_key, rep))(reps)

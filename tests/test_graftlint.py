@@ -154,6 +154,46 @@ def test_gl002_boundary_callback_outside_body_is_clean(tmp_path):
     assert not _findings(src, ["GL002"])
 
 
+def test_gl_hpo_bad_fixture_counts_are_exact():
+    """Nested-workflow scope: an outer key consumed inside a vmapped inner
+    function (GL001) and an inner fold_in fed from a vmap lane index
+    instead of a candidate uid (GL006) must flag — one finding per inline
+    marker, no over-firing."""
+    path = FIXTURES / "gl_hpo_bad.py"
+    text = path.read_text().splitlines()
+    for code in ("GL001", "GL006"):
+        expected = sum(f"# {code}" in line for line in text)
+        found = [f for f in _findings(path, [code]) if f.rule == code]
+        assert len(found) == expected, (
+            f"{path.name}: expected {expected} {code} findings, got "
+            f"{len(found)}:\n" + "\n".join(f.format() for f in found)
+        )
+    assert any(
+        "vmap" in f.message for f in _findings(path, ["GL001"])
+    ), "the vmapped-closure finding is the point of the nested extension"
+
+
+def test_gl_hpo_ok_fixture_is_clean_across_all_rules():
+    """The sanctioned nested-PRNG idioms — per-instance split parameters,
+    identity-keyed fold_in over stable uids, key-transparent repeat
+    derivation — must stay clean under every rule."""
+    path = FIXTURES / "gl_hpo_ok.py"
+    found = _findings(path)
+    assert not found, "\n".join(f.format() for f in found)
+
+
+def test_gl_hpo_nested_scope_sweep_is_clean():
+    """The hpo subsystem itself must hold the discipline its linter
+    extension enforces (the baseline entry for the nested scope stays
+    empty: no debt)."""
+    hpo_dir = REPO / "evox_tpu" / "hpo"
+    found = scan_paths(
+        sorted(hpo_dir.glob("*.py")),
+        [RULES_BY_CODE["GL001"], RULES_BY_CODE["GL006"]],
+    )
+    assert not found, "\n".join(f.format() for f in found)
+
+
 def test_fused_segment_builder_is_clean_under_scanbody_scope():
     """``StdWorkflow._segment_program``'s scan body (and its cond-branch
     closure) is now compiled scope — the real builder must hold itself to

@@ -1,0 +1,709 @@
+"""The HPO workload: fused nesting, resumable nested state, elastic
+growth, and service packing (``evox_tpu/hpo/``).
+
+Four layers:
+
+* **nested contracts** (fast) — identity-keyed PRNG isolation (a
+  candidate's inner streams are invariant under ladder width), telemetry
+  shape/content, workload validation, and the pure ``hpo-grow`` decider;
+* **resume bit-identity matrix** (slow) — a REAL SIGTERM mid-meta-run,
+  then a fresh-process-equivalent resume, must equal the uninterrupted
+  run bit-for-bit: final outer state, per-candidate inner histories, and
+  checkpoint leaf digests — for PSO-over-OpenES and CMA-ES-over-PSO;
+* **elastic growth** (slow) — a stagnating inner ladder fires a
+  journaled ``hpo-grow`` decision mid-run, the inner population regrows
+  at the boundary, journal replay reproduces the decision sequence
+  bit-for-bit, and a kill after the growth resumes bit-identically;
+* **service packing** (slow) — an HPO tenant beside a NaN-bursting HPO
+  cotenant finishes bit-identical to the same tenant solo; an HPO tenant
+  packed into a ServiceDaemon beside ordinary tenants survives a
+  kill-restart with bit-identical resume; a service-packed ladder
+  regrows through the bucket re-key + lane surgery path.
+"""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.algorithms import CMAES, PSO, OpenES
+from evox_tpu.control import Controller, decide_hpo_grow
+from evox_tpu.core import Problem, State
+from evox_tpu.hpo import (
+    GrowthLadder,
+    HPOFitnessMonitor,
+    HPORunner,
+    NestedProblem,
+    find_nested,
+    grow_evidence,
+)
+from evox_tpu.problems.numerical import Ackley, Sphere
+from evox_tpu.resilience import FaultyProblem, HealthProbe, Preempted
+from evox_tpu.service import (
+    OptimizationService,
+    RequestJournal,
+    ServiceDaemon,
+    TenantSpec,
+)
+from evox_tpu.workflows import EvalMonitor, StdWorkflow
+
+DIM = 4
+
+
+# -- shared builders (module-level: daemon journal pickling needs them) ------
+
+
+def make_inner_es(pop):
+    return OpenES(pop, jnp.zeros(DIM), learning_rate=0.05, noise_stdev=0.1)
+
+
+def make_inner_pso(pop):
+    return PSO(pop, -5.0 * jnp.ones(DIM), 5.0 * jnp.ones(DIM))
+
+
+def es_transform(x):
+    return {
+        "algorithm.lr": jnp.clip(x[:, 0], 1e-3, 1.0),
+        "algorithm.noise_stdev": jnp.clip(x[:, 1], 1e-3, 1.0),
+    }
+
+
+def pso_transform(x):
+    return {
+        "algorithm.w": jnp.clip(x[:, 0], 0.1, 1.0),
+        "algorithm.phi_p": jnp.clip(x[:, 1], 0.5, 3.0),
+    }
+
+
+class Plateau(Problem):
+    """Constant fitness: every inner run stagnates by construction."""
+
+    def evaluate(self, state, pop):
+        return jnp.ones(pop.shape[0]), state
+
+
+def build_pso_over_es(inner_pop=8, iterations=5, candidates=4, problem=None):
+    inner = StdWorkflow(
+        make_inner_es(inner_pop),
+        problem if problem is not None else Sphere(),
+        monitor=HPOFitnessMonitor(),
+    )
+    nested = NestedProblem(inner, iterations=iterations, num_candidates=candidates)
+    return StdWorkflow(
+        PSO(candidates, lb=0.01 * jnp.ones(2), ub=1.0 * jnp.ones(2)),
+        nested,
+        monitor=EvalMonitor(),
+        solution_transform=es_transform,
+    )
+
+
+def build_cmaes_over_pso(inner_pop=8, iterations=5, candidates=4):
+    inner = StdWorkflow(
+        make_inner_pso(inner_pop), Sphere(), monitor=HPOFitnessMonitor()
+    )
+    nested = NestedProblem(inner, iterations=iterations, num_candidates=candidates)
+    return StdWorkflow(
+        CMAES(jnp.asarray([0.6, 2.0]), 0.3, pop_size=candidates),
+        nested,
+        monitor=EvalMonitor(),
+        solution_transform=pso_transform,
+    )
+
+
+BUILDERS = {
+    "pso_over_openes": build_pso_over_es,
+    "cmaes_over_pso": build_cmaes_over_pso,
+}
+
+
+# -- comparison helpers -------------------------------------------------------
+
+
+def _leaves(state, skip=("num_preemptions",)):
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        name = jax.tree_util.keystr(path)
+        if any(s in name for s in skip):
+            continue
+        if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key
+        ):
+            leaf = jax.random.key_data(leaf)
+        out[name] = np.asarray(leaf)
+    return out
+
+
+def assert_states_equal(a, b, skip=("num_preemptions",)):
+    la, lb = _leaves(a, skip), _leaves(b, skip)
+    assert la.keys() == lb.keys()
+    for name in la:
+        assert np.array_equal(la[name], lb[name]), f"leaf {name} differs"
+
+
+def final_digests(ckpt_dir, skip=("num_preemptions",)):
+    from evox_tpu.resilience import latest_checkpoint
+    from evox_tpu.utils.checkpoint import read_manifest
+
+    manifest = read_manifest(latest_checkpoint(ckpt_dir))
+    return {
+        k: v
+        for k, v in manifest["leaf_digests"].items()
+        if not any(s in k for s in skip)
+    }
+
+
+# -- fast: nested contracts ---------------------------------------------------
+
+
+def test_workload_validation():
+    lb, ub = -jnp.ones(2), jnp.ones(2)
+    with pytest.raises(ValueError, match="NestedProblem"):
+        TenantSpec("t", PSO(4, lb, ub), Sphere(), n_steps=4, workload="hpo")
+    with pytest.raises(ValueError, match="workload"):
+        TenantSpec("t", PSO(4, lb, ub), Sphere(), n_steps=4, workload="nas")
+    ladder = GrowthLadder(inner_factory=make_inner_es)
+    with pytest.raises(ValueError, match="hpo"):
+        TenantSpec(
+            "t", PSO(4, lb, ub), Sphere(), n_steps=4, grow=ladder
+        )
+    with pytest.raises(ValueError, match="iterations"):
+        NestedProblem(
+            StdWorkflow(make_inner_es(4), Sphere(), monitor=HPOFitnessMonitor()),
+            iterations=1,
+            num_candidates=2,
+        )
+    with pytest.raises(ValueError, match="HPOMonitor"):
+        NestedProblem(
+            StdWorkflow(make_inner_es(4), Sphere()),
+            iterations=4,
+            num_candidates=2,
+        )
+    # A ladder window the telemetry can never span must fail loudly at
+    # construction (series holds iterations-2 points; firing needs
+    # iterations >= stagnation_window + 3), for spec and runner alike.
+    nested = NestedProblem(
+        StdWorkflow(make_inner_es(4), Sphere(), monitor=HPOFitnessMonitor()),
+        iterations=6,
+        num_candidates=2,
+    )
+    wide = GrowthLadder(inner_factory=make_inner_es, stagnation_window=8)
+    with pytest.raises(ValueError, match="never fire"):
+        TenantSpec(
+            "t", PSO(2, lb, ub), nested, n_steps=4, workload="hpo",
+            grow=wide, solution_transform=es_transform,
+        )
+    outer = StdWorkflow(
+        PSO(2, lb=0.01 * jnp.ones(2), ub=1.0 * jnp.ones(2)), nested,
+        solution_transform=es_transform,
+    )
+    with pytest.raises(ValueError, match="never fire"):
+        HPORunner(outer, "/tmp/unused", grow=wide)
+
+
+def test_nested_prng_is_identity_keyed(key):
+    """The GL006 contract, nested: a candidate's inner instance is a pure
+    function of (outer key, candidate uid) — invariant under the ladder
+    width, so re-packing/regrowing neighbors can never shift a
+    candidate's randomness.  The split-mode shim, by contrast, reshuffles
+    every instance when the width changes (the back-compat behavior)."""
+    inner = StdWorkflow(make_inner_es(4), Sphere(), monitor=HPOFitnessMonitor())
+    wide = NestedProblem(inner, iterations=4, num_candidates=4).setup(key)
+    narrow = NestedProblem(inner, iterations=4, num_candidates=2).setup(key)
+    w, n = _leaves(wide.instances), _leaves(narrow.instances)
+    for name in w:
+        assert np.array_equal(w[name][:2], n[name]), name
+    # base_uid offsets the identity: candidate 0 of a base_uid=2 problem
+    # IS candidate 2 of the base ladder.
+    offset = NestedProblem(
+        inner, iterations=4, num_candidates=2, base_uid=2
+    ).setup(key)
+    o = _leaves(offset.instances)
+    for name in w:
+        assert np.array_equal(w[name][2:4], o[name]), name
+
+
+def test_nested_telemetry_series(key):
+    """The fused evaluate batches each candidate's per-generation inner
+    best-fitness series out as state telemetry (the feed for histories,
+    trends, and growth)."""
+    candidates, iterations, repeats = 3, 6, 2
+    inner = StdWorkflow(make_inner_es(4), Sphere(), monitor=HPOFitnessMonitor())
+    nested = NestedProblem(
+        inner,
+        iterations=iterations,
+        num_candidates=candidates,
+        num_repeats=repeats,
+    )
+    state = nested.setup(key)
+    assert "telemetry" in state and "uids" in state
+    tel = state.telemetry
+    assert tel.best_fitness.shape == (candidates, repeats, iterations - 2)
+    assert np.all(np.asarray(tel.best_fitness) == 0.0)  # zeros until evaluated
+    fit, state = jax.jit(nested.evaluate)(state, nested.get_init_params(state))
+    assert fit.shape == (candidates,)
+    series = np.asarray(state.telemetry.best_fitness)
+    assert series.shape == (candidates, repeats, iterations - 2)
+    assert np.all(np.isfinite(series))
+    assert np.asarray(state.telemetry.executed).shape == (candidates, repeats)
+    assert np.all(np.asarray(state.telemetry.executed) == iterations - 2)
+
+
+def test_decide_hpo_grow_is_pure():
+    base = {
+        "stagnation_tol": 0.0,
+        "stagnation_window": 4.0,
+        "best_slope": 0.0,
+        "span": 4.0,
+        "inner_pop": 8,
+        "growth_factor": 2.0,
+        "max_inner_pop": 32,
+    }
+    assert decide_hpo_grow(base) == "16"
+    assert decide_hpo_grow({**base, "span": 3.0}) == "hold"  # window unmet
+    assert decide_hpo_grow({**base, "best_slope": -1.0}) == "hold"  # improving
+    assert decide_hpo_grow({**base, "best_slope": None}) == "hold"  # no signal
+    assert decide_hpo_grow({**base, "inner_pop": 32}) == "hold"  # capped
+    assert decide_hpo_grow({**base, "max_inner_pop": None}) == "16"
+    # grow_evidence picks the MOST stagnant candidate.
+    ladder = GrowthLadder(
+        inner_factory=make_inner_es, stagnation_window=3, max_inner_pop=32
+    )
+    evidence = grow_evidence(
+        ladder,
+        {0: np.asarray([5.0, 4.0, 3.0, 2.0]), 7: np.asarray([1.0, 1.0, 1.0, 1.0])},
+        inner_pop=8,
+    )
+    assert evidence["candidate_uid"] == 7
+    assert decide_hpo_grow(evidence) == "16"
+
+
+def test_shim_is_nested_problem():
+    """The back-compat wrapper IS the subsystem (one implementation), with
+    the seed key schedule and lean state pinned."""
+    from evox_tpu.problems.hpo_wrapper import HPOProblemWrapper
+
+    inner = StdWorkflow(make_inner_es(4), Sphere(), monitor=HPOFitnessMonitor())
+    shim = HPOProblemWrapper(iterations=4, num_instances=3, workflow=inner)
+    assert isinstance(shim, NestedProblem)
+    assert shim.prng == "split" and shim.telemetry is False
+    assert shim.num_instances == shim.num_candidates == 3
+    state = shim.setup(jax.random.key(0))
+    assert "telemetry" not in state
+
+
+def test_transform_digest_splits_buckets():
+    """Two tenants whose solution transforms differ ONLY in behavior
+    (same qualname, constants differ — identical bytecode) must never
+    share a compilation bucket; identical transforms must."""
+    from evox_tpu.service.tenant import bucket_key
+
+    def t_a(x):
+        return {"algorithm.lr": x[:, 0]}
+
+    def t_b(x):
+        return {"algorithm.noise_stdev": x[:, 0]}
+
+    def t_c(x):
+        return {"algorithm.lr": x[:, 0]}
+
+    t_b.__qualname__ = t_a.__qualname__  # only co_consts/co_names differ
+    t_c.__qualname__ = t_a.__qualname__
+    algo = PSO(4, lb=0.01 * jnp.ones(2), ub=1.0 * jnp.ones(2))
+    inner = StdWorkflow(make_inner_es(4), Sphere(), monitor=HPOFitnessMonitor())
+    nested = NestedProblem(inner, iterations=4, num_candidates=4)
+
+    def spec(tid, fn):
+        return TenantSpec(
+            tid, algo, nested, n_steps=4, workload="hpo",
+            solution_transform=fn,
+        )
+
+    assert bucket_key(spec("a", t_a)) != bucket_key(spec("b", t_b))
+    assert bucket_key(spec("a", t_a)) == bucket_key(spec("c", t_c))
+
+
+def test_readmission_preserves_applied_growth(tmp_path):
+    """A growth-parked (EVICTED) HPO tenant resubmitted with its original
+    spec must keep the GROWN nested problem (the grown instance is
+    service-internal) — otherwise readmission would bucket by the
+    ungrown template and silently skip the grown-shape checkpoints."""
+    from evox_tpu.service.tenant import TenantStatus
+
+    svc = _service(tmp_path / "svc")
+    spec = hpo_faulty_spec("meta", 9)
+    record = svc.submit(spec)
+    nested = find_nested(spec.problem)
+    grown = nested.with_inner_pop(16, make_inner_es)
+    # Model a growth that parked the tenant (grown bucket full).
+    import dataclasses
+
+    record.spec = dataclasses.replace(record.spec, problem=grown)
+    record.grows = 1
+    record.status = TenantStatus.EVICTED
+    svc._queue.clear()
+    svc.submit(spec)  # caller resubmits the ORIGINAL (ungrown) spec
+    assert find_nested(record.spec.problem) is grown
+    assert record.spec.n_steps == spec.n_steps  # budget still refreshed
+
+
+# -- slow: resume bit-identity matrix ----------------------------------------
+
+
+def _run_meta(build, root, n_steps, *, kill_after_checkpoints=None, seed=0):
+    """One supervised meta-run; optionally deliver a REAL SIGTERM to this
+    process after the Nth checkpoint publish (mid-meta-run: the guard
+    converts it to an emergency checkpoint + Preempted at the next
+    boundary)."""
+    wf = build()
+    published = {"n": 0}
+
+    def on_event(msg):
+        if (
+            kill_after_checkpoints is not None
+            and msg.startswith("checkpoint written")
+            and published["n"] >= 0
+        ):
+            published["n"] += 1
+            if published["n"] == kill_after_checkpoints:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    runner = HPORunner(
+        wf,
+        root,
+        checkpoint_every=2,
+        preemption=True,
+        on_event=on_event,
+    )
+    state = wf.init(jax.random.key(seed))
+    try:
+        final = runner.run(state, n_steps)
+        return runner, final, False
+    except Preempted:
+        return runner, None, True
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("config", sorted(BUILDERS))
+def test_sigterm_resume_bit_identity(config, tmp_path):
+    """SIGTERM mid-meta-run -> fresh-process-equivalent resume == the
+    uninterrupted run: final outer state (inner instances and telemetry
+    included), per-candidate inner histories, and checkpoint leaf
+    digests (``num_preemptions`` excluded — it counts the interruptions
+    themselves)."""
+    build = BUILDERS[config]
+    n_steps = 8
+    ref_root, cut_root = tmp_path / "ref", tmp_path / "cut"
+    ref_runner, ref_final, preempted = _run_meta(build, ref_root, n_steps)
+    assert not preempted
+
+    _, _, preempted = _run_meta(
+        build, cut_root, n_steps, kill_after_checkpoints=2
+    )
+    assert preempted, "the SIGTERM must interrupt the meta-run"
+    # Fresh-process equivalent: new workflow objects, new runner, same dir.
+    resumed_runner, resumed_final, preempted = _run_meta(
+        build, cut_root, n_steps
+    )
+    assert not preempted
+    assert resumed_runner.stats.resumed_from_generation is not None
+
+    assert_states_equal(ref_final, resumed_final)
+    # Per-candidate inner histories: manifest-re-ingested prefix + live
+    # tail must equal the uninterrupted run's, entry for entry.
+    assert resumed_runner.candidate_history == ref_runner.candidate_history
+    assert final_digests(ref_root) == final_digests(cut_root)
+
+
+# -- slow: elastic growth -----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_hpo_grow_fires_journals_and_replays(tmp_path):
+    """A stagnating inner ladder fires a journaled hpo-grow decision
+    mid-run: the inner population regrows at the boundary (outer state
+    untouched), the growth is restart lineage, journal replay reproduces
+    the decision sequence bit-for-bit, and a fresh supervisor resumes the
+    grown run bit-identically."""
+    def build():
+        return build_pso_over_es(iterations=8, problem=Plateau())
+
+    ladder = GrowthLadder(
+        inner_factory=make_inner_es,
+        stagnation_window=4,
+        stagnation_tol=0.0,
+        max_inner_pop=32,
+    )
+    journal = RequestJournal(tmp_path / "journal.jsonl")
+
+    wf = build()
+    runner = HPORunner(
+        wf,
+        tmp_path / "ck",
+        checkpoint_every=2,
+        grow=ladder,
+        controller=Controller(journal=journal, grace=2),
+        max_restarts=3,
+    )
+    state = wf.init(jax.random.key(0))
+    final = runner.run(state, 8)
+
+    grows = [e for e in runner.stats.restarts if e.policy == "hpo-grow"]
+    assert grows, "the plateau ladder must fire at least one growth"
+    assert all(e.detail["grown"] for e in grows)
+    assert find_nested(runner.workflow.problem).inner_pop > 8
+    assert final.problem.instances.algorithm.fit.shape[-1] > 8  # regrown
+
+    decisions = runner.controller.decisions
+    fired = [d for d in decisions if d.kind == "hpo-grow"]
+    assert fired and all(d.action.isdigit() for d in fired)
+    assert fired[0].evidence["candidate_uid"] in (0, 1, 2, 3)
+
+    # Replay: recomputing every journaled decision's action from its
+    # journaled evidence reproduces the sequence bit-for-bit.
+    records, damage = journal.replay()
+    assert damage is None
+    replayed = Controller.replay_decisions(records)
+    assert [d.to_manifest() for d in replayed] == [
+        d.to_manifest() for d in decisions
+    ]
+
+    # Kill-equivalent resume across the growth: a fresh supervisor (fresh
+    # workflow, same dir) replays the lineage, rebuilds the grown
+    # template, and lands on the identical final state.
+    wf2 = build()
+    runner2 = HPORunner(
+        wf2,
+        tmp_path / "ck",
+        checkpoint_every=2,
+        grow=ladder,
+        controller=Controller(grace=2),
+        max_restarts=3,
+    )
+    final2 = runner2.run(wf2.init(jax.random.key(0)), 8)
+    assert_states_equal(final, final2)
+    assert runner2.candidate_history == runner.candidate_history
+
+
+# -- slow: service packing ----------------------------------------------------
+
+VICTIM_UID, BURSTER_UID = 5, 6
+
+# Tenant-keyed chaos on the INNER problem: only the burster's inner runs
+# take NaN bursts (the service stamps each tenant's uid into every
+# fault_lane leaf of its state — nested instances included).
+INNER_LANE_FAULTS = {
+    BURSTER_UID: {"nan_generations": tuple(range(1, 40)), "nan_rows": 8}
+}
+
+
+def hpo_faulty_spec(tenant_id, uid, n_steps=6):
+    inner = StdWorkflow(
+        make_inner_es(8),
+        FaultyProblem(Sphere(), lane_faults=INNER_LANE_FAULTS),
+        monitor=HPOFitnessMonitor(),
+    )
+    nested = NestedProblem(inner, iterations=5, num_candidates=4)
+    return TenantSpec(
+        tenant_id,
+        PSO(4, lb=0.01 * jnp.ones(2), ub=1.0 * jnp.ones(2)),
+        nested,
+        n_steps=n_steps,
+        uid=uid,
+        workload="hpo",
+        solution_transform=es_transform,
+    )
+
+
+def _service(root):
+    return OptimizationService(
+        root,
+        lanes_per_pack=4,
+        segment_steps=2,
+        health=HealthProbe(nonfinite_skip=("instances",)),
+        max_restarts=1,
+    )
+
+
+@pytest.mark.slow
+def test_hpo_tenant_isolated_from_nan_bursting_cotenant(tmp_path):
+    """The bulkhead, nested: an HPO tenant packed beside an HPO cotenant
+    whose INNER runs burst NaN every generation finishes bit-identical —
+    final state, monitor history, checkpoint digests — to the same
+    tenant solo."""
+    packed = _service(tmp_path / "packed")
+    packed.submit(hpo_faulty_spec("victim", VICTIM_UID))
+    packed.submit(hpo_faulty_spec("burster", BURSTER_UID))
+    packed.run(max_rounds=30)
+    assert packed.tenant("victim").status.value == "completed"
+
+    solo = _service(tmp_path / "solo")
+    solo.submit(hpo_faulty_spec("victim", VICTIM_UID))
+    solo.run(max_rounds=30)
+    assert solo.tenant("victim").status.value == "completed"
+
+    assert_states_equal(packed.result("victim"), solo.result("victim"))
+    hp = [np.asarray(x) for x in packed.tenant("victim").monitor.fitness_history]
+    hs = [np.asarray(x) for x in solo.tenant("victim").monitor.fitness_history]
+    assert len(hp) == len(hs) and all(
+        np.array_equal(a, b) for a, b in zip(hp, hs)
+    )
+    assert final_digests(
+        tmp_path / "packed" / "tenants" / "victim"
+    ) == final_digests(tmp_path / "solo" / "tenants" / "victim")
+    # The burster's inner quarantine actually engaged (the chaos was real).
+    burster_tel = np.asarray(
+        packed.result("burster").problem.telemetry.best_fitness
+    )
+    assert np.all(np.isfinite(burster_tel))  # penalties, not NaN, leaked out
+
+
+def _daemon(root):
+    return ServiceDaemon(
+        root,
+        lanes_per_pack=4,
+        segment_steps=2,
+        seed=0,
+        health=HealthProbe(nonfinite_skip=("instances",)),
+        exec_cache=False,
+        preemption=False,
+    )
+
+
+def _daemon_submit_all(d):
+    d.submit(
+        TenantSpec(
+            "meta-1",
+            PSO(4, lb=0.01 * jnp.ones(2), ub=1.0 * jnp.ones(2)),
+            NestedProblem(
+                StdWorkflow(
+                    make_inner_es(8), Sphere(), monitor=HPOFitnessMonitor()
+                ),
+                iterations=5,
+                num_candidates=4,
+            ),
+            n_steps=6,
+            uid=11,
+            workload="hpo",
+            solution_transform=es_transform,
+        )
+    )
+    lb, ub = -10 * jnp.ones(8), 10 * jnp.ones(8)
+    d.submit(TenantSpec("plain-1", PSO(16, lb, ub), Ackley(), n_steps=6, uid=12))
+
+
+def _drain(d, kill_after_rounds=None):
+    rounds = 0
+    while True:
+        if kill_after_rounds is not None and rounds >= kill_after_rounds:
+            return False  # SIGKILL model: abandon mid-run, no close
+        if not d.step() and not d.service._queue:
+            return True
+        rounds += 1
+
+
+@pytest.mark.slow
+def test_daemon_kill_restart_hpo_tenant_bit_identical(tmp_path):
+    """ISSUE acceptance: an HPO tenant packed into a ServiceDaemon beside
+    an ordinary tenant survives a kill-restart (journal replay, spec
+    round-trip through pickle, namespace resume) with bit-identical
+    outer+inner state, checkpoint digests, and the post-restart monitor
+    history tail."""
+    ref = _daemon(tmp_path / "ref")
+    ref.start()
+    _daemon_submit_all(ref)
+    assert _drain(ref)
+    assert ref.tenant("meta-1").status.value == "completed"
+
+    cut = _daemon(tmp_path / "cut")
+    cut.start()
+    _daemon_submit_all(cut)
+    assert not _drain(cut, kill_after_rounds=2)  # killed mid-run
+
+    # Fresh process equivalent: a new daemon over the same root replays
+    # the journal (the HPO spec — nested problem, transform, workload —
+    # round-trips through the journal's pickled record).
+    restarted = _daemon(tmp_path / "cut")
+    assert restarted.start() == 2
+    spec = restarted.tenant("meta-1").spec
+    assert spec.workload == "hpo" and find_nested(spec.problem) is not None
+    assert _drain(restarted)
+    assert restarted.tenant("meta-1").status.value == "completed"
+
+    assert_states_equal(ref.result("meta-1"), restarted.result("meta-1"))
+    assert_states_equal(ref.result("plain-1"), restarted.result("plain-1"))
+    assert final_digests(
+        tmp_path / "ref" / "tenants" / "meta-1"
+    ) == final_digests(tmp_path / "cut" / "tenants" / "meta-1")
+    # Monitor history: the restarted process re-records from its resume
+    # point; its tail must match the uninterrupted run's entry-for-entry.
+    hr = [np.asarray(x) for x in ref.tenant("meta-1").monitor.fitness_history]
+    hc = [
+        np.asarray(x)
+        for x in restarted.tenant("meta-1").monitor.fitness_history
+    ]
+    assert hc and all(np.array_equal(a, b) for a, b in zip(hr[-len(hc):], hc))
+
+
+@pytest.mark.slow
+def test_service_hpo_grow_rekeys_bucket(tmp_path):
+    """The packed growth path: a stagnating packed ladder fires the
+    journaled hpo-grow decision, and the tenant regrows through bucket
+    re-key + lane surgery — new compilation bucket, larger inner
+    population, uid/monitor/outer state preserved, run completes."""
+    journal = RequestJournal(tmp_path / "journal.jsonl")
+    controller = Controller(journal=journal, grace=2)
+    svc = OptimizationService(
+        tmp_path / "svc",
+        lanes_per_pack=4,
+        segment_steps=2,
+        health=HealthProbe(nonfinite_skip=("instances",)),
+        controller=controller,
+        max_restarts=2,
+    )
+    inner = StdWorkflow(
+        make_inner_es(8), Plateau(), monitor=HPOFitnessMonitor()
+    )
+    nested = NestedProblem(inner, iterations=6, num_candidates=4)
+    ladder = GrowthLadder(
+        inner_factory=make_inner_es,
+        stagnation_window=3,
+        stagnation_tol=0.0,
+        max_inner_pop=16,
+    )
+    svc.submit(
+        TenantSpec(
+            "meta-grow",
+            PSO(4, lb=0.01 * jnp.ones(2), ub=1.0 * jnp.ones(2)),
+            nested,
+            n_steps=6,
+            uid=3,
+            workload="hpo",
+            grow=ladder,
+            solution_transform=es_transform,
+        )
+    )
+    old_bucket = None
+    svc.run(max_rounds=30)
+    record = svc.tenant("meta-grow")
+    assert record.status.value == "completed"
+    assert record.grows >= 1
+    assert find_nested(record.spec.problem).inner_pop == 16
+    assert record.uid == 3
+    fired = [d for d in controller.decisions if d.kind == "hpo-grow"]
+    assert fired and fired[0].tenant_id == "meta-grow"
+    # Two buckets exist: the original and the re-keyed (grown) one.
+    pops = sorted(
+        find_nested(b.workflow.problem).inner_pop
+        for b in svc._buckets.values()
+    )
+    assert pops == [8, 16]
+    # The journaled decisions replay bit-for-bit.
+    records, damage = journal.replay()
+    assert damage is None
+    replayed = Controller.replay_decisions(records)
+    assert [d.to_manifest() for d in replayed] == [
+        d.to_manifest() for d in controller.decisions
+    ]

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""CPU microbenchmark: cost of the fused nested evaluate's meta-plumbing.
+
+The HPO subsystem's evaluate (``evox_tpu.hpo.NestedProblem``) is one
+``jax.vmap`` of the inner workflow's fused segment program — plus the
+meta-machinery riding along: per-candidate telemetry channels (the
+best-fitness series batched out as scan outputs), uid-keyed state, and
+the init/final framing.  The null hypothesis this gate protects: all of
+that costs (almost) nothing against a HAND-ROLLED nested loop — a bare
+``vmap`` of ``init_step + fori_loop(step) + final_step + tell_fitness``
+with no telemetry, the seed-prototype shape.
+
+Gate: fused nested evaluate >= 90% of the hand-rolled loop's
+evaluations/sec on a fixed ladder config.  FAILS (exit 1) under the
+floor.
+
+Methodology mirrors the other overhead gates: both sides jitted and
+warmed (compiles amortized out), interleaved repeats, best-of-N.
+
+Run via::
+
+    ./run_tests.sh --hpo            # suite + graftlint sweep + this gate
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_hpo_overhead.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.algorithms import OpenES  # noqa: E402
+from evox_tpu.hpo import HPOFitnessMonitor, NestedProblem  # noqa: E402
+from evox_tpu.problems.numerical import Sphere  # noqa: E402
+from evox_tpu.workflows import StdWorkflow  # noqa: E402
+
+# The fixed ladder config: outer candidates x inner pop x inner gens.
+CANDIDATES = 16
+INNER_POP = 64
+ITERATIONS = 32
+DIM = 16
+REPEATS = 7
+EVALS_PER_ROUND = 5  # outer evaluations timed per repeat
+FLOOR = 0.90
+
+
+def _inner_workflow():
+    return StdWorkflow(
+        OpenES(INNER_POP, jnp.zeros(DIM), learning_rate=0.05, noise_stdev=0.1),
+        Sphere(),
+        monitor=HPOFitnessMonitor(),
+    )
+
+
+def _fused_side():
+    nested = NestedProblem(
+        _inner_workflow(), iterations=ITERATIONS, num_candidates=CANDIDATES
+    )
+    state = nested.setup(jax.random.key(0))
+    params = nested.get_init_params(state)
+    evaluate = jax.jit(nested.evaluate)
+
+    def run_once():
+        fit, _ = evaluate(state, params)
+        return fit
+
+    return run_once
+
+
+def _handrolled_side():
+    wf = _inner_workflow()
+    keys = jax.random.split(jax.random.key(0), CANDIDATES)
+    instances = jax.vmap(wf.setup)(keys)
+    from evox_tpu.core import get_params
+
+    params = get_params(instances)
+
+    def run_one(ws, hp):
+        from evox_tpu.core import set_params
+
+        ws = set_params(ws, hp)
+        ws = wf.init_step(ws)
+        ws = jax.lax.fori_loop(
+            0, ITERATIONS - 2, lambda _, s: wf.step(s), ws
+        )
+        ws = wf.final_step(ws)
+        return wf.monitor.tell_fitness(ws.monitor)
+
+    evaluate = jax.jit(lambda inst, hp: jax.vmap(run_one)(inst, hp))
+
+    def run_once():
+        return evaluate(instances, params)
+
+    return run_once
+
+
+def _timed(run_once) -> float:
+    t0 = time.perf_counter()
+    for _ in range(EVALS_PER_ROUND):
+        jax.block_until_ready(run_once())
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    sides = {"handrolled": _handrolled_side(), "fused": _fused_side()}
+    for run_once in sides.values():  # warm: compiles amortized out
+        jax.block_until_ready(run_once())
+    seconds = {m: [] for m in sides}
+    for _ in range(REPEATS):
+        for m in sides:
+            seconds[m].append(_timed(sides[m]))
+    eps = {m: EVALS_PER_ROUND / min(seconds[m]) for m in sides}
+    ratio = eps["fused"] / eps["handrolled"]
+    inner_gens = CANDIDATES * ITERATIONS
+    result = {
+        "bench": "hpo_nested_overhead",
+        "backend": jax.default_backend(),
+        "candidates": CANDIDATES,
+        "inner_pop": INNER_POP,
+        "iterations": ITERATIONS,
+        "dim": DIM,
+        "repeats": REPEATS,
+        "seconds": seconds,
+        "evaluations_per_sec": eps,
+        "inner_gens_per_sec": {m: v * inner_gens for m, v in eps.items()},
+        "throughput_ratio": ratio,
+        "floor_ratio": FLOOR,
+        "within_budget": ratio >= FLOOR,
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(
+        out_dir, f"hpo_overhead.{jax.default_backend()}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"fused nested evaluate vs hand-rolled vmap-of-fori_loop "
+        f"(outer {CANDIDATES} x inner {INNER_POP} x {ITERATIONS} gens, "
+        f"best-of-{REPEATS}):\n"
+        f"  hand-rolled {eps['handrolled']:7.2f} evals/s\n"
+        f"  fused       {eps['fused']:7.2f} evals/s = {ratio * 100:5.1f}% "
+        f"(floor {FLOOR * 100:.0f}%)"
+    )
+    print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+    if ratio < FLOOR:
+        print(
+            f"FAIL: fused nested evaluate at {ratio * 100:.1f}% is under "
+            f"the {FLOOR * 100:.0f}% floor",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -32,6 +32,7 @@ NAMESPACES = [
     ("problems.numerical", "evox_tpu.problems.numerical"),
     ("problems.neuroevolution", "evox_tpu.problems.neuroevolution"),
     ("problems.hpo_wrapper", "evox_tpu.problems.hpo_wrapper"),
+    ("hpo", "evox_tpu.hpo"),
     ("operators.selection", "evox_tpu.operators.selection"),
     ("operators.crossover", "evox_tpu.operators.crossover"),
     ("operators.mutation", "evox_tpu.operators.mutation"),

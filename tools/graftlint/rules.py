@@ -71,7 +71,7 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Iterator
+from typing import Any, Iterator
 
 from .engine import Finding, Module, Rule
 
@@ -673,10 +673,82 @@ class KeyReuseRule(Rule):
         "forward (`state.replace(key=new_key)`)"
     )
 
+    # Mapping wrappers that replicate the mapped function per batch member:
+    # a CLOSURE key consumed inside one is consumed once per instance.
+    _MAP_WRAPPERS = frozenset({"vmap", "pmap"})
+
     def check(self, mod: Module) -> list[Finding]:
         findings: list[Finding] = []
         for fn, _cls, _enc in _iter_functions(mod.tree):
             findings.extend(self._check_function(mod, fn))
+            findings.extend(self._check_mapped_closures(mod, fn))
+        return findings
+
+    # -- nested-workflow scope: keys closed over by vmapped functions --------
+    def _check_mapped_closures(self, mod: Module, fn: ast.AST) -> list[Finding]:
+        """The nested-workflow (HPO) reuse shape: a key from the OUTER
+        scope consumed inside a ``jax.vmap``/``pmap``-mapped function.
+        The mapped function runs once per batch member (inner instance),
+        so a closure-captured key — unlike a mapped parameter — hands
+        every instance the SAME stream: N inner workflows drawing
+        identical randomness.  Split per instance, or fold in each
+        instance's stable uid (``evox_tpu.hpo``'s identity-keyed
+        contract)."""
+        local_defs = {
+            n.name: n
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        }
+        findings: list[Finding] = []
+        flagged: set[tuple[int, str]] = set()
+        for node in _body_walk(fn, into_nested=False):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Call
+            ):
+                continue
+            wrapper = node.func
+            tail = (_dotted(wrapper.func) or "").rsplit(".", 1)[-1]
+            if tail not in self._MAP_WRAPPERS or not wrapper.args:
+                continue
+            mapped = wrapper.args[0]
+            if isinstance(mapped, ast.Lambda):
+                params = {a.arg for a in mapped.args.args}
+                body_nodes = list(ast.walk(mapped.body))
+            elif isinstance(mapped, ast.Name) and mapped.id in local_defs:
+                target = local_defs[mapped.id]
+                params = {a.arg for a in target.args.args}
+                body_nodes = list(_body_walk(target, into_nested=True))
+            else:
+                continue  # attributes/externals: cannot see the body
+            for n in body_nodes:
+                if not isinstance(n, ast.Call):
+                    continue
+                ctail = (_dotted(n.func) or "").rsplit(".", 1)[-1]
+                if (
+                    ctail in _KEY_TRANSPARENT
+                    or ctail in ("replace", "State")
+                    or _EXC_NAME.search(ctail)
+                ):
+                    continue
+                for arg in list(n.args) + [k.value for k in n.keywords]:
+                    kid = _key_expr_id(arg)
+                    if kid is None or kid.split(".", 1)[0] in params:
+                        continue
+                    if (n.lineno, kid) in flagged:
+                        continue
+                    flagged.add((n.lineno, kid))
+                    findings.append(
+                        self.finding(
+                            mod,
+                            n,
+                            f"outer PRNG key `{kid}` consumed inside a "
+                            f"`{tail}`-mapped function — every mapped "
+                            f"instance draws IDENTICAL randomness; split "
+                            f"the key per instance, or fold in each "
+                            f"instance's stable uid",
+                        )
+                    )
         return findings
 
     # Consumption model: any call that receives a key-like expression uses it
@@ -1229,10 +1301,27 @@ class AxisIndexFoldRule(Rule):
     # Wrappers through which a nested function is invoked with positionally
     # mapped arguments (``jax.vmap(f)(xs)`` hands ``xs`` to ``f``'s params).
     _WRAPPERS = frozenset({"vmap", "pmap", "jit", "shard_map", "checkpoint"})
+    # Wrappers whose mapped axis is a BATCH POSITION: an inline
+    # ``jnp.arange``/``iota`` mapped through one of these is a lane index.
+    _MAP_WRAPPERS = frozenset({"vmap", "pmap"})
+    # Parameter names that declare a stable identity (the sanctioned thing
+    # to fold): candidate uids, tenant identities.  A lane index renamed
+    # `uid` is a lie the reviewer owns; the linter trusts the name, exactly
+    # like the `_KEY_NAME` heuristic GL001 is built on.
+    _UID_NAME = re.compile(r"(uid|candidate|identity|tenant)", re.IGNORECASE)
 
     def check(self, mod: Module) -> list[Finding]:
-        if "axis_index" not in mod.source:
-            return []  # cheap pre-filter: nothing to derive from
+        # Cheap pre-filters: axis_index derivation (the original rule) or
+        # the nested-workflow lane-index shape (an arange/iota mapped
+        # through vmap into a fold_in).
+        has_axis = "axis_index" in mod.source
+        has_lane = (
+            "fold_in" in mod.source
+            and ("vmap" in mod.source or "pmap" in mod.source)
+            and ("arange" in mod.source or "iota" in mod.source)
+        )
+        if not has_axis and not has_lane:
+            return []
         findings: list[Finding] = []
         for fn, _cls, enclosing in _iter_functions(mod.tree):
             if enclosing is not None:
@@ -1240,19 +1329,35 @@ class AxisIndexFoldRule(Rule):
             findings.extend(self._check_tree(mod, fn))
         return findings
 
-    def _call_target(self, call: ast.Call) -> str | None:
-        """Name of the function a call ultimately hands its args to: a bare
-        ``f(...)`` or a wrapper application ``jax.vmap(f)(...)``."""
+    def _call_target(self, call: ast.Call) -> tuple[Any, bool]:
+        """``(target, mapped)`` — the function a call ultimately hands its
+        args to (a bare ``f(...)`` name, or the Name/Lambda inside a
+        wrapper application ``jax.vmap(f)(...)``) and whether the
+        application maps a batch axis (vmap/pmap: positional args become
+        per-batch-member parameter values)."""
         if isinstance(call.func, ast.Name):
-            return call.func.id
+            return call.func.id, False
         if isinstance(call.func, ast.Call):
             inner = call.func
             tail = (_dotted(inner.func) or "").rsplit(".", 1)[-1]
-            if tail in self._WRAPPERS and inner.args and isinstance(
-                inner.args[0], ast.Name
-            ):
-                return inner.args[0].id
-        return None
+            if tail in self._WRAPPERS and inner.args:
+                mapped = tail in self._MAP_WRAPPERS
+                if isinstance(inner.args[0], ast.Name):
+                    return inner.args[0].id, mapped
+                if mapped and isinstance(inner.args[0], ast.Lambda):
+                    return inner.args[0], True
+        return None, False
+
+    @staticmethod
+    def _is_lane_index(node: ast.AST) -> bool:
+        """An inline batch-position iota: ``jnp.arange(...)`` /
+        ``lax.iota(...)`` handed straight to a vmap application — the
+        lane-index idiom (contrast: a *stable-uid* array is state/config
+        data, reaching the call as a name)."""
+        return isinstance(node, ast.Call) and (
+            (_dotted(node.func) or "").rsplit(".", 1)[-1]
+            in ("arange", "iota")
+        )
 
     def _check_tree(self, mod: Module, fn: ast.AST) -> list[Finding]:
         # Whole-lexical-tree fixpoint taint (statement order ignored — a
@@ -1302,13 +1407,25 @@ class AxisIndexFoldRule(Rule):
                     tainted.add(node.target.id)
                     changed = True
                 elif isinstance(node, ast.Call):
-                    target = self._call_target(node)
-                    if target in nested:
+                    target, mapped = self._call_target(node)
+                    params: list[str] = []
+                    if isinstance(target, str) and target in nested:
                         params = [a.arg for a in nested[target].args.args]
-                        for param, arg in zip(params, node.args):
-                            if derived(arg) and param not in tainted:
-                                tainted.add(param)
-                                changed = True
+                    elif isinstance(target, ast.Lambda):
+                        params = [a.arg for a in target.args.args]
+                    for param, arg in zip(params, node.args):
+                        # A batch-position iota mapped through vmap/pmap is
+                        # a LANE index: folding it (instead of a stable
+                        # candidate uid) ties the stream to placement —
+                        # the nested-workflow twin of the axis_index bug.
+                        lane = (
+                            mapped
+                            and self._is_lane_index(arg)
+                            and not self._UID_NAME.search(param)
+                        )
+                        if (derived(arg) or lane) and param not in tainted:
+                            tainted.add(param)
+                            changed = True
 
         findings: list[Finding] = []
         flagged: set[int] = set()
@@ -1324,10 +1441,13 @@ class AxisIndexFoldRule(Rule):
                     self.finding(
                         mod,
                         node,
-                        "`fold_in` fed an `axis_index`-derived value — the "
-                        "PRNG stream depends on the mesh topology, so the "
-                        "same seed diverges across mesh sizes and re-meshed "
-                        "resume forks; fold the global slot index instead",
+                        "`fold_in` fed a placement-derived value "
+                        "(`axis_index` shard position, or a vmap lane "
+                        "index) — the PRNG stream depends on WHERE the "
+                        "value runs, so the same seed diverges across mesh "
+                        "sizes / lane assignments and re-meshed or "
+                        "re-packed resume forks; fold the global slot "
+                        "index or the stable candidate uid instead",
                     )
                 )
         return findings
