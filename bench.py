@@ -415,6 +415,175 @@ def bench_pso_northstar_bf16_rbg(n_steps, profile_dir=None):
     return result
 
 
+def accuracy_bound(ref: float, tol_factor: float, eps: float) -> float:
+    """Upper bound a 'lower is better' policy metric may reach against the
+    f32 reference: a relative band ``ref + (tol_factor-1)*|ref| + eps``.
+    ONE definition shared with ``tools/bench_precision.py`` — the plain
+    ``ref * tol_factor`` product INVERTS the tolerance when ``ref < 0``
+    (CEC optima below zero), demanding the policy *beat* the reference."""
+    return ref + (tol_factor - 1.0) * abs(ref) + eps
+
+
+def _policy_quality(
+    make_ref, make_policy, final_metric, label, gens, tol_factor, eps
+):
+    """Accuracy gate for a precision config: run the f32 reference and the
+    policy workflow for ``gens`` fused generations at a reduced (CPU-safe)
+    shape and compare ``final_metric`` (lower is better).  A policy that
+    degrades the metric beyond ``tol_factor`` x the reference FAILS the
+    config (raises) — a fast-but-wrong number must never be recorded as a
+    win.  Returns the quality record on pass."""
+    import jax
+
+    def run_final(wf):
+        st = wf.init(0)
+        st = jax.jit(wf.init_step)(st)
+        return float(final_metric(wf.run(st, gens, init=False)))
+
+    ref = run_final(make_ref())
+    pol = run_final(make_policy())
+    quality = {
+        "metric": label,
+        "gens": gens,
+        "ref": ref,
+        "policy": pol,
+        "tol_factor": tol_factor,
+    }
+    if not pol <= accuracy_bound(ref, tol_factor, eps):
+        raise RuntimeError(
+            f"precision accuracy gate FAILED: policy {label} {pol} exceeds "
+            f"{tol_factor}x the f32 reference {ref} after {gens} "
+            f"generations — the policy degrades convergence and must not "
+            f"be recorded as a win ({quality})"
+        )
+    return quality
+
+
+def _policy_quality_so(make_ref, make_policy, gens=100, tol_factor=1.25):
+    """Single-objective gate: final best fitness, policy vs f32."""
+    import jax.numpy as jnp
+
+    return _policy_quality(
+        make_ref,
+        make_policy,
+        lambda st: jnp.min(st.algorithm.fit.astype(jnp.float32)),
+        "final best fitness",
+        gens,
+        tol_factor,
+        1e-6,
+    )
+
+
+def _policy_quality_igd(make_ref, make_policy, pf, gens=50, tol_factor=1.15):
+    """Multi-objective gate: final IGD against the analytic Pareto
+    front, policy vs f32."""
+    import jax.numpy as jnp
+
+    from evox_tpu.metrics import igd
+
+    return _policy_quality(
+        make_ref,
+        make_policy,
+        lambda st: igd(st.algorithm.fit.astype(jnp.float32), pf),
+        "igd",
+        gens,
+        tol_factor,
+        1e-9,
+    )
+
+
+def bench_pso_northstar_policy(n_steps, profile_dir=None):
+    """The north-star config through the PRODUCT fast path: a plain
+    ``StdWorkflow(precision=PrecisionPolicy(), key_impl="rbg")`` — bf16
+    storage leaves, f32 compute, hardware rbg PRNG — instead of the
+    hand-built bench-only recipes (``pso_northstar_bf16_rbg``).  This is
+    the number that proves the +75% measured lever is now an API any
+    algorithm/runner/tenant opts into, and its accuracy gate (final
+    fitness vs the f32 reference at a reduced shape) fails the config
+    outright if the policy degrades convergence."""
+    from evox_tpu.algorithms import PSO
+    from evox_tpu.precision import PrecisionPolicy
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows import StdWorkflow
+
+    lb, ub = _box(1000)
+    wf = StdWorkflow(
+        PSO(100_000, lb, ub),
+        Sphere(),
+        precision=PrecisionPolicy(),
+        key_impl="rbg",
+    )
+    gps, _ = _timed_steps(wf, n_steps, profile_dir=profile_dir)
+    qlb, qub = _box(128)
+    quality = _policy_quality_so(
+        lambda: StdWorkflow(PSO(2048, qlb, qub), Sphere()),
+        lambda: StdWorkflow(
+            PSO(2048, qlb, qub),
+            Sphere(),
+            precision=PrecisionPolicy(),
+            key_impl="rbg",
+        ),
+    )
+    return {
+        "metric": (
+            "PSO generations/sec/chip, PrecisionPolicy(bf16)+rbg "
+            "(pop=100000, dim=1000, Sphere)"
+        ),
+        "value": round(gps, 3),
+        "unit": "generations/sec",
+        "precision_policy": "storage=bfloat16,compute=float32",
+        "key_impl": "rbg",
+        "quality": quality,
+    }
+
+
+def bench_nsga2_dtlz2_policy(n_steps, profile_dir=None):
+    """NSGA-II under the precision policy (bf16 pop/fit/dis storage, f32
+    rank/crowding compute) with an IGD accuracy gate vs the f32 reference
+    — the EMO side of the numerics plane (the tensorized-EMO paper's
+    claim that large-population EMO throughput comes from precision-aware
+    kernels, with "fast" provably not meaning "wrong")."""
+    import jax.numpy as jnp
+
+    from evox_tpu.algorithms import NSGA2
+    from evox_tpu.precision import PrecisionPolicy
+    from evox_tpu.problems.numerical import DTLZ2
+    from evox_tpu.workflows import StdWorkflow
+
+    d, m, pop = 12, 3, 10_000
+    wf = StdWorkflow(
+        NSGA2(pop, m, jnp.zeros(d), jnp.ones(d)),
+        DTLZ2(d=d, m=m),
+        precision=PrecisionPolicy(),
+        key_impl="rbg",
+    )
+    gps, _ = _timed_steps(wf, n_steps, profile_dir=profile_dir)
+    qpop = 256
+    quality = _policy_quality_igd(
+        lambda: StdWorkflow(
+            NSGA2(qpop, m, jnp.zeros(d), jnp.ones(d)), DTLZ2(d=d, m=m)
+        ),
+        lambda: StdWorkflow(
+            NSGA2(qpop, m, jnp.zeros(d), jnp.ones(d)),
+            DTLZ2(d=d, m=m),
+            precision=PrecisionPolicy(),
+            key_impl="rbg",
+        ),
+        DTLZ2(d=d, m=m).pf(),
+    )
+    return {
+        "metric": (
+            "NSGA-II generations/sec/chip, PrecisionPolicy(bf16)+rbg "
+            f"(pop={pop}, DTLZ2 m=3)"
+        ),
+        "value": round(gps, 3),
+        "unit": "generations/sec",
+        "precision_policy": "storage=bfloat16,compute=float32",
+        "key_impl": "rbg",
+        "quality": quality,
+    }
+
+
 def bench_pso_northstar_pallas(n_steps, profile_dir=None):
     """North-star config in bf16 with the Pallas-fused move kernel
     (``PallasPSO``): personal-best fold + in-kernel hardware PRNG +
@@ -646,6 +815,154 @@ def bench_rank_20k(n_steps, profile_dir=None):
     }
 
 
+def _timed_op(fn, args, n_steps, metric, unit, profile_dir=None, extra=None):
+    """Operator-microbench shape shared by the crowding/top-k twins
+    (bench_rank_20k's discipline): jit, compile outside the timer, then
+    n_steps dispatches behind block_until_ready."""
+    import jax
+
+    compiled = jax.jit(fn)
+    out = compiled(*args)
+    jax.block_until_ready(out)
+    if profile_dir:
+        _dump_compiled(compiled.lower(*args).compile(), profile_dir)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        out = compiled(*args)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    result = {
+        "metric": metric,
+        "value": round(n_steps / elapsed, 3),
+        "unit": unit,
+    }
+    if extra:
+        result.update(extra)
+    return result
+
+
+def _crowding_inputs(n=50_000, m=3):
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.key(0)
+    # Evolved-like front structure (the rank_20k recipe): noise plus a
+    # drift so fronts have realistic widths, plus quantization for ties.
+    f = jax.random.normal(key, (n, m)) + jnp.linspace(0.0, 3.0, n)[:, None]
+    return jnp.round(f * 64) / 64
+
+
+def bench_crowding_50k(n_steps, profile_dir=None):
+    """XLA reference crowding distance at the pop=50k cliff shape (the
+    merged-population call inside NSGA-II survivor selection is 2N rows;
+    this measures the op in isolation): m stable sorts + two scatters —
+    the formulation the Pallas neighbor kernel exists to beat.  The twin
+    ``crowding_50k_pallas`` measures the kernel; the next TPU sweep
+    decides the winner empirically."""
+    from evox_tpu.operators.selection import crowding_distance
+    from evox_tpu.operators.selection.non_dominate import (
+        _pallas_crowding_eligible,
+    )
+
+    f = _crowding_inputs()
+    if _pallas_crowding_eligible(f):
+        raise RuntimeError(
+            "crowding_50k: the Pallas gate is open for this input, so the "
+            "kernel (not the XLA sort+scatter path) would be measured "
+            "under the XLA label; unset EVOX_TPU_PALLAS for this config."
+        )
+    return _timed_op(
+        crowding_distance,
+        (f,),
+        n_steps,
+        "crowding_distance calls/sec (n=50000, m=3, XLA sort+scatter)",
+        "calls/sec",
+        profile_dir=profile_dir,
+    )
+
+
+def bench_crowding_50k_pallas(n_steps, profile_dir=None):
+    """The tiled lexicographic-neighbor Pallas kernel
+    (``ops/crowding.py``) on the same input — refuses to run (rather than
+    mislabel the XLA path) when the gate is closed or the dispatch
+    threshold exceeds the input."""
+    from evox_tpu.operators.selection import crowding_distance
+    from evox_tpu.operators.selection.non_dominate import (
+        _pallas_crowding_eligible,
+    )
+
+    f = _crowding_inputs()
+    if not _pallas_crowding_eligible(f):
+        raise RuntimeError(
+            "crowding_50k_pallas: the crowding kernel is not eligible for "
+            "this input (gate closed / EVOX_TPU_PALLAS_CROWDING_MIN_POP "
+            "over 50000) — the XLA path would be measured under a pallas "
+            "label."
+        )
+    return _timed_op(
+        crowding_distance,
+        (f,),
+        n_steps,
+        "crowding_distance calls/sec (n=50000, m=3, pallas neighbor kernel)",
+        "calls/sec",
+        profile_dir=profile_dir,
+    )
+
+
+def bench_topk_50k(n_steps, profile_dir=None):
+    """XLA reference masked top-k (stable argsort) at the cliff shape —
+    k = n/2, the survivor-selection ratio.  Twin of ``topk_50k_pallas``."""
+    import functools
+
+    from evox_tpu.operators.selection.non_dominate import (
+        _pallas_topk_eligible,
+    )
+    from evox_tpu.ops.topk import masked_top_k_xla
+
+    f = _crowding_inputs(m=1)[:, 0]
+    if _pallas_topk_eligible(f):
+        raise RuntimeError(
+            "topk_50k: the Pallas gate is open for this input; unset "
+            "EVOX_TPU_PALLAS so the XLA label measures the XLA path."
+        )
+    return _timed_op(
+        functools.partial(masked_top_k_xla, k=25_000),
+        (f,),
+        n_steps,
+        "masked_top_k calls/sec (n=50000, k=25000, XLA stable argsort)",
+        "calls/sec",
+        profile_dir=profile_dir,
+    )
+
+
+def bench_topk_50k_pallas(n_steps, profile_dir=None):
+    """The rank-by-count Pallas kernel (``ops/topk.py``) on the same
+    input; refuses to run with the gate closed."""
+    import functools
+
+    from evox_tpu.operators.selection.non_dominate import (
+        _pallas_topk_eligible,
+    )
+    from evox_tpu.ops.topk import masked_top_k
+
+    f = _crowding_inputs(m=1)[:, 0]
+    if not _pallas_topk_eligible(f):
+        raise RuntimeError(
+            "topk_50k_pallas: the top-k kernel is not eligible for this "
+            "input (gate closed / EVOX_TPU_PALLAS_TOPK_MIN_POP over "
+            "50000) — the XLA path would be measured under a pallas "
+            "label."
+        )
+    return _timed_op(
+        functools.partial(masked_top_k, k=25_000),
+        (f,),
+        n_steps,
+        "masked_top_k calls/sec (n=50000, k=25000, pallas rank-by-count)",
+        "calls/sec",
+        profile_dir=profile_dir,
+    )
+
+
 def bench_nsga2_dtlz2_50k(n_steps, profile_dir=None):
     """NSGA-II at pop=50k: a scale the dense bool dominance matrix cannot
     reach on one chip (the merged 2N=100k bool matrix alone is 10 GB; the
@@ -660,24 +977,29 @@ def bench_nsga2_dtlz2_pallas(n_steps, profile_dir=None):
     rather than silently measuring the broadcast path under a pallas label —
     when the gate is closed or the population is below the dispatch
     threshold."""
-    from evox_tpu.operators.selection.non_dominate import _pallas_min_pop
-    from evox_tpu.ops.pallas_gate import pallas_enabled
+    import jax.numpy as jnp
 
-    if not pallas_enabled():
-        raise RuntimeError(
-            "nsga2_dtlz2_pallas: the Pallas gate is closed (no passing "
-            "capability verdict for this backend — run "
-            "`python -m evox_tpu.ops.pallas_gate` first)."
-        )
+    from evox_tpu.operators.selection.non_dominate import (
+        _pallas_kernel_eligible,
+    )
+
     # NSGA-II's survivor selection ranks the merged parent+offspring
     # population, so the kernel dispatches on 2N=20000 rows each step (and
-    # on N=10000 only for the init-step ranking): the threshold must stay
-    # at or below the merged size for the measured path to be the kernel.
-    if _pallas_min_pop() > 20_000:
+    # on N=10000 only for the init-step ranking).  Ask the REAL dispatch
+    # predicate at that shape — the same guard the crowding/topk twins
+    # use — so every condition dispatch requires (the open gate, the
+    # min-pop threshold, and since the demotion the explicit
+    # EVOX_TPU_PALLAS_DOMINANCE opt-in) is checked in one place and this
+    # config can never silently measure the broadcast path under a
+    # pallas label.
+    if not _pallas_kernel_eligible(jnp.zeros((20_000, 3), jnp.float32)):
         raise RuntimeError(
-            "nsga2_dtlz2_pallas: EVOX_TPU_PALLAS_MIN_POP exceeds the "
-            "config's merged population (2N=20000); the kernel would "
-            "never dispatch."
+            "nsga2_dtlz2_pallas: the demoted dominance kernel would not "
+            "dispatch at the config's merged population (2N=20000) — it "
+            "needs the open Pallas gate (capability verdict: run "
+            "`python -m evox_tpu.ops.pallas_gate`), "
+            "EVOX_TPU_PALLAS_DOMINANCE=1 (explicit opt-in since the "
+            "demotion), and EVOX_TPU_PALLAS_MIN_POP <= 20000."
         )
     result = bench_nsga2_dtlz2(n_steps, profile_dir=profile_dir)
     result["metric"] += ", pallas dominance kernel"
@@ -1160,14 +1482,29 @@ def bench_smoke(n_steps, profile_dir=None):
 # unsupported single-client relay can hang it), and the bench fn refuses to
 # measure rather than mislabel the broadcast path.
 CONFIG_ENV = {
-    "nsga2_dtlz2_pallas": {"EVOX_TPU_PALLAS": "probe"},
+    # The dominance kernel is DEMOTED (it measurably loses to XLA): its
+    # bench twin keeps recording the loss via the explicit opt-in on top
+    # of the probe gate, so the verdict stays re-litigable — never a
+    # default path (see ops/dominance.py).
+    "nsga2_dtlz2_pallas": {
+        "EVOX_TPU_PALLAS": "probe",
+        "EVOX_TPU_PALLAS_DOMINANCE": "1",
+    },
     "pso_northstar_pallas": {"EVOX_TPU_PALLAS": "probe"},
+    "crowding_50k_pallas": {"EVOX_TPU_PALLAS": "probe"},
+    "topk_50k_pallas": {"EVOX_TPU_PALLAS": "probe"},
 }
 
 # Configs that never run under --all: smoke is a diagnostic, and the pallas
-# variant must not dispatch on an unprobed attachment.  (Also consumed by
+# variants must not dispatch on an unprobed attachment.  (Also consumed by
 # tools/update_baseline.py for its artifact-fallback rule.)
-EXPLICIT_ONLY = {"smoke", "nsga2_dtlz2_pallas", "pso_northstar_pallas"}
+EXPLICIT_ONLY = {
+    "smoke",
+    "nsga2_dtlz2_pallas",
+    "pso_northstar_pallas",
+    "crowding_50k_pallas",
+    "topk_50k_pallas",
+}
 
 # name -> (fn, tpu_steps, cpu_steps)
 CONFIGS = {
@@ -1180,12 +1517,18 @@ CONFIGS = {
     "pso_northstar_rbg": (bench_pso_northstar_rbg, 100, 3),
     "pso_northstar_bf16": (bench_pso_northstar_bf16, 100, 3),
     "pso_northstar_bf16_rbg": (bench_pso_northstar_bf16_rbg, 100, 3),
+    "pso_northstar_policy": (bench_pso_northstar_policy, 100, 3),
     "pso_northstar_pallas": (bench_pso_northstar_pallas, 100, 3),
     "cmaes_cec": (bench_cmaes_cec, 200, 50),
     "de_cec": (bench_de_cec, 200, 20),
     "openes_cec": (bench_openes_cec, 300, 50),
     "nsga2_dtlz2": (bench_nsga2_dtlz2, 30, 3),
+    "nsga2_dtlz2_policy": (bench_nsga2_dtlz2_policy, 30, 3),
     "rank_20k": (bench_rank_20k, 30, 3),
+    "crowding_50k": (bench_crowding_50k, 30, 3),
+    "crowding_50k_pallas": (bench_crowding_50k_pallas, 30, 3),
+    "topk_50k": (bench_topk_50k, 30, 3),
+    "topk_50k_pallas": (bench_topk_50k_pallas, 30, 3),
     "nsga2_dtlz2_50k": (bench_nsga2_dtlz2_50k, 10, 2),
     "nsga2_dtlz2_pallas": (bench_nsga2_dtlz2_pallas, 30, 3),
     "nsga2_dtlz2_fused": (bench_nsga2_dtlz2_fused, 30, 3),

@@ -16,6 +16,7 @@ from . import (
     metrics,
     obs,
     operators,
+    precision,
     problems,
     resilience,
     service,
@@ -45,6 +46,7 @@ __all__ = [
     "metrics",
     "obs",
     "operators",
+    "precision",
     "problems",
     "resilience",
     "service",

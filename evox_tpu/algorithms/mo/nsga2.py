@@ -37,6 +37,12 @@ __all__ = ["NSGA2"]
 class NSGA2(Algorithm):
     """Tensorized NSGA-II for multi-objective optimization."""
 
+    # Mixed-precision map (``evox_tpu.precision``): decision variables,
+    # objectives and crowding distances are population-sized and safe to
+    # store narrow (ranks are int32 and unmapped by construction; the
+    # rank/crowding *computation* runs in the compute dtype at the seam).
+    storage_leaves = ("pop", "fit", "dis")
+
     def __init__(
         self,
         pop_size: int,

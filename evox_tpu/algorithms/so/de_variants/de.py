@@ -24,6 +24,10 @@ __all__ = ["DE"]
 class DE(Algorithm):
     """Classic DE/rand-or-best/k/bin."""
 
+    # Mixed-precision map (``evox_tpu.precision``): the two
+    # population-sized buffers; trial vectors are built per step.
+    storage_leaves = ("pop", "fit")
+
     def __init__(
         self,
         pop_size: int,

@@ -24,6 +24,13 @@ __all__ = ["CMAES"]
 
 
 class CMAES(Algorithm):
+    # Mixed-precision map (``evox_tpu.precision``): only the fitness
+    # buffer is population-sized.  Everything else (mean, covariance,
+    # evolution paths, step size) accumulates across generations — the
+    # C/A/C_invsqrt small-matmul updates are precision-critical and stay
+    # in the compute dtype end to end.
+    storage_leaves = ("fit",)
+
     def __init__(
         self,
         mean_init: jax.Array,

@@ -19,6 +19,11 @@ __all__ = ["OpenES"]
 
 
 class OpenES(CenterES):
+    # Mixed-precision map (``evox_tpu.precision``): only the fitness
+    # buffer is population-sized; the center and optimizer moments
+    # accumulate across generations and must keep full precision.
+    storage_leaves = ("fit",)
+
     def __init__(
         self,
         pop_size: int,

@@ -38,6 +38,18 @@ BASELINE.md measures both).  ``rand="input"`` draws Threefry uniforms
 outside the kernel and feeds them in — deterministic across backends
 (and how the CPU interpret-mode tests run the full padded path), at the
 cost of materializing the two (N, D) draw tensors the hw mode avoids.
+
+**Relation to the precision plane.**  The bf16+rbg recipe this class was
+built to beat is now the product's first-class fast path:
+``StdWorkflow(precision=PrecisionPolicy(), key_impl="rbg")``
+(``evox_tpu.precision``; ``docs/guide/precision.md``) gets bf16 storage
+and hardware random bits on ANY algorithm without a custom kernel.
+``PallasPSO`` remains the hand-fused specialist on top of it — one HBM
+pass for the whole move instead of the policy path's two mega-fusions
+plus standalone PRNG ops — and the ``pso_northstar_policy`` /
+``pso_northstar_pallas`` bench twins keep the comparison honest per
+attachment.  ``PSO.storage_leaves`` (inherited here) is the per-leaf
+dtype map the policy applies.
 """
 
 from __future__ import annotations

@@ -23,6 +23,19 @@ __all__ = ["PSO"]
 class PSO(Algorithm):
     """Canonical inertia/cognitive/social PSO."""
 
+    # Declarative mixed-precision map (``evox_tpu.precision``): the
+    # population-sized buffers audited as safe to carry in a narrow
+    # storage dtype between generations.  The global-best pair stays full
+    # precision — it is O(dim) (no HBM leverage) and it anchors the
+    # monotone best-fold comparisons.
+    storage_leaves = (
+        "pop",
+        "velocity",
+        "local_best_location",
+        "local_best_fit",
+        "fit",
+    )
+
     def __init__(
         self,
         pop_size: int,

@@ -431,6 +431,11 @@ class NestedProblem(Problem):
             fitness_transform=wf.fitness_transform,
             quarantine_nonfinite=wf.quarantine_nonfinite,
             nonfinite_penalty=wf.nonfinite_penalty,
+            # Numerics identity survives elastic regrowth: dropping the
+            # policy/impl here would silently widen a bf16 inner run (or
+            # fork its streams) at the first hpo-grow boundary.
+            precision=getattr(wf, "precision", None),
+            key_impl=getattr(wf, "key_impl", None),
         )
         return self.with_inner_workflow(new_wf)
 

@@ -159,8 +159,8 @@ class HPORunner(ResilientRunner):
             self._rebind_workflow()
 
     # -- manifests: inner metadata + the history ring ------------------------
-    def _manifest_extras(self, probed: bool) -> dict:
-        extras = super()._manifest_extras(probed)
+    def _manifest_extras(self, probed, state=None) -> dict:
+        extras = super()._manifest_extras(probed, state)
         nested = self._nested()
         from ..service.tenant import static_signature
 

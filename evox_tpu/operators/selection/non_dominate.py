@@ -169,10 +169,7 @@ def _non_dominate_rank_packed(
     return _peel_fronts(dominate_count, count_desc_fn, n, until_count)
 
 
-def _pallas_min_pop() -> int:
-    import os
-
-    return int(os.environ.get("EVOX_TPU_PALLAS_MIN_POP", "4096"))
+_PALLAS_MIN_POP_DEFAULT = 4096
 
 
 def _dominance_matrix(f: jax.Array) -> jax.Array:
@@ -203,21 +200,87 @@ def _dominance_matrix(f: jax.Array) -> jax.Array:
 def _pallas_kernel_eligible(f: jax.Array) -> bool:
     """Would ``_dominance_matrix`` dispatch the Pallas kernel for ``f``?
     One predicate shared by the matrix and rank dispatchers so their
-    routing can never disagree."""
-    if f.ndim != 2 or f.shape[0] < _pallas_min_pop():
+    routing can never disagree.
+
+    **Demoted (PR 15):** the dominance kernel measurably LOSES to plain
+    XLA on the NSGA-II bench (69 vs 90 gen/s; the packed broadcast path
+    fuses better) — the general ``EVOX_TPU_PALLAS`` gate alone no longer
+    dispatches it anywhere.  It is kept as an explicit opt-in
+    (``EVOX_TPU_PALLAS_DOMINANCE=1`` *in addition to* the open gate) with
+    its bench twin (``nsga2_dtlz2_pallas``) recording the loss, so the
+    next TPU sweep can re-litigate the verdict empirically instead of
+    the kernel rotting as silent dead code.  Pallas effort now aims at
+    the ops where XLA demonstrably loses: the tiled crowding-distance
+    kernel (``ops/crowding.py``) and the masked top-k selection kernel
+    (``ops/topk.py``)."""
+    import os
+
+    if os.environ.get("EVOX_TPU_PALLAS_DOMINANCE", "0").strip().lower() not in (
+        "1",
+        "force",
+        "on",
+        "true",
+    ):
         return False
-    if f.dtype == jnp.float64 and jax.default_backend() == "tpu":
+    return _pallas_op_eligible(
+        f, 2, "EVOX_TPU_PALLAS_MIN_POP", default_min_pop=_PALLAS_MIN_POP_DEFAULT
+    )
+
+
+def _pallas_op_eligible(
+    arr: jax.Array, ndim: int, min_pop_env: str, default_min_pop: int = 8192
+) -> bool:
+    """ONE definition of the per-op Pallas gating shape, so the three
+    dispatchers can never drift: input rank and dispatch threshold
+    (``min_pop_env`` rows, env-overridable), no f64 on a real TPU (Mosaic
+    has no f64 tile compare — dispatching would fail at compile time
+    instead of falling back), and the capability gate itself
+    (:mod:`evox_tpu.ops.pallas_gate`)."""
+    import os
+
+    min_pop = int(os.environ.get(min_pop_env, str(default_min_pop)))
+    if arr.ndim != ndim or arr.shape[0] < min_pop:
+        return False
+    if arr.dtype == jnp.float64 and jax.default_backend() == "tpu":
         return False
     from ...ops.pallas_gate import pallas_enabled
 
     return pallas_enabled()
 
 
+def _pallas_crowding_eligible(costs: jax.Array) -> bool:
+    """Route ``crowding_distance`` to the tiled neighbor kernel
+    (``ops/crowding.py``)?  Unlike the demoted dominance kernel, this one
+    targets an op XLA demonstrably loses on (the pop=50k NSGA-II
+    sort+scatter cliff), so the open gate alone dispatches it.  The
+    ``crowding_50k[_pallas]`` bench twins record whether it actually wins
+    per attachment."""
+    return _pallas_op_eligible(costs, 2, "EVOX_TPU_PALLAS_CROWDING_MIN_POP")
+
+
+def _pallas_topk_eligible(values: jax.Array) -> bool:
+    """Route the survivor-selection rank threshold to the masked top-k
+    rank-by-count kernel (``ops/topk.py``)?  Same gating shape as
+    crowding; the ``topk_50k[_pallas]`` bench twins record the verdict."""
+    return _pallas_op_eligible(values, 1, "EVOX_TPU_PALLAS_TOPK_MIN_POP")
+
+
 def crowding_distance(costs: jax.Array, mask: jax.Array | None = None) -> jax.Array:
     """NSGA-II crowding distance over the ``mask``-selected rows of ``costs``
     (n, m); boundary points get ``inf``, masked-out rows ``-inf``
-    (reference ``non_dominate.py:206-239``)."""
+    (reference ``non_dominate.py:206-239``).
+
+    This sort+scatter formulation is the XLA reference implementation;
+    above ``EVOX_TPU_PALLAS_CROWDING_MIN_POP`` rows with the Pallas gate
+    open, the sort-free tiled neighbor kernel
+    (:func:`evox_tpu.ops.crowding.crowding_distance_pallas`) dispatches
+    instead — bitwise-identical results, parity-pinned in
+    ``tests/test_pallas_kernels.py``."""
     n, m = costs.shape
+    if _pallas_crowding_eligible(costs):
+        from ...ops.crowding import crowding_distance_pallas
+
+        return crowding_distance_pallas(costs, mask)
     if mask is None:
         mask = jnp.ones((n,), dtype=bool)
         num_valid = n
@@ -252,7 +315,14 @@ def nd_environmental_selection(
     # after every real rank, and the boundary front/worst_rank are exact
     # because peeling always completes whole fronts.
     rank = non_dominate_rank(f, until_count=topk)
-    worst_rank = -jax.lax.top_k(-rank, topk)[0][-1]
+    if _pallas_topk_eligible(rank):
+        from ...ops.topk import masked_top_k
+
+        # k-th smallest rank via the rank-by-count kernel: the same
+        # value lax.top_k's bitonic sort returns, without the sort.
+        worst_rank = masked_top_k(rank, topk)[0][-1]
+    else:
+        worst_rank = -jax.lax.top_k(-rank, topk)[0][-1]
     mask = rank == worst_rank
     crowding_dis = crowding_distance(f, mask)
     combined_order = lexsort([-crowding_dis, rank])[:topk]

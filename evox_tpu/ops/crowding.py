@@ -1,0 +1,225 @@
+"""Pallas tiled crowding-distance kernel.
+
+NSGA-II's pop=50k cliff (6.7 gen/s, BASELINE.md) is survivor selection,
+and its crowding-distance step is one of the two ops XLA handles worst at
+that size: the reference formulation is ``m`` stable sorts plus two
+scatters over (n, m) — a lowering dominated by XLA's TPU sort (an
+O(n log² n) bitonic network of full-array HBM passes) and data-dependent
+scatter addressing that Mosaic handles but never tiles well.
+
+This kernel computes the same distances with **no sort and no scatter**:
+for each individual the per-objective crowding gap is
+``(next_above - next_below) / range`` where next-above/next-below are its
+lexicographic ``(value, index)`` neighbors — exactly the elements that sit
+beside it in the reference's stable sort, so the arithmetic (and the
+result, bitwise) is identical.  Finding the neighbors is an O(n²m) tiled
+reduction over (B, B) VPU compare tiles — the same shape the (demoted)
+dominance kernel tiles, trading asymptotic complexity for perfectly
+streaming, sort-free, scatter-free memory traffic.  Whether that trade
+wins at which ``n`` on real hardware is decided **empirically**: the
+``crowding_50k`` / ``crowding_50k_pallas`` bench twins exist so the next
+TPU sweep records the verdict (the same discipline that demoted the
+dominance kernel).
+
+The XLA reference implementation is
+:func:`evox_tpu.operators.selection.crowding_distance`; parity — bitwise,
+ties and masks included — is pinned by ``tests/test_pallas_kernels.py``,
+and dispatch is gated (:mod:`evox_tpu.ops.pallas_gate`) exactly like
+every Pallas kernel in this library.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["crowding_neighbors", "crowding_distance_pallas"]
+
+
+def _neighbor_kernel(
+    xi_ref,
+    xj_ref,
+    vj_ref,
+    below_ref,
+    above_ref,
+    has_below_ref,
+    has_above_ref,
+    *,
+    n_obj: int,
+    block: int,
+):
+    """One (i-tile, j-tile) step: fold the j tile's candidates into the i
+    tile's running lexicographic-neighbor accumulators.
+
+    ``xi_ref``/``xj_ref``: (m, B) objective columns; ``vj_ref``: (1, B)
+    validity of the j tile (float 0/1 — bools stay off the lane tiles);
+    ``below_ref``/``above_ref``: (m, B) running max-below / min-above per
+    objective over the NON-NaN candidates, accumulated across the
+    sequential j grid dimension; ``has_below_ref``/``has_above_ref``:
+    (m, B) float encodings of which neighbor KINDS exist.  The explicit
+    existence flags (rather than sentinel ``±inf`` values) are what keep
+    real ``±inf`` objective values exact: a row whose successor genuinely
+    IS ``+inf`` has a neighbor, and its gap must be the reference's
+    ``(inf - below)/rng`` arithmetic — not a fabricated boundary ``inf``.
+
+    NaN discipline (matching the reference's stable sort, where NaN rows
+    sort last with index tie-breaks): a NaN candidate cannot ride the
+    min/max value accumulators — one NaN would poison the whole
+    reduction even when a nearer finite neighbor exists — so the value
+    accumulators see only non-NaN candidates, and the flag encodings
+    carry the NaN side-channel:
+
+    * ``has_below``: max of ``2.0`` (a NaN predecessor exists — only
+      possible when the row itself is NaN, and then the TRUE predecessor
+      is that NaN), ``1.0`` (non-NaN predecessor), ``0.0`` (none).
+    * ``has_above``: max of ``1.0`` (a non-NaN successor exists — it is
+      nearer than any NaN), ``0.5`` (only NaN successors), ``0.0``
+      (none).
+
+    ``crowding_neighbors`` folds the encodings back into NaN neighbor
+    values + plain 0/1 existence flags.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        below_ref[...] = jnp.full_like(below_ref, -jnp.inf)
+        above_ref[...] = jnp.full_like(above_ref, jnp.inf)
+        has_below_ref[...] = jnp.zeros_like(has_below_ref)
+        has_above_ref[...] = jnp.zeros_like(has_above_ref)
+
+    # Global element ids of both tiles: the index component of the
+    # lexicographic (value, index) order — what makes ties deterministic
+    # and bitwise-equal to the reference's stable sort.
+    iota = jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)[:, 0]
+    ii = (i * block + iota)[:, None]  # (B, 1)
+    jj = (j * block + iota)[None, :]  # (1, B)
+    valid_j = vj_ref[0, :][None, :] > 0.0  # (1, B)
+
+    for k in range(n_obj):
+        a = xi_ref[k, :][:, None]  # (B, 1) i-tile values
+        b = xj_ref[k, :][None, :]  # (1, B) j-tile candidates
+        a_nan = jnp.isnan(a)
+        b_nan = jnp.isnan(b)
+        eq = (b == a) | (b_nan & a_nan)
+        prec = ((b < a) | (~b_nan & a_nan) | (eq & (jj < ii))) & valid_j
+        succ = ((b > a) | (b_nan & ~a_nan) | (eq & (jj > ii))) & valid_j
+        below = jnp.max(jnp.where(prec & ~b_nan, b, -jnp.inf), axis=1)
+        above = jnp.min(jnp.where(succ & ~b_nan, b, jnp.inf), axis=1)
+        below_ref[k, :] = jnp.maximum(below_ref[k, :], below)
+        above_ref[k, :] = jnp.minimum(above_ref[k, :], above)
+        has_below_ref[k, :] = jnp.maximum(
+            has_below_ref[k, :],
+            jnp.max(
+                jnp.where(prec, jnp.where(b_nan, 2.0, 1.0), 0.0), axis=1
+            ).astype(has_below_ref.dtype),
+        )
+        has_above_ref[k, :] = jnp.maximum(
+            has_above_ref[k, :],
+            jnp.max(
+                jnp.where(succ, jnp.where(b_nan, 0.5, 1.0), 0.0), axis=1
+            ).astype(has_above_ref.dtype),
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+def crowding_neighbors(
+    costs: jax.Array,
+    mask: jax.Array,
+    block_size: int = 512,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-objective lexicographic neighbor values of every row: returns
+    ``(below, above, has_below, has_above)`` of shape (n, m) — the
+    masked-stable-sort predecessor/successor values plus float-0/1
+    existence flags (the values alone cannot distinguish "no neighbor"
+    from a genuine ``±inf`` neighbor).  NaN objective values sort last
+    (index tie-breaks) exactly like the reference's stable sort, so a
+    row whose sort neighbor is a NaN row gets a NaN neighbor value."""
+    n, m = costs.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bs = min(block_size, n)
+    n_pad = -(-n // bs) * bs
+    # (m, n) layout: the population axis rides the 128-lane axis (the
+    # dominance kernel's layout).  Pad columns are invalid and carry +inf.
+    xt = jnp.pad(costs.T, ((0, 0), (0, n_pad - n)), constant_values=jnp.inf)
+    vt = jnp.pad(
+        mask.astype(costs.dtype)[None, :], ((0, 0), (0, n_pad - n))
+    )
+    i_tile = pl.BlockSpec((m, bs), lambda i, j: (0, i))
+    below, above, has_below, has_above = pl.pallas_call(
+        functools.partial(_neighbor_kernel, n_obj=m, block=bs),
+        grid=(n_pad // bs, n_pad // bs),
+        in_specs=[
+            i_tile,
+            pl.BlockSpec((m, bs), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bs), lambda i, j: (0, j)),
+        ],
+        out_specs=[i_tile, i_tile, i_tile, i_tile],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n_pad), costs.dtype),
+            jax.ShapeDtypeStruct((m, n_pad), costs.dtype),
+            jax.ShapeDtypeStruct((m, n_pad), jnp.float32),
+            jax.ShapeDtypeStruct((m, n_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xt, xt, vt)
+    below = below[:, :n].T
+    above = above[:, :n].T
+    has_below = has_below[:, :n].T
+    has_above = has_above[:, :n].T
+    # Fold the kernel's NaN side-channel encodings back into neighbor
+    # VALUES + plain 0/1 existence flags: a NaN predecessor (only
+    # possible for a NaN row — NaN sorts last) is the nearest one, so it
+    # wins; a NaN successor is the nearest only when no non-NaN
+    # successor exists.
+    nan = jnp.asarray(jnp.nan, costs.dtype)
+    below = jnp.where(has_below >= 2.0, nan, below)
+    above = jnp.where((has_above > 0.0) & (has_above < 1.0), nan, above)
+    return (
+        below,
+        above,
+        (has_below > 0.0).astype(jnp.float32),
+        (has_above > 0.0).astype(jnp.float32),
+    )
+
+
+def crowding_distance_pallas(
+    costs: jax.Array,
+    mask: jax.Array | None = None,
+    block_size: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Crowding distance via the tiled neighbor kernel — bitwise equal to
+    the XLA reference :func:`~evox_tpu.operators.selection.
+    crowding_distance` (boundary rows ``inf``, masked-out rows ``-inf``).
+    """
+    n, m = costs.shape
+    if mask is None:
+        mask = jnp.ones((n,), dtype=bool)
+    below, above, has_below, has_above = crowding_neighbors(
+        costs, mask, block_size=block_size, interpret=interpret
+    )
+    # Per-column valid range — the ends of the reference's sorted array.
+    # NaN-last ordering makes the two ends ASYMMETRIC: the top end
+    # (sorted[num_valid-1]) IS a NaN when any valid value is NaN (plain
+    # max propagates it), while the bottom end (sorted[0]) is the
+    # smallest non-NaN value (nanmin; all-NaN columns collapse to NaN).
+    mx = jnp.max(jnp.where(mask[:, None], costs, -jnp.inf), axis=0)
+    mn = jnp.nanmin(jnp.where(mask[:, None], costs, jnp.nan), axis=0)
+    rng = mx - mn
+    # Boundary = a MISSING neighbor (existence flags, not value
+    # sentinels): a row whose neighbor genuinely is ±inf takes the
+    # arithmetic path, reproducing the reference's (above-below)/rng —
+    # NaNs from inf-inf/inf included, bitwise.
+    boundary = (has_below <= 0.0) | (has_above <= 0.0)
+    gaps = jnp.where(
+        boundary, jnp.asarray(jnp.inf, costs.dtype), (above - below) / rng
+    )
+    gaps = jnp.where(mask[:, None], gaps, -jnp.inf)
+    return jnp.sum(gaps, axis=1)

@@ -1,11 +1,24 @@
-"""Pallas blocked dominance-matrix kernel.
+"""Pallas blocked dominance-matrix kernel.  **DEMOTED — opt-in only.**
 
 The O(n²m) dominance matrix is the hot spot of non-dominated sorting
 (SURVEY §2.3 ⚠ — reference ``operators/selection/non_dominate.py:6-26``
-computes it as a broadcasted (n, n, m) compare).  For pop ≥ ~4k, this kernel
-computes the (n, n) boolean matrix in (B, B) VMEM tiles, never materializing
-an (n, n, m) intermediate: objectives are laid out ``(m, n)`` so each tile
+computes it as a broadcasted (n, n, m) compare).  This kernel computes the
+(n, n) boolean matrix in (B, B) VMEM tiles, never materializing an
+(n, n, m) intermediate: objectives are laid out ``(m, n)`` so each tile
 compare is an unrolled loop of ``(B, 1) vs (1, B)`` VPU ops.
+
+**Verdict (recorded, not hoped):** on the measured NSGA-II bench the
+kernel *loses* to plain XLA — 69 vs 90 gen/s (BASELINE.md; the bit-packed
+broadcast rank path fuses better and streams less).  It is therefore OFF
+every default path: the general ``EVOX_TPU_PALLAS`` gate no longer
+dispatches it, and it engages only with the explicit
+``EVOX_TPU_PALLAS_DOMINANCE=1`` opt-in on top of the open gate (see
+``operators/selection/non_dominate.py::_pallas_kernel_eligible``).  The
+``nsga2_dtlz2_pallas`` bench twin keeps measuring the opt-in path so the
+next TPU sweep can re-litigate the verdict — no silent dead code.  Pallas
+effort is aimed instead at the ops where XLA demonstrably loses at the
+pop=50k cliff: the tiled crowding-distance kernel (``ops/crowding.py``)
+and the masked top-k rank-by-count kernel (``ops/topk.py``).
 
 Falls back to interpret mode off-TPU so tests exercise the same code path.
 """

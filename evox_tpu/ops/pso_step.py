@@ -34,6 +34,18 @@ twins; it is now the general, resilience-preserving
 batched monitor telemetry ride inside the scan).  The two compose: a
 ``PallasPSO`` step body is fused across generations by the segment scan
 exactly like the XLA step is.
+
+Likewise the bf16+rbg configuration this kernel was profiled against is
+no longer a hand-built bench recipe: it is the framework-wide numerics
+plane (``evox_tpu.precision`` — ``StdWorkflow(precision=
+PrecisionPolicy(), key_impl="rbg")``), which carries mapped state leaves
+in bf16 storage with f32 compute at one seam and makes the partitionable
+``rbg`` generator a first-class key implementation.  The XLA-path
+structure this kernel hand-fuses (two mega-fusions + unfused
+``rng-bit-generator`` ops) is exactly what that policy path lowers to;
+the ``pso_northstar_policy`` vs ``pso_northstar_pallas`` bench twins
+measure whether the in-kernel PRNG still pays on top of the policy.  See
+``docs/guide/precision.md``.
 """
 
 from __future__ import annotations

@@ -1,0 +1,157 @@
+"""Pallas masked top-k selection kernel (rank-by-count, sort-free).
+
+The second op XLA handles poorly at the NSGA-II pop=50k cliff is top-k
+selection over large ``k``: ``lax.top_k`` / ``sort`` lower to a bitonic
+network of O(log² n) full-array HBM passes on TPU.  This kernel selects
+the ``k`` lexicographically smallest ``(value, index)`` elements with **no
+sort**: a tiled O(n²) count kernel computes every element's exact rank
+(``rank_i = #{j : (v_j, j) < (v_i, i)}`` — a strict total order, so ranks
+are a permutation), and the selected elements scatter straight to their
+output positions (``out[rank_i] = i`` for ``rank_i < k``).  The count tile
+is the same (B, B) VPU compare shape the dominance kernel tiles; whether
+counting beats sorting at which ``n`` is decided empirically by the
+``topk_50k`` / ``topk_50k_pallas`` bench twins on the next TPU sweep —
+the same record-the-verdict discipline that demoted the dominance kernel.
+
+Masked rows are excluded by treating them as ``(+inf, index)`` — they rank
+after every valid element and are only selected when fewer than ``k``
+valid rows exist, exactly matching the XLA reference's stable argsort of
+the masked array.  NaN values rank after everything (``+inf`` and masked
+rows included) with index tie-breaks among themselves — the same NaN-last
+placement ``jnp.argsort`` gives, so unquarantined non-finite fitness
+cannot flip the selection between the gated and ungated paths.  Parity (bitwise, ties and masks included) is pinned by
+``tests/test_pallas_kernels.py``; dispatch is gated
+(:mod:`evox_tpu.ops.pallas_gate`) like every Pallas kernel here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["lex_rank", "masked_top_k", "masked_top_k_xla"]
+
+
+def _big(dtype) -> jax.Array:
+    """The largest representable value of ``dtype`` — the rank-last fill
+    for masked rows (``+inf`` for floats; integer inputs — NSGA-II ranks
+    — use the dtype max, with the index tie-break keeping the order
+    strict).  One definition for the kernel and the XLA reference, so
+    their masked semantics can never diverge."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+
+
+def _pad_fill(dtype) -> jax.Array:
+    """The fill for the kernel's tile-alignment PAD columns — strictly
+    rank-last under the NaN-aware total order.  For floats that is NaN
+    (the order's maximum: real NaN rows must still rank BEFORE pads,
+    which a ``+inf`` pad would jump ahead of), resolved against real NaN
+    rows by the pad's larger index; integers reuse the dtype max."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.nan, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+
+
+def _rank_kernel(xi_ref, xj_ref, out_ref, *, block: int):
+    """One (i-tile, j-tile) step: add the j tile's contribution to each
+    i-tile element's lexicographic rank count."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)[:, 0]
+    ii = (i * block + iota)[:, None]  # (B, 1) global ids of the i tile
+    jj = (j * block + iota)[None, :]  # (1, B) global ids of the j tile
+    a = xi_ref[0, :][:, None]  # (B, 1)
+    b = xj_ref[0, :][None, :]  # (1, B)
+    # NaN-aware total order matching the reference's stable argsort: NaN
+    # ranks after EVERYTHING (+inf included), all NaNs tie with each
+    # other (stable → resolved by index).  Plain `<`/`==` are all-false
+    # around NaN, which would hand every NaN element rank 0 and clobber
+    # the true minimum's scatter slot.  On integer inputs isnan folds to
+    # constant-false and this is exactly the plain comparison.
+    a_nan = jnp.isnan(a)
+    b_nan = jnp.isnan(b)
+    eq = (b == a) | (b_nan & a_nan)
+    less = (b < a) | (~b_nan & a_nan) | (eq & (jj < ii))
+    out_ref[0, :] += jnp.sum(less.astype(jnp.int32), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+def lex_rank(
+    values: jax.Array, block_size: int = 512, interpret: bool | None = None
+) -> jax.Array:
+    """Exact rank of every element under the strict lexicographic
+    ``(value, index)`` order — a permutation of ``arange(n)`` (stable-sort
+    positions), computed by tiled counting instead of sorting."""
+    (n,) = values.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bs = min(block_size, n)
+    n_pad = -(-n // bs) * bs
+    # Pad candidates rank-last (indices >= n): under the NaN-aware order
+    # the pad fill is the order's maximum, and a tie against a real
+    # rank-last value loses on the larger pad index — so pads contribute
+    # no counts to any real row.
+    xt = jnp.pad(values[None, :], ((0, 0), (0, n_pad - n))).at[
+        :, n:
+    ].set(_pad_fill(values.dtype))
+    ranks = pl.pallas_call(
+        functools.partial(_rank_kernel, block=bs),
+        grid=(n_pad // bs, n_pad // bs),
+        in_specs=[
+            pl.BlockSpec((1, bs), lambda i, j: (0, i)),
+            pl.BlockSpec((1, bs), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, bs), lambda i, j: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+        interpret=interpret,
+    )(xt, xt)
+    return ranks[0, :n]
+
+
+def masked_top_k_xla(
+    values: jax.Array, k: int, mask: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array]:
+    """XLA reference: the ``k`` smallest ``(value, index)`` elements of
+    ``values`` with masked rows excluded (ascending; deterministic index
+    tie-break via stable sort).  Returns ``(values_k, indices_k)``."""
+    (n,) = values.shape
+    if mask is not None:
+        values = jnp.where(mask, values, _big(values.dtype))
+    order = jnp.argsort(values, stable=True)[:k]
+    return values[order], order.astype(jnp.int32)
+
+
+def masked_top_k(
+    values: jax.Array,
+    k: int,
+    mask: jax.Array | None = None,
+    block_size: int = 512,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Masked top-k via the rank-by-count kernel — bitwise equal to
+    :func:`masked_top_k_xla` (which is also the shape/semantics contract).
+    """
+    (n,) = values.shape
+    if not 0 < k <= n:
+        raise ValueError(f"k must be in 1..{n}, got {k}")
+    if mask is not None:
+        values = jnp.where(mask, values, _big(values.dtype))
+    ranks = lex_rank(values, block_size=block_size, interpret=interpret)
+    # Ranks are a permutation, so the k selected elements scatter to
+    # distinct output slots; everything ranked >= k drops.
+    idx = (
+        jnp.zeros((k,), jnp.int32)
+        .at[jnp.where(ranks < k, ranks, k)]
+        .set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    )
+    return values[idx], idx

@@ -196,6 +196,7 @@ def bootstrap_fleet(
     auto: bool = False,
     cpu_collectives: str = "gloo",
     initialization_timeout: float | None = None,
+    key_impl: str | None = None,
 ) -> FleetTopology:
     """Join (or skip joining) the fleet's process group.  Call once per
     worker process, BEFORE any other JAX API.
@@ -227,8 +228,34 @@ def bootstrap_fleet(
     double-init; a resumed worker calling through a shared main() must not
     die for it).
 
+    :param key_impl: optional fleet-wide PRNG key implementation
+        (``"rbg"`` for the partitionable hardware generator; defaults to
+        the shared ``EVOX_TPU_KEY_IMPL`` env contract when set) — applied
+        as the process's default impl before the backend initializes, so
+        every host of the fleet derives identical streams.  See
+        ``evox_tpu.precision`` / ``docs/guide/precision.md``.
+
     :returns: the :class:`FleetTopology` this process now belongs to.
     """
+    # Fleet-wide PRNG implementation (explicit arg, or the shared
+    # EVOX_TPU_KEY_IMPL env contract): set as the process default BEFORE
+    # the backend exists, so every `jax.random.key(seed)` in worker code
+    # — workflow setup, identity-keyed tenant streams, GL006 per-slot
+    # folds — lands on the same generator on every host.  A fleet whose
+    # hosts disagree on the impl would trace different programs (key-data
+    # shapes differ) and deadlock its collectives; one knob, one place.
+    if key_impl is not None or os.environ.get("EVOX_TPU_KEY_IMPL"):
+        from ..precision import resolve_key_impl
+
+        resolved = resolve_key_impl(key_impl)
+        jax.config.update("jax_default_prng_impl", resolved)
+        # Publish the resolved impl into the shared env contract too:
+        # `resolve_key_impl`/`make_key`/`coerce_key` (workflow setup,
+        # identity-keyed tenant streams, per-slot folds) consult
+        # EVOX_TPU_KEY_IMPL, not jax's config — without this, an explicit
+        # key_impl= argument would flip raw jax.random.key() calls but
+        # silently leave every library-constructed key on the default.
+        os.environ["EVOX_TPU_KEY_IMPL"] = resolved
     # An empty coordinator string means "no coordinator" — it is how a
     # FleetSupervisor spells the degenerate single-worker attempt in the
     # environment contract (env vars cannot carry None).

@@ -1,0 +1,64 @@
+"""Framework-wide numerics plane: mixed-precision storage policies and
+first-class partitionable PRNG key implementations.
+
+The single biggest *measured* raw-speed lever on the north-star PSO bench
+(100k x 1000) is not arithmetic — it is bytes and random bits: bf16 state
+plus the hardware ``rbg`` generator runs +75% over f32/Threefry, while
+bf16 alone is *slower* (BASELINE.md; random-bit generation is the
+bottleneck).  Until this package, that win existed only as two hand-built
+bench configs; now it is a policy every workflow, runner, service tenant
+and HPO nest can opt into:
+
+* :class:`PrecisionPolicy` — bf16/fp16 **storage** leaves with f32
+  **compute/reductions**, applied per algorithm through a declarative
+  per-leaf dtype map (``Algorithm.storage_leaves``).  The one
+  ``promote``/``demote`` seam lives in ``StdWorkflow._step``, so the fused
+  segment scan's carry stays in storage dtype (HBM traffic halves) while
+  every generation's math runs in the compute dtype.
+* :func:`make_key` / :func:`resolve_key_impl` / :func:`coerce_key` — the
+  ``key_impl`` knob (``"threefry2x32"`` default, ``"rbg"`` the
+  partitionable hardware generator) plumbed through workflow, runner,
+  service, and ``bootstrap_fleet``.  ``rbg`` keys compose with the GL006
+  topology-invariant ``fold_in`` contract and the service's identity-keyed
+  tenant streams: runs are self-consistent per impl (fused==debug,
+  solo==packed, resume==uninterrupted), and cross-impl divergence is
+  documented and gated, never accidental.
+* :func:`check_precision` — the checkpoint-manifest guard: a bf16
+  checkpoint refuses to silently load as f32 and vice versa
+  (:class:`~evox_tpu.utils.checkpoint.CheckpointError`, remesh-style).
+
+Policy identity is folded into ``TenantSpec.bucket_key``, checkpoint
+manifests, and the persistent executable-cache signature, so two runs
+differing only in numerics can never share a compiled program, a bucket,
+or a resume point by accident.  See ``docs/guide/precision.md``.
+"""
+
+from .policy import (
+    DEFAULT_PRECISION_TAG,
+    PrecisionPolicy,
+    check_precision,
+    precision_identity,
+    precision_tag,
+)
+from .prng import (
+    KEY_IMPLS,
+    coerce_key,
+    key_impl_name,
+    make_key,
+    state_key_impl,
+    resolve_key_impl,
+)
+
+__all__ = [
+    "PrecisionPolicy",
+    "precision_identity",
+    "precision_tag",
+    "check_precision",
+    "DEFAULT_PRECISION_TAG",
+    "KEY_IMPLS",
+    "make_key",
+    "coerce_key",
+    "key_impl_name",
+    "state_key_impl",
+    "resolve_key_impl",
+]

@@ -1,0 +1,252 @@
+"""Mixed-precision storage policy.
+
+The policy model separates two dtypes:
+
+* **storage** — what a state leaf is *carried* as between generations:
+  the dtype of the fused segment scan's carry, of checkpoint archives, and
+  of the HBM-resident state on the per-step path.  ``bfloat16`` halves the
+  bytes of every mapped leaf.
+* **compute** — what one generation's math runs in.  The workflow's step
+  seam promotes mapped leaves to the compute dtype on entry and demotes
+  them back on exit, so reductions, best-fold comparisons and the
+  algorithm's update arithmetic never accumulate in the narrow type.
+
+Which leaves are mapped is **per-algorithm and declarative**: an algorithm
+opts in by declaring ``storage_leaves`` — a tuple of state-leaf names (or
+a ``{name: dtype}`` map for per-leaf overrides) naming the
+population-sized buffers that are safe to narrow.  Small accumulating
+leaves (a CMA-ES covariance, an Adam moment) stay out of the map and keep
+full precision.  Applying a policy to an algorithm with no declaration
+raises — narrowing state a class author never audited is how convergence
+silently degrades.
+
+Identity discipline: :func:`precision_identity` (a hashable tuple) rides
+in ``TenantSpec.bucket_key`` and the executable-cache signature;
+:func:`precision_tag` (a string) rides in checkpoint manifests, where
+:func:`check_precision` enforces the no-silent-crossing rule
+(``CheckpointError``, structured like the remesh topology guard).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "PrecisionPolicy",
+    "precision_identity",
+    "precision_tag",
+    "check_precision",
+    "DEFAULT_PRECISION_TAG",
+]
+
+# The tag an archive without a precision entry (or a policy-less run) is
+# treated as: full-precision storage, identical compute.
+DEFAULT_PRECISION_TAG = "storage=float32,compute=float32"
+
+_STORAGE_DTYPES = ("bfloat16", "float16", "float32")
+_COMPUTE_DTYPES = ("float32", "float64")
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """Declarative mixed-precision policy: ``storage`` dtype for the
+    algorithm's mapped state leaves, ``compute`` dtype for the step's math.
+
+    :param storage: dtype name the mapped leaves are carried as between
+        generations (``"bfloat16"`` — the TPU-native narrow type — or
+        ``"float16"``; ``"float32"`` makes the policy an identity).
+    :param compute: dtype name one generation's arithmetic runs in
+        (``"float32"`` default; reductions and best-folds happen here).
+    :param leaves: optional explicit per-leaf map overriding the
+        algorithm's ``storage_leaves`` declaration — a tuple of leaf
+        names (all stored as ``storage``) or a ``{name: dtype}`` mapping.
+        Leave ``None`` to use the algorithm's own declaration (the normal,
+        author-audited path).
+    """
+
+    storage: str = "bfloat16"
+    compute: str = "float32"
+    leaves: tuple = None  # tuple[str, ...] | tuple[tuple[str, str], ...]
+
+    def __post_init__(self) -> None:
+        if self.storage not in _STORAGE_DTYPES:
+            raise ValueError(
+                f"storage must be one of {_STORAGE_DTYPES}, got "
+                f"{self.storage!r}"
+            )
+        if self.compute not in _COMPUTE_DTYPES:
+            raise ValueError(
+                f"compute must be one of {_COMPUTE_DTYPES}, got "
+                f"{self.compute!r}"
+            )
+        if self.leaves is not None:
+            # Normalize {name: dtype} / iterables to a canonical, hashable
+            # sorted tuple of (name, dtype) pairs so policy identity (and
+            # therefore bucket keys) never depends on declaration order.
+            if isinstance(self.leaves, Mapping):
+                pairs = tuple(
+                    sorted((str(k), str(v)) for k, v in self.leaves.items())
+                )
+            else:
+                pairs = tuple(
+                    sorted(
+                        (str(leaf), self.storage)
+                        if isinstance(leaf, str)
+                        else (str(leaf[0]), str(leaf[1]))
+                        for leaf in self.leaves
+                    )
+                )
+            for _, dt in pairs:
+                if dt not in _STORAGE_DTYPES:
+                    raise ValueError(
+                        f"per-leaf storage dtype must be one of "
+                        f"{_STORAGE_DTYPES}, got {dt!r}"
+                    )
+            object.__setattr__(self, "leaves", pairs)
+
+    # -- dtype handles ------------------------------------------------------
+    @property
+    def storage_dtype(self):
+        return jnp.dtype(self.storage)
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.compute)
+
+    # -- per-algorithm leaf map --------------------------------------------
+    def leaf_map(self, algorithm: Any) -> dict[str, Any]:
+        """The ``{leaf_name: storage_dtype}`` map this policy applies to
+        ``algorithm``'s state.  Explicit ``leaves`` win; otherwise the
+        algorithm's declarative ``storage_leaves`` attribute is consulted.
+        Raises ``TypeError`` when neither exists — precision is opt-in per
+        algorithm, never inferred."""
+        if self.leaves is not None:
+            return {name: jnp.dtype(dt) for name, dt in self.leaves}
+        declared = getattr(algorithm, "storage_leaves", None)
+        if declared is None:
+            raise TypeError(
+                f"{type(algorithm).__name__} declares no `storage_leaves` "
+                f"map, so a PrecisionPolicy cannot be applied to it: narrow "
+                f"storage is opt-in per algorithm (declare the class "
+                f"attribute naming the population-sized leaves that are "
+                f"safe to store narrow, or pass PrecisionPolicy(leaves=...) "
+                f"to override explicitly)"
+            )
+        if isinstance(declared, Mapping):
+            return {str(k): jnp.dtype(str(v)) for k, v in declared.items()}
+        return {str(name): self.storage_dtype for name in declared}
+
+    def validate_state(self, algo_state: Any, leaf_map: Mapping[str, Any]) -> None:
+        """Refuse a map naming leaves the state does not have.  A typo'd
+        entry (``PrecisionPolicy(leaves=("velocty",))``) or a stale
+        ``storage_leaves`` declaration would otherwise be a silent no-op:
+        the run executes at full precision while its bucket key,
+        exec-cache signature, and checkpoint manifest all record the
+        narrow policy — a mislabeled measurement, the exact failure class
+        this plane's loud guards exist to prevent."""
+        missing = sorted(set(leaf_map) - set(algo_state))
+        if missing:
+            raise ValueError(
+                f"PrecisionPolicy maps state leaves {missing} that do not "
+                f"exist in the algorithm state (leaves: "
+                f"{sorted(algo_state)}): a misnamed entry would silently "
+                f"run at full precision under a narrow-policy identity — "
+                f"fix the leaves= map or the storage_leaves declaration"
+            )
+
+    # -- the cast seam ------------------------------------------------------
+    def _cast(self, state: Any, target_of) -> Any:
+        """Cast mapped leaves of a flat algorithm ``State`` via
+        ``target_of(leaf_name) -> dtype | None`` (None = leave alone).
+        PRNG keys and non-floating leaves are never touched."""
+        updates = {}
+        for name in state:
+            dtype = target_of(name)
+            if dtype is None:
+                continue
+            leaf = state[name]
+            if not isinstance(leaf, jax.Array) and not hasattr(leaf, "dtype"):
+                continue
+            if jax.dtypes.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+                continue
+            if not jnp.issubdtype(leaf.dtype, jnp.floating):
+                continue
+            if leaf.dtype != dtype:
+                updates[name] = leaf.astype(dtype)
+        return state.replace(**updates) if updates else state
+
+    def demote(self, algo_state: Any, leaf_map: Mapping[str, Any]) -> Any:
+        """Storage form: mapped leaves narrowed to their storage dtype —
+        the dtype the scan carry, checkpoints, and HBM-resident state
+        hold between generations."""
+        return self._cast(algo_state, leaf_map.get)
+
+    def promote(self, algo_state: Any, leaf_map: Mapping[str, Any]) -> Any:
+        """Compute form: mapped leaves widened to the compute dtype for
+        one generation's math."""
+        compute = self.compute_dtype
+        return self._cast(
+            algo_state, lambda name: compute if name in leaf_map else None
+        )
+
+    # -- identity -----------------------------------------------------------
+    def identity(self) -> tuple:
+        """Hashable identity of this policy — what bucket keys and the
+        executable-cache signature fold in."""
+        return ("precision", self.storage, self.compute, self.leaves)
+
+    def tag(self) -> str:
+        """Manifest form of the identity (human-greppable string)."""
+        base = f"storage={self.storage},compute={self.compute}"
+        if self.leaves is not None:
+            base += ",leaves=" + ";".join(f"{n}:{d}" for n, d in self.leaves)
+        return base
+
+
+def precision_identity(policy: PrecisionPolicy | None) -> tuple:
+    """Bucket-key / cache-signature identity, total over ``None`` (the
+    policy-less default is full precision)."""
+    if policy is None:
+        return ("precision", "float32", "float32", None)
+    return policy.identity()
+
+
+def precision_tag(policy: PrecisionPolicy | None) -> str:
+    """Checkpoint-manifest tag, total over ``None``."""
+    return DEFAULT_PRECISION_TAG if policy is None else policy.tag()
+
+
+def check_precision(
+    manifest_tag: str | None,
+    policy: PrecisionPolicy | None,
+    *,
+    context: str = "checkpoint",
+) -> None:
+    """The manifest guard: refuse to load a checkpoint across a precision
+    boundary.  ``manifest_tag`` is the archive's recorded ``precision``
+    entry (``None`` for archives predating the plane — treated as full
+    precision, exactly what a policy-less writer produced); ``policy`` is
+    what the loading run is configured with.
+
+    Raises :class:`~evox_tpu.utils.checkpoint.CheckpointError` on any
+    mismatch — a bf16 archive silently widened into an f32 run (or an f32
+    archive silently narrowed into a bf16 run) would *load cleanly* under
+    the generic same-kind dtype casting and corrupt the run's numerics
+    story instead of failing loudly, the same class of bug the remesh
+    topology guard exists for."""
+    from ..utils.checkpoint import CheckpointError
+
+    recorded = manifest_tag if manifest_tag else DEFAULT_PRECISION_TAG
+    expected = precision_tag(policy)
+    if recorded != expected:
+        raise CheckpointError(
+            f"{context}: precision policy mismatch — the archive was "
+            f"written under [{recorded}] but this run is configured for "
+            f"[{expected}]. A checkpoint never crosses a precision "
+            f"boundary silently: load it with the matching "
+            f"PrecisionPolicy, or re-seed the run."
+        )

@@ -1,0 +1,149 @@
+"""First-class PRNG key implementations (the ``key_impl`` knob).
+
+JAX's default Threefry generator derives every random word through a long
+per-word ALU chain on the VPU; at north-star shapes (2 x pop x dim ~= 200M
+words per PSO generation) that chain — not the swarm arithmetic — is the
+step's bottleneck (BASELINE.md: bf16+rbg 242 gen/s vs 138 f32/threefry,
+while bf16 alone is *slower*).  The ``rbg`` implementation uses the TPU's
+hardware random-bit generator and is **partitionable**: under ``vmap`` /
+``shard_map`` the per-lane draws need no per-word key derivation, which is
+exactly why it is the sharding-friendly choice.
+
+The trade, stated once and gated by tests rather than discovered in
+production:
+
+* **Within one impl, determinism is full-strength.**  ``fold_in`` /
+  ``split`` are defined for every impl, so the GL006 topology-invariant
+  folding contract and the service's identity-keyed tenant streams hold
+  unchanged: fused == debug, solo == packed, resume == uninterrupted —
+  bit-identical per impl (``tests/test_precision.py`` pins the matrix).
+* **Across impls, streams differ by construction.**  A threefry run and an
+  rbg run of the same seed draw different numbers; that divergence is
+  documented here and *gated* — checkpoint manifests record the key impl,
+  bucket keys split on it, and :func:`coerce_key` makes any cross-impl
+  key handoff an explicit, deterministic re-seeding instead of a silent
+  reinterpretation.
+
+``resolve_key_impl`` honors the ``EVOX_TPU_KEY_IMPL`` environment variable
+so a whole fleet can be flipped without touching call sites
+(:func:`~evox_tpu.parallel.bootstrap_fleet` plumbs the same knob
+process-wide).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "KEY_IMPLS",
+    "resolve_key_impl",
+    "make_key",
+    "coerce_key",
+    "key_impl_name",
+    "state_key_impl",
+]
+
+# The built-in jax implementations this library supports.  "rbg" is the
+# partitionable hardware generator; "unsafe_rbg" additionally relaxes
+# fold_in/split derivation quality for maximum throughput (only for runs
+# that never rely on derived-stream independence).
+KEY_IMPLS = ("threefry2x32", "rbg", "unsafe_rbg")
+
+DEFAULT_KEY_IMPL = "threefry2x32"
+
+_ENV_KEY_IMPL = "EVOX_TPU_KEY_IMPL"
+
+
+def resolve_key_impl(impl: str | None) -> str:
+    """Canonical impl name for a knob value: explicit argument first, then
+    the ``EVOX_TPU_KEY_IMPL`` environment variable, then the library
+    default (Threefry — bit-compatible with every pre-plane run)."""
+    name = impl or os.environ.get(_ENV_KEY_IMPL) or DEFAULT_KEY_IMPL
+    if name not in KEY_IMPLS:
+        raise ValueError(
+            f"unknown PRNG key impl {name!r}; expected one of {KEY_IMPLS}"
+        )
+    return name
+
+
+def make_key(seed: int, impl: str | None = None) -> jax.Array:
+    """A typed PRNG key of the resolved implementation — the one
+    constructor every key-creating seam in the library routes through."""
+    return jax.random.key(int(seed), impl=resolve_key_impl(impl))
+
+
+def key_impl_name(key: jax.Array) -> str:
+    """The implementation name of a typed key (``"threefry2x32"`` /
+    ``"rbg"`` / ...), robust across jax's PRNGSpec repr variants."""
+    spec = jax.random.key_impl(key)
+    name = getattr(spec, "name", None)
+    if isinstance(name, str) and name:
+        return name
+    # PRNGSpec.__repr__ is the stable public surface on jax 0.4.x
+    # (repr(spec) == "'rbg'"); strip the quoting.
+    return re.sub(r"""^['"]|['"]$""", "", repr(spec))
+
+
+def state_key_impl(state) -> str | None:
+    """The key implementation a state pytree ACTUALLY carries — the impl
+    of its first typed PRNG leaf (tree order), or ``None`` when no typed
+    key leaf exists.  This is what checkpoint manifests must record: a
+    knob-less workflow (``key_impl=None``, pass-through semantics) can
+    legitimately run on whatever impl the caller's key was, and recording
+    the resolved *default* there would make the cross-impl resume guard
+    fire falsely on exactly those archives."""
+    for leaf in jax.tree_util.tree_leaves(state):
+        if jax.dtypes.issubdtype(
+            getattr(leaf, "dtype", None), jax.dtypes.prng_key
+        ):
+            return key_impl_name(leaf)
+    return None
+
+
+def coerce_key(key_or_seed, impl: str | None = None) -> jax.Array:
+    """Deterministically produce a key of the requested implementation.
+
+    * an ``int`` seed builds a fresh key of the impl;
+    * a key already of the impl passes through unchanged (the common case
+      — zero-cost when callers already agree);
+    * a key of a *different* impl is re-seeded by folding its raw key-data
+      words, in order, into a zero key of the target impl — deterministic
+      and total, so template-building code paths (restart rebuilds,
+      service resume templates) can hand any key to a workflow with a
+      pinned ``key_impl`` and always land on the same stream.
+
+    The cross-impl branch is an explicit re-seeding, not a
+    reinterpretation: there is no meaning-preserving conversion between
+    generators, and pretending otherwise is how cross-impl divergence
+    becomes accidental instead of documented."""
+    target = resolve_key_impl(impl)
+    if not isinstance(key_or_seed, jax.Array) or not jax.dtypes.issubdtype(
+        getattr(key_or_seed, "dtype", None), jax.dtypes.prng_key
+    ):
+        if getattr(key_or_seed, "ndim", 0):
+            # A legacy RAW key array (`jax.random.PRNGKey(0)`): pre-plane
+            # code accepted these everywhere, so wrap the bits back into
+            # a typed key and fall through to the normal cross-impl
+            # handling instead of dying in `int()` of a length-2 array.
+            # Raw buffers carry no impl tag, and wrap_key_data's default
+            # follows the PROCESS default impl — which bootstrap_fleet
+            # may have flipped — so dispatch on the trailing word count
+            # instead: threefry raw keys are (2,) uint32, rbg-family
+            # (4,).  Deterministic either way; the fold below only
+            # consumes the bits.
+            raw = jnp.asarray(key_or_seed, jnp.uint32)
+            key_or_seed = jax.random.wrap_key_data(
+                raw, impl="threefry2x32" if raw.shape[-1] == 2 else "rbg"
+            )
+        else:
+            return make_key(int(key_or_seed), target)
+    if key_impl_name(key_or_seed) == target:
+        return key_or_seed
+    out = jax.random.key(0, impl=target)
+    for word in jnp.ravel(jax.random.key_data(key_or_seed)):
+        out = jax.random.fold_in(out, word.astype(jnp.uint32))
+    return out

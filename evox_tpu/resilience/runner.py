@@ -1061,7 +1061,7 @@ class ResilientRunner:
     def _ckpt_path(self, generation: int) -> Path:
         return self.checkpoint_dir / f"ckpt_{generation:08d}.npz"
 
-    def _manifest_extras(self, probed: bool) -> dict:
+    def _manifest_extras(self, probed: bool, state: State | None = None) -> dict:
         """Topology + health/restart context riding in the checkpoint
         manifest so a resumed run replays decisions exactly:
 
@@ -1079,6 +1079,24 @@ class ResilientRunner:
         extras: dict = {
             "topology": workflow_topology(self.workflow).to_manifest()
         }
+        # Numerics identity: the precision-policy tag and key impl the
+        # workflow runs under ride in every manifest, so resume (and the
+        # service's readmission scan) can refuse a cross-policy or
+        # cross-impl load BEFORE restoring a single leaf — the remesh
+        # discipline, applied to dtypes and PRNG streams.
+        from ..precision import precision_tag
+
+        extras["precision"] = precision_tag(
+            getattr(self.workflow, "precision", None)
+        )
+        # The impl the state ACTUALLY carries (a knob-less workflow runs
+        # pass-through on whatever impl the caller's key was; recording
+        # the resolved default there would make the resume guard fire
+        # falsely on those archives).  The knob-resolved fallback covers
+        # key-leaf-less states — and still records an env-selected
+        # generator (EVOX_TPU_KEY_IMPL) rather than leaving the guard
+        # vacuous exactly when the knob was set fleet-wide.
+        extras["key_impl"] = self._observed_key_impl(state)
         if self.health is not None:
             extras.update(
                 restarts=[e.to_manifest() for e in self.stats.restarts],
@@ -1086,6 +1104,19 @@ class ResilientRunner:
                 health_probed=bool(probed),
             )
         return extras
+
+    def _observed_key_impl(self, state: State | None) -> str:
+        """The PRNG impl name this run's numerics identity records: the
+        impl of ``state``'s typed key leaves when it has any, else the
+        workflow knob resolved through the env contract.  ONE definition
+        for the manifest write side and the resume guard's expectation,
+        so they can never disagree about a pass-through-keyed run."""
+        from ..precision import resolve_key_impl, state_key_impl
+
+        observed = None if state is None else state_key_impl(state)
+        return observed or resolve_key_impl(
+            getattr(self.workflow, "key_impl", None)
+        )
 
     def _note_write_failure(self, path, exc: BaseException) -> None:
         """A checkpoint write failed (disk full, injected chaos, ...): the
@@ -1196,7 +1227,7 @@ class ResilientRunner:
             return True
         self.checkpoint_dir.mkdir(parents=True, exist_ok=True)
         path = self._ckpt_path(generation)
-        metadata = self._manifest_extras(probed)
+        metadata = self._manifest_extras(probed, state)
         if extra_metadata:
             metadata.update(extra_metadata)
         t0 = time.perf_counter()
@@ -1408,6 +1439,8 @@ class ResilientRunner:
                     candidate_template,
                     allow_missing=True,
                     verify=self.verify_resume == "manifest",
+                    precision=getattr(self.workflow, "precision", None),
+                    key_impl=self._observed_key_impl(candidate_template),
                 )
             except FileNotFoundError:
                 self._skip_candidate(
@@ -1705,7 +1738,13 @@ class ResilientRunner:
         path = self._ckpt_path(generation)
         if path.exists():
             try:
-                return load_state(path, state, verify=self.verify_resume)
+                return load_state(
+                    path,
+                    state,
+                    verify=self.verify_resume,
+                    precision=getattr(self.workflow, "precision", None),
+                    key_impl=self._observed_key_impl(state),
+                )
             except (CheckpointError, ValueError) as e:  # pragma: no cover
                 self._event(
                     f"retry reload of {path.name} failed ({e}); "

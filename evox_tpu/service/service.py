@@ -308,7 +308,10 @@ class OptimizationService:
         # path would otherwise pay it on every rollback.
         self._templates: dict[tuple, State] = {}
         self._next_uid = 0
-        self._base_key = jax.random.key(self.seed)
+        # Identity-stream roots, one per PRNG key implementation actually
+        # used by a tenant (lazily built in _tenant_key; all derive from
+        # the same seed).
+        self._base_keys: dict[str, jax.Array] = {}
 
     # -- events -------------------------------------------------------------
     def _event(
@@ -605,12 +608,18 @@ class OptimizationService:
     ) -> None:
         ns = self.namespace(record.spec.tenant_id)
         ns.mkdir(parents=True, exist_ok=True)
+        from ..precision import precision_tag, resolve_key_impl
+
         metadata: dict[str, Any] = {
             "tenant_id": record.spec.tenant_id,
             "uid": record.uid,
             "tenant_status": record.status.value,
             "tenant_restarts": record.restarts,
             "lane_health_window": list(self.health.lane_window(record.uid)),
+            # Numerics identity (remesh-style guard): readmission refuses
+            # a cross-policy / cross-impl resume before touching a leaf.
+            "precision": precision_tag(record.spec.precision),
+            "key_impl": resolve_key_impl(record.spec.key_impl),
         }
         if emergency:
             metadata.update(
@@ -643,10 +652,21 @@ class OptimizationService:
         )
 
     # -- tenant state construction -------------------------------------------
-    def _tenant_key(self, uid: int) -> jax.Array:
+    def _tenant_key(self, uid: int, key_impl: str | None = None) -> jax.Array:
         # Identity-keyed stream: stable across lanes, packs, and
-        # readmissions (the GL006 discipline, applied to tenants).
-        return jax.random.fold_in(self._base_key, jnp.uint32(uid))
+        # readmissions (the GL006 discipline, applied to tenants).  One
+        # base key per PRNG implementation, derived from the SAME seed:
+        # an rbg tenant's stream is a function of (seed, impl, uid) only
+        # — never of which cotenants or lanes exist — so an rbg tenant
+        # beside a threefry tenant finishes bit-identical to the same
+        # tenant solo in either impl.
+        from ..precision import make_key, resolve_key_impl
+
+        impl = resolve_key_impl(key_impl)
+        base = self._base_keys.get(impl)
+        if base is None:
+            base = self._base_keys[impl] = make_key(self.seed, impl)
+        return jax.random.fold_in(base, jnp.uint32(uid))
 
     def _fresh_state(self, bucket: _Bucket, record: TenantRecord) -> State:
         """A tenant's pre-init state, built exactly like
@@ -655,17 +675,21 @@ class OptimizationService:
         ``fault_lane`` chaos leaf."""
         wf = bucket.workflow
         algo_key, prob_key, mon_key = jax.random.split(
-            self._tenant_key(record.uid), 3
+            self._tenant_key(record.uid, record.spec.key_impl), 3
         )
         mon_state = wf.monitor.setup(mon_key)
         if "instance_id" in mon_state:
             mon_state = mon_state.replace(
                 instance_id=jnp.asarray(record.uid, jnp.int32)
             )
-        state = State(
-            algorithm=wf.algorithm.setup(algo_key),
-            problem=wf.problem.setup(prob_key),
-            monitor=mon_state,
+        # apply_precision: the storage form (narrow mapped leaves) —
+        # exactly the layout wf.setup() would have produced.
+        state = wf.apply_precision(
+            State(
+                algorithm=wf.algorithm.setup(algo_key),
+                problem=wf.problem.setup(prob_key),
+                monitor=mon_state,
+            )
         )
         return assign_fault_lane(state, record.uid)
 
@@ -709,7 +733,12 @@ class OptimizationService:
             try:
                 manifest = read_manifest(path)
                 state = load_state(
-                    path, template, allow_missing=True, verify=True
+                    path,
+                    template,
+                    allow_missing=True,
+                    verify=True,
+                    precision=record.spec.precision,
+                    key_impl=record.spec.key_impl,
                 )
             except FileNotFoundError:
                 continue
@@ -744,6 +773,8 @@ class OptimizationService:
                 spec.problem,
                 monitor=monitor,
                 solution_transform=spec.solution_transform,
+                precision=spec.precision,
+                key_impl=spec.key_impl,
             )
             pack = TenantPack(
                 workflow,

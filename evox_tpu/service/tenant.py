@@ -105,6 +105,16 @@ class TenantSpec:
         participates in the bucket key (by function code + closure
         digest); must be a module-level function (not a lambda) for
         daemon journal durability.
+    :param precision: optional
+        :class:`~evox_tpu.precision.PrecisionPolicy` this tenant's
+        workflow runs under.  Policy identity is part of the bucket key —
+        a bf16 tenant and an f32 tenant trace different programs (the
+        state avals differ) and must never share a vmapped pack.
+    :param key_impl: optional PRNG key implementation for the tenant's
+        identity-keyed stream (``"rbg"`` for the partitionable hardware
+        generator).  Also part of the bucket key: key-data shapes differ
+        per impl, so an rbg tenant and a threefry tenant cannot share a
+        lane axis — and must not share a stream family either.
     """
 
     tenant_id: str
@@ -115,6 +125,8 @@ class TenantSpec:
     workload: str = "standard"
     grow: Any = None
     solution_transform: Any = None
+    precision: Any = None
+    key_impl: str | None = None
 
     def __post_init__(self) -> None:
         if not re.fullmatch(r"[A-Za-z0-9._-]+", self.tenant_id or ""):
@@ -154,6 +166,12 @@ class TenantSpec:
                 "grow= (the elastic inner-population ladder) only applies "
                 "to workload='hpo' tenants"
             )
+        if self.key_impl is not None:
+            from ..precision import resolve_key_impl
+
+            # Normalize at submission so the bucket key and every stream
+            # derivation agree on one canonical name.
+            self.key_impl = resolve_key_impl(self.key_impl)
 
 
 @dataclass
@@ -283,8 +301,14 @@ def static_signature(obj: Any) -> str:
 def bucket_key(spec: TenantSpec) -> tuple:
     """The compilation-shape bucket a tenant belongs to: algorithm class +
     ``(pop, dim)`` + the static-configuration digests of algorithm,
-    problem, and solution transform.  Tenants sharing a key are safe to
-    step through ONE traced program with per-tenant state."""
+    problem, and solution transform, plus the tenant's **numerics
+    identity** (precision-policy identity and PRNG key implementation —
+    both change the traced program's avals, so sharing a bucket across
+    them would stack mismatched dtypes/key-data shapes onto one lane
+    axis).  Tenants sharing a key are safe to step through ONE traced
+    program with per-tenant state."""
+    from ..precision import precision_identity, resolve_key_impl
+
     algo = spec.algorithm
     if spec.solution_transform is None:
         transform = "no-transform"
@@ -300,4 +324,6 @@ def bucket_key(spec: TenantSpec) -> tuple:
         static_signature(algo),
         static_signature(spec.problem),
         transform,
+        precision_identity(spec.precision),
+        resolve_key_impl(spec.key_impl),
     )

@@ -83,6 +83,13 @@ __all__ = [
 
 MANIFEST_KEY = "__manifest__"
 DIGEST_KEY = "__digest__"
+# bfloat16 is not a numpy-native dtype: ``np.savez`` stores it as a raw
+# void-2 scalar whose byte-order tag does not even survive the round trip
+# (``<V2`` on write, ``|V2`` on read), so both digests and the load-time
+# dtype check would break.  Narrow-storage leaves therefore ride as a
+# tagged uint16 bit view — the same convention ``__key__/`` uses for
+# typed PRNG keys.
+BF16_PREFIX = "__bf16__/"
 # Format 2 added per-leaf + manifest SHA-256 digests (``leaf_digests`` /
 # ``__digest__``); format-1 archives still load, but cannot be verified.
 CHECKPOINT_FORMAT = 2
@@ -322,6 +329,8 @@ def save_state(
             arr.dtype, jax.dtypes.prng_key
         ):
             out["__key__/" + name] = np.asarray(jax.random.key_data(arr))
+        elif getattr(arr, "dtype", None) == jax.numpy.bfloat16:
+            out[BF16_PREFIX + name] = np.asarray(arr).view(np.uint16)
         else:
             out[name] = np.asarray(arr)
     manifest = {
@@ -550,6 +559,9 @@ def _match_weak_type(value: "jax.Array", like_leaf: Any) -> "jax.Array":
     return value
 
 
+_UNSET = object()
+
+
 def load_state(
     path: Union[str, Path],
     like: Any,
@@ -558,6 +570,8 @@ def load_state(
     mesh: Any | None = None,
     remesh: bool = True,
     verify: bool = False,
+    precision: Any = _UNSET,
+    key_impl: Any = _UNSET,
 ) -> Any:
     """Load a checkpoint written by :func:`save_state` into the structure of
     ``like`` (a template state with the same shape — e.g. a freshly
@@ -595,6 +609,19 @@ def load_state(
         bit-flipped archive raises :class:`CheckpointCorruptError` instead
         of silently restoring damaged values.  The resilience runner loads
         with ``verify=True`` by default.
+    :param precision: when passed (a
+        :class:`~evox_tpu.precision.PrecisionPolicy` or ``None`` for the
+        full-precision default), the archive's recorded ``precision``
+        manifest tag is checked against it *before* any leaf is restored:
+        a bf16 checkpoint refuses to silently load as f32 and vice versa
+        (:class:`CheckpointError`, remesh-style) — the generic same-kind
+        dtype cast below would otherwise widen/narrow it cleanly and
+        corrupt the run's numerics story.  Omit the argument entirely to
+        skip the check (template-only tooling).
+    :param key_impl: when passed (an impl name or ``None`` for the
+        default), the archive's recorded ``key_impl`` manifest tag is
+        checked the same way — cross-impl divergence is documented and
+        gated, never discovered as a mid-run stream fork.
     """
     path = _resolve(path)
     try:
@@ -608,10 +635,48 @@ def load_state(
     with data:  # close the archive fd even on a mismatch raise below
         if verify:
             _verify_archive(path, data)
+        # Parse the manifest ONCE for every guard below (precision,
+        # key-impl, topology) — it carries the per-leaf digest dict, so
+        # re-decoding it per guard scales with leaf count on the resume
+        # hot path.
+        if precision is not _UNSET or key_impl is not _UNSET or mesh is not None:
+            manifest = (
+                json.loads(str(data[MANIFEST_KEY]))
+                if MANIFEST_KEY in data
+                else {}
+            )
+        if precision is not _UNSET:
+            from ..precision import check_precision
+
+            check_precision(
+                manifest.get("precision"),
+                precision,
+                context=f"checkpoint {path}",
+            )
+        if key_impl is not _UNSET:
+            from ..precision import resolve_key_impl
+            from ..precision.prng import DEFAULT_KEY_IMPL
+
+            # A pre-plane archive (no key_impl entry) was necessarily
+            # written on the LITERAL library default (threefry) — the
+            # env-aware resolve must not apply here, or setting
+            # EVOX_TPU_KEY_IMPL=rbg fleet-wide would make the guard
+            # pass vacuously on exactly the legacy archives it exists
+            # to protect.
+            recorded_impl = manifest.get("key_impl") or DEFAULT_KEY_IMPL
+            expected_impl = resolve_key_impl(key_impl)
+            if recorded_impl != expected_impl:
+                raise CheckpointError(
+                    f"checkpoint {path}: PRNG key-impl mismatch — the "
+                    f"archive was written with {recorded_impl!r} but "
+                    f"this run is configured for {expected_impl!r}. "
+                    f"Streams differ across implementations by "
+                    f"construction; resume with the matching key_impl "
+                    f"or re-seed the run."
+                )
         if mesh is not None and MANIFEST_KEY in data:
             from ..resilience.elastic import MeshTopology, check_topology
 
-            manifest = json.loads(str(data[MANIFEST_KEY]))
             check_topology(
                 manifest.get("topology"),
                 MeshTopology.from_mesh(mesh),
@@ -660,8 +725,38 @@ def _restore_leaves(
                     f"{restored.shape}, but the template expects {leaf.shape}"
                 )
             new_leaves.append(restored)
-        elif name in data:
-            arr = data[name]
+        elif name in data or BF16_PREFIX + name in data:
+            if BF16_PREFIX + name in data:
+                # Tagged narrow-storage leaf: reinterpret the stored
+                # uint16 bits as bfloat16, then run the SAME shape/dtype
+                # checks as any other leaf.
+                arr = data[BF16_PREFIX + name].view(jax.numpy.bfloat16)
+            else:
+                arr = data[name]
+            # Narrow-storage dtypes (bfloat16 AND float16 — both valid
+            # PrecisionPolicy storage types) never cross a precision
+            # boundary silently, even without the manifest-level guard:
+            # the generic same-kind cast below would widen a narrow
+            # archive into an f32 template (or narrow the reverse)
+            # without a sound — exactly the bug class the precision
+            # plane exists to make loud.  (float64 -> float32 from an
+            # x64-enabled writer of the SAME policy remains tolerated,
+            # as before.)
+            _narrow = (jax.numpy.bfloat16, jax.numpy.float16)
+            if (
+                hasattr(leaf, "dtype")
+                and arr.dtype != leaf.dtype
+                and any(
+                    np.dtype(n) in (arr.dtype, leaf.dtype) for n in _narrow
+                )
+            ):
+                raise CheckpointError(
+                    f"checkpoint {path}: leaf {name!r} crosses a precision "
+                    f"boundary (stored {arr.dtype}, template "
+                    f"{leaf.dtype}) — a bfloat16 checkpoint must be loaded "
+                    f"under the matching PrecisionPolicy, never silently "
+                    f"cast"
+                )
             if hasattr(leaf, "shape") and tuple(arr.shape) != tuple(leaf.shape):
                 if getattr(leaf, "size", None) == 0:
                     # Size-0 placeholder: the template was built before the

@@ -25,6 +25,7 @@ TPU-native counterpart of the reference ``StdWorkflow``
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -118,11 +119,33 @@ class StdWorkflow(Workflow):
         quarantine_nonfinite: bool = True,
         nonfinite_penalty: float = 1e30,
         quarantine_granularity: str = "individual",
+        precision: Any | None = None,
+        key_impl: str | None = None,
     ):
         """
         :param opt_direction: ``"min"`` or ``"max"``; for ``"max"`` fitness is
             negated before the fitness transform and monitor, matching the
             reference (``std_workflow.py:86,94-95``).
+        :param precision: optional
+            :class:`~evox_tpu.precision.PrecisionPolicy` — the algorithm's
+            declared ``storage_leaves`` are carried in the policy's narrow
+            storage dtype between generations (the fused scan's carry and
+            every checkpoint hold the storage form), while each
+            generation's math runs in the compute dtype: the ONE
+            promote/demote seam lives in :meth:`_step`, so the per-step,
+            fused-segment, vmapped-pack, and resilient-runner paths all
+            inherit it.  Requires the algorithm to declare its per-leaf
+            dtype map (opt-in; see ``docs/guide/precision.md``).
+        :param key_impl: optional PRNG key implementation name
+            (``"threefry2x32"`` / ``"rbg"`` / ``"unsafe_rbg"``) — when
+            set, :meth:`setup` coerces the incoming key to this
+            implementation (an int seed builds one directly), so every
+            stream derived from the state key — including the GL006
+            topology-invariant per-slot folds and identity-keyed tenant
+            streams — runs on it.  ``"rbg"`` is the partitionable
+            hardware generator (see ``evox_tpu.precision``); runs are
+            bit-reproducible per impl, and cross-impl divergence is
+            documented, never silent.
         :param enable_distributed: shard evaluation over ``mesh``'s
             ``pop_axis`` via ``shard_map`` + ICI all-gather.
         :param mesh: the device mesh to shard over; defaults to a 1-D mesh of
@@ -161,6 +184,32 @@ class StdWorkflow(Workflow):
         self.opt_direction = 1 if opt_direction == "min" else -1
         self.algorithm = algorithm
         self.problem = problem
+        # Numerics plane: validate the policy against the algorithm's
+        # declarative per-leaf map AT CONSTRUCTION (an unaudited algorithm
+        # must fail here, not mid-trace), and resolve the key impl once so
+        # the knob's env-var default is captured per workflow, not per
+        # call.  Both are part of the workflow's static identity: the
+        # service's bucket keys and the runner's executable-cache
+        # signature fold them in.
+        self.precision = precision
+        if precision is not None:
+            # Fail-fast audit: an algorithm with no storage_leaves
+            # declaration raises HERE, not mid-trace.
+            precision.leaf_map(algorithm)
+        if key_impl is not None or os.environ.get("EVOX_TPU_KEY_IMPL"):
+            # Resolve ONCE at construction (explicit arg or the fleet-wide
+            # env contract), so both of setup()'s entry paths — int seeds
+            # and typed keys — coerce to the same impl this workflow's
+            # manifests and bucket keys record.  Without the env capture,
+            # a typed threefry key handed to an env-configured-rbg
+            # workflow would skip coercion and run a stream the recorded
+            # numerics identity misdescribes.  A knob-less, env-less
+            # workflow keeps key_impl=None: it accepts whatever key it is
+            # given (pre-plane pass-through semantics).
+            from ..precision import resolve_key_impl
+
+            key_impl = resolve_key_impl(key_impl)
+        self.key_impl = key_impl
         self.monitor = monitor if monitor is not None else Monitor()
         if monitor is not None:
             monitor.set_config(opt_direction=self.opt_direction)
@@ -262,17 +311,59 @@ class StdWorkflow(Workflow):
             grouping does not depend on callback delivery order::
 
                 states = jax.vmap(wf.init)(keys, jnp.arange(n_instances))
+
+        An int seed is accepted in place of a key and built with the
+        workflow's ``key_impl``; a key of a different implementation than
+        a pinned ``key_impl`` is deterministically re-seeded
+        (:func:`~evox_tpu.precision.coerce_key`) — template-building
+        callers never have to know the knob.
         """
+        if self.key_impl is not None or not isinstance(key, jax.Array):
+            from ..precision import coerce_key
+
+            key = coerce_key(key, self.key_impl)
         algo_key, prob_key, mon_key = jax.random.split(key, 3)
         mon_state = self.monitor.setup(mon_key)
         if instance_id is not None and "instance_id" in mon_state:
             mon_state = mon_state.replace(
                 instance_id=jnp.asarray(instance_id, jnp.int32)
             )
-        return State(
-            algorithm=self.algorithm.setup(algo_key),
-            problem=self.problem.setup(prob_key),
-            monitor=mon_state,
+        return self.apply_precision(
+            State(
+                algorithm=self.algorithm.setup(algo_key),
+                problem=self.problem.setup(prob_key),
+                monitor=mon_state,
+            )
+        )
+
+    @property
+    def _precision_leaf_map(self):
+        """The policy's per-leaf dtype map for the CURRENT algorithm —
+        computed on use, never cached on the workflow: restart policies
+        swap ``self.algorithm`` mid-run (growth ladders), and a stale
+        construction-time map would silently narrow leaves the new class
+        never audited.  Host-side dict building, evaluated only at trace
+        time."""
+        if self.precision is None:
+            return None
+        return self.precision.leaf_map(self.algorithm)
+
+    def apply_precision(self, state: State) -> State:
+        """The storage form of a workflow state under this workflow's
+        precision policy (identity without one): mapped algorithm leaves
+        demoted to their storage dtype.  Setup runs it on fresh states;
+        callers that build states out-of-band (the service's
+        identity-keyed tenant construction) apply it for the same
+        layout.  Every state enters the policy through here, so this is
+        where the map is validated against the REAL leaf names — a
+        misnamed map entry would otherwise silently run at full
+        precision under a narrow-policy identity."""
+        if self.precision is None:
+            return state
+        leaf_map = self._precision_leaf_map
+        self.precision.validate_state(state.algorithm, leaf_map)
+        return state.replace(
+            algorithm=self.precision.demote(state.algorithm, leaf_map)
         )
 
     init = setup  # convenience alias
@@ -442,6 +533,29 @@ class StdWorkflow(Workflow):
 
     # -- stepping ----------------------------------------------------------
     def _step(self, state: State, which: str) -> State:
+        # THE precision seam: promote the mapped storage leaves to the
+        # compute dtype for this generation's math, demote on the way
+        # out.  Everything between (evaluation, reductions, best folds,
+        # quarantine) runs in the compute dtype; everything carried
+        # between generations — the fused scan's carry, checkpoints,
+        # HBM-resident state on the per-step path — holds the narrow
+        # storage form.  One seam, inherited by every driver.
+        if self.precision is not None:
+            state = state.replace(
+                algorithm=self.precision.promote(
+                    state.algorithm, self._precision_leaf_map
+                )
+            )
+        state = self._step_inner(state, which)
+        if self.precision is not None:
+            state = state.replace(
+                algorithm=self.precision.demote(
+                    state.algorithm, self._precision_leaf_map
+                )
+            )
+        return state
+
+    def _step_inner(self, state: State, which: str) -> State:
         carrier = {
             "problem": state.problem,
             "monitor": state.monitor,

@@ -157,6 +157,26 @@
 #                               probe adds <5% wall-clock overhead to a
 #                               200-generation run (artifact written under
 #                               bench_artifacts/)
+#   ./run_tests.sh --precision  mixed-precision + PRNG numerics lane: the
+#                               precision-plane suite (PrecisionPolicy
+#                               storage/compute seam, per-algorithm leaf
+#                               maps, checkpoint manifest dtype guard +
+#                               bit-identical bf16+rbg resume, bucket
+#                               split on policy/key_impl, rbg-beside-
+#                               threefry tenant isolation, compile-once
+#                               sentinel on policy/impl flips) + the
+#                               Pallas kernel-program suite (crowding /
+#                               top-k parity vs XLA, dominance demotion),
+#                               then a full graftlint sweep (GL008 dtype
+#                               discipline stays clean), then
+#                               tools/bench_precision.py: accuracy gates
+#                               (policy final-fitness / IGD within
+#                               tolerance of f32 — enforced everywhere),
+#                               resilient bf16+rbg resume e2e, and the
+#                               throughput twin (bf16+rbg >= f32/threefry
+#                               gated on TPU; CPU-provisional
+#                               BENCH_HISTORY rows recorded otherwise,
+#                               artifacts under bench_artifacts/)
 #   ./run_tests.sh --lint       repo lints: the graftlint static-analysis
 #                               suite (GL000 assert ratchet + GL001-GL007
 #                               JAX-purity rules), then the lint test suite
@@ -179,6 +199,17 @@ fi
 if [ "$1" = "--lint-fix-hints" ]; then
   shift
   exec python -m tools.graftlint --lint-fix-hints "$@"
+fi
+if [ "$1" = "--precision" ]; then
+  shift
+  PRECISION_TIMEOUT="${EVOX_TPU_PRECISION_TIMEOUT:-1200}"
+  timeout -k 30 "$PRECISION_TIMEOUT" \
+    "${CPU_ENV[@]}" python -m pytest \
+    tests/test_precision.py tests/test_pallas_kernels.py -q "$@" || exit 1
+  # Numerics discipline: the full graftlint sweep (GL008 et al.) must
+  # stay clean — no f64 / unannotated dtype-mixing in compiled scope.
+  python -m tools.graftlint || exit 1
+  exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_precision.py
 fi
 if [ "$1" = "--elastic" ]; then
   shift

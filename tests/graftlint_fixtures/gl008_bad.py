@@ -1,0 +1,32 @@
+"""GL008 true positives: f64 and unannotated dtype-mixing in compiled
+scope — the numerics-discipline bug class the precision plane exists to
+own at one seam."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class BadAlgo:
+    def step(self, state, evaluate):
+        # Hard f64 in compiled scope: TPUs have no native float64, XLA
+        # emulates it — compute and HBM bytes silently multiply.
+        noise = jnp.zeros(state.pop.shape, dtype=jnp.float64)  # GL008
+        pop = state.pop + noise.astype(state.pop.dtype)
+        fit = evaluate(pop)
+        # Unannotated dtype-mixing: a state leaf cast to a hard-coded
+        # float dtype outside the PrecisionPolicy seam — the leaf crosses
+        # the storage/compute boundary behind the policy's back.
+        vel = state.velocity.astype(jnp.float32) * 0.9  # GL008
+        # The implicit-f64 builtin in positional astype form: under x64
+        # this is float64 too, just never spelled out.
+        fit = state.fit.astype(float) + 0.0  # GL008
+        # Keyword spelling of the same crossing — must not be an evasion.
+        lbf = state.local_best_fit.astype(dtype=jnp.float16)  # GL008
+        return state.replace(pop=pop, fit=fit, velocity=vel, local_best_fit=lbf)
+
+
+def evaluate(state, pop):
+    # Implicit f64 promotion: the Python `float` builtin is float64 under
+    # x64 — a constant table built this way widens the whole pipeline.
+    table = np.asarray([1.0, 2.0], dtype=float)  # GL008
+    return (pop * table[0]).sum(axis=-1), state

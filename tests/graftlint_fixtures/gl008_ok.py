@@ -1,0 +1,29 @@
+"""GL008 clean patterns: policy-preserving casts, integer index math, and
+host-side f64 are all sanctioned."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class OkAlgo:
+    def step(self, state, evaluate):
+        # Policy-preserving: casting to an EXISTING leaf's dtype never
+        # crosses the storage/compute boundary.
+        keys = state.rank.astype(state.dis.dtype)
+        # Integer/bool casts are index math, not precision mixing.
+        count = (state.fit < 0).astype(jnp.int32).sum()
+        # f64-AVOIDANCE guards compare against float64 without building
+        # it — upholding the rule's intent, exempt by construction.
+        if state.pop.dtype == jnp.float64:
+            raise TypeError("f64 state is not supported on TPU")
+        # An ordinary variable named `double` is not a dtype.
+        double = count * 2
+        pop = state.pop + keys[:, None] * 0 + double * 0
+        fit = evaluate(pop)
+        return state.replace(pop=pop, fit=fit)
+
+
+def build_reference_vectors(n, m):
+    # Host-side setup (not compiled scope): f64 is fine where XLA never
+    # sees it — reference-vector lattices are built once with numpy.
+    return np.zeros((n, m), dtype=np.float64)

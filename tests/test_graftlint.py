@@ -546,11 +546,27 @@ def test_new_rules_start_at_zero():
     )
     assert sorted(committed) == [
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
+        "GL008",
     ]
     assert all(files == {} for files in committed.values()), (
         "GL001+ baselines must stay empty — fix or pragma new findings "
         f"instead of baselining them: {committed}"
     )
+
+
+def test_gl008_prefilter_keeps_implicit_float_builtin(tmp_path):
+    """The cheap source pre-filter must not swallow the implicit-f64
+    `dtype=float` builtin: a compiled-scope file that never spells
+    'float64'/'double'/'astype' still contains a documented GL008 case."""
+    f = tmp_path / "only_builtin.py"
+    f.write_text(
+        "import jax.numpy as jnp\n\n\n"
+        "class A:\n"
+        "    def step(self, state):\n"
+        "        return jnp.zeros((4,), dtype=float)\n"
+    )
+    found = [x for x in _findings(f, ["GL008"]) if x.rule == "GL008"]
+    assert len(found) == 1, [x.format() for x in found]
 
 
 def test_gl006_guards_parallel_layer():

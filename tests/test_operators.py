@@ -94,8 +94,13 @@ def test_pallas_dominance_kernel(mo_fitness):
 
 
 def test_pallas_gate_dispatch(mo_fitness, monkeypatch):
-    """EVOX_TPU_PALLAS gate: closed -> broadcast path; open (forced) ->
-    the Pallas kernel dispatches inside non_dominate_rank and agrees."""
+    """Demoted dominance kernel: the open EVOX_TPU_PALLAS gate alone no
+    longer dispatches it (the kernel measurably loses to XLA — it is
+    opt-in via EVOX_TPU_PALLAS_DOMINANCE on top of the gate), and the
+    opt-in path still agrees with the broadcast path."""
+    from evox_tpu.operators.selection.non_dominate import (
+        _pallas_kernel_eligible,
+    )
     from evox_tpu.ops import pallas_gate
 
     expected = np.asarray(non_dominate_rank(mo_fitness))  # gate closed
@@ -104,6 +109,11 @@ def test_pallas_gate_dispatch(mo_fitness, monkeypatch):
     monkeypatch.setenv("EVOX_TPU_PALLAS_MIN_POP", "1")
     pallas_gate._reset_for_tests()
     try:
+        # Gate open but no dominance opt-in: the demoted kernel must NOT
+        # be eligible on any default path.
+        assert not _pallas_kernel_eligible(mo_fitness)
+        monkeypatch.setenv("EVOX_TPU_PALLAS_DOMINANCE", "1")
+        assert _pallas_kernel_eligible(mo_fitness)
         got = np.asarray(non_dominate_rank(mo_fitness))
     finally:
         pallas_gate._reset_for_tests()

@@ -1,0 +1,259 @@
+"""The settled Pallas kernel program (ISSUE 15):
+
+* the dominance kernel is DEMOTED — the open ``EVOX_TPU_PALLAS`` gate
+  alone never dispatches it (it measurably loses to XLA); explicit
+  ``EVOX_TPU_PALLAS_DOMINANCE`` opt-in only;
+* the two kernels re-aimed at ops where XLA demonstrably loses at the
+  pop=50k NSGA-II cliff — tiled crowding distance (``ops/crowding.py``)
+  and masked top-k rank-by-count (``ops/topk.py``) — are BITWISE equal to
+  their XLA reference implementations, ties and masks included, and
+  route through the standard gate + threshold dispatch.
+
+All kernels run in interpret mode here (CPU), exactly like the dominance
+kernel's own tests.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.operators.selection import crowding_distance  # noqa: E402
+from evox_tpu.operators.selection.non_dominate import (  # noqa: E402
+    _pallas_crowding_eligible,
+    _pallas_kernel_eligible,
+    _pallas_topk_eligible,
+)
+from evox_tpu.ops.crowding import crowding_distance_pallas  # noqa: E402
+from evox_tpu.ops.topk import masked_top_k, masked_top_k_xla  # noqa: E402
+
+
+def _tie_heavy(key, shape):
+    """Quantized uniforms: every draw collides with neighbors, so the
+    lexicographic index tie-break is exercised on purpose."""
+    return jnp.round(jax.random.uniform(key, shape) * 8) / 8
+
+
+# ---------------------------------------------------------------------------
+# crowding distance: pallas == XLA reference, bitwise
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,m", [(37, 3), (64, 2), (130, 4), (256, 1)])
+def test_crowding_parity_unmasked(n, m):
+    costs = _tie_heavy(jax.random.key(n * 10 + m), (n, m))
+    ref = np.asarray(crowding_distance(costs))
+    got = np.asarray(
+        crowding_distance_pallas(costs, block_size=32, interpret=True)
+    )
+    np.testing.assert_array_equal(ref, got)
+
+
+@pytest.mark.parametrize("n,m", [(50, 3), (129, 2)])
+def test_crowding_parity_masked(n, m):
+    k1, k2 = jax.random.split(jax.random.key(n))
+    costs = _tie_heavy(k1, (n, m))
+    mask = jax.random.uniform(k2, (n,)) > 0.3
+    ref = np.asarray(crowding_distance(costs, mask))
+    got = np.asarray(
+        crowding_distance_pallas(costs, mask, block_size=32, interpret=True)
+    )
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_crowding_parity_with_real_inf_objectives():
+    """Real ±inf objective values (quarantine off / inf-producing fitness
+    transforms) must not be confused with the no-neighbor boundary: the
+    kernel's existence flags take the reference's arithmetic path — NaNs
+    from inf-inf/inf included, bitwise."""
+    costs = jnp.asarray(
+        [[1.0, 0.5], [2.0, jnp.inf], [jnp.inf, 0.25], [3.0, -jnp.inf]]
+    )
+    ref = np.asarray(crowding_distance(costs))
+    got = np.asarray(
+        crowding_distance_pallas(costs, block_size=2, interpret=True)
+    )
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_crowding_parity_with_nan_objectives():
+    """Unquarantined NaN fitness (quarantine off / NaN-producing fitness
+    transforms) must not flip survivor selection between the gated and
+    ungated paths: the reference's stable sort places NaN rows LAST
+    (index tie-breaks), the NaN row's neighbors and the NaN-propagating
+    range poison the same gaps — the kernel reproduces that placement.
+
+    NaN positions must match exactly; non-NaN entries bitwise."""
+    cases = [
+        jnp.asarray([[0.0], [jnp.nan], [2.0], [1.0]]),
+        jnp.asarray([[jnp.nan], [jnp.nan], [1.0], [0.0]]),  # NaN ties
+        jnp.asarray(  # NaN beside a genuine +inf (inf sorts BEFORE NaN)
+            [[1.0, 0.5], [jnp.inf, jnp.nan], [jnp.nan, 0.25], [3.0, 2.0]]
+        ),
+    ]
+    for costs in cases:
+        ref = np.asarray(crowding_distance(costs))
+        got = np.asarray(
+            crowding_distance_pallas(costs, block_size=2, interpret=True)
+        )
+        np.testing.assert_array_equal(np.isnan(ref), np.isnan(got))
+        np.testing.assert_array_equal(
+            ref[~np.isnan(ref)], got[~np.isnan(got)]
+        )
+
+
+def test_crowding_parity_nan_masked():
+    """A masked-out NaN row must stay invisible (-inf like every masked
+    row) while a valid NaN row still poisons its neighbors."""
+    costs = jnp.asarray([[0.0], [jnp.nan], [2.0], [jnp.nan], [1.0]])
+    mask = jnp.asarray([True, False, True, True, True])
+    ref = np.asarray(crowding_distance(costs, mask))
+    got = np.asarray(
+        crowding_distance_pallas(costs, mask, block_size=2, interpret=True)
+    )
+    np.testing.assert_array_equal(np.isnan(ref), np.isnan(got))
+    np.testing.assert_array_equal(ref[~np.isnan(ref)], got[~np.isnan(got)])
+
+
+def test_crowding_boundary_and_masked_rows():
+    """Boundary semantics pinned directly: first/last valid per column
+    are inf, masked-out rows are -inf — the reference contract."""
+    costs = jnp.asarray([[0.0], [1.0], [2.0], [3.0]])
+    mask = jnp.asarray([True, True, True, False])
+    got = np.asarray(
+        crowding_distance_pallas(costs, mask, block_size=2, interpret=True)
+    )
+    assert got[0] == np.inf and got[2] == np.inf  # boundary of valid set
+    assert got[3] == -np.inf  # masked out
+    assert got[1] == pytest.approx((2.0 - 0.0) / 2.0)
+
+
+# ---------------------------------------------------------------------------
+# masked top-k: pallas == XLA reference, bitwise
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [17, 64, 129, 512])
+def test_topk_parity(n):
+    k1, k2 = jax.random.split(jax.random.key(n))
+    vals = _tie_heavy(k1, (n,))
+    mask = jax.random.uniform(k2, (n,)) > 0.4
+    for k in (1, 5, n // 2, n):
+        ev, ei = masked_top_k_xla(vals, k, mask)
+        gv, gi = masked_top_k(vals, k, mask, block_size=32, interpret=True)
+        np.testing.assert_array_equal(np.asarray(ev), np.asarray(gv))
+        np.testing.assert_array_equal(np.asarray(ei), np.asarray(gi))
+
+
+def test_topk_int_ranks():
+    """The survivor-selection use: k-th smallest of an int32 rank vector
+    (heavy ties — rank vectors are mostly duplicates)."""
+    ranks = jax.random.randint(jax.random.key(3), (200,), 0, 7, jnp.int32)
+    for k in (1, 100, 200):
+        ev, ei = masked_top_k_xla(ranks, k)
+        gv, gi = masked_top_k(ranks, k, block_size=32, interpret=True)
+        np.testing.assert_array_equal(np.asarray(ev), np.asarray(gv))
+        np.testing.assert_array_equal(np.asarray(ei), np.asarray(gi))
+        # worst-rank extraction (nd_environmental_selection's use) agrees
+        # with the lax.top_k formulation.
+        assert int(gv[-1]) == int(-jax.lax.top_k(-ranks, k)[0][-1])
+
+
+def test_topk_parity_with_nan_values():
+    """NaN values rank LAST (after +inf and masked rows, index
+    tie-breaks among themselves) exactly like the reference's stable
+    argsort — a NaN element must never win a top-k slot ahead of a
+    finite one, and is selected only when k reaches past every non-NaN
+    candidate."""
+    vals = jnp.asarray([3.0, 1.0, 2.0, 0.5, jnp.nan])
+    for k in (1, 3, 4, 5):
+        ev, ei = masked_top_k_xla(vals, k)
+        gv, gi = masked_top_k(vals, k, block_size=2, interpret=True)
+        np.testing.assert_array_equal(np.asarray(ei), np.asarray(gi))
+        np.testing.assert_array_equal(
+            np.asarray(ev).tobytes(), np.asarray(gv).tobytes()
+        )
+    # NaN ties + a genuine +inf + masking, across pad boundaries.
+    vals = jnp.asarray([jnp.nan, 2.0, jnp.inf, jnp.nan, 1.0, 0.0, 4.0])
+    mask = jnp.asarray([True, True, True, True, False, True, True])
+    for k in (2, 5, 7):
+        ev, ei = masked_top_k_xla(vals, k, mask)
+        gv, gi = masked_top_k(vals, k, mask, block_size=2, interpret=True)
+        np.testing.assert_array_equal(np.asarray(ei), np.asarray(gi))
+        np.testing.assert_array_equal(
+            np.asarray(ev).tobytes(), np.asarray(gv).tobytes()
+        )
+
+
+def test_topk_validates_k():
+    vals = jnp.arange(8.0)
+    with pytest.raises(ValueError, match="k must be"):
+        masked_top_k(vals, 0, interpret=True)
+    with pytest.raises(ValueError, match="k must be"):
+        masked_top_k(vals, 9, interpret=True)
+
+
+# ---------------------------------------------------------------------------
+# dispatch discipline
+# ---------------------------------------------------------------------------
+
+
+def test_dominance_demoted_but_crowding_topk_dispatch(monkeypatch):
+    """The settled program: with the gate OPEN and every threshold at 1,
+    the demoted dominance kernel stays ineligible (explicit opt-in only)
+    while the crowding and top-k kernels dispatch."""
+    from evox_tpu.ops import pallas_gate
+
+    f = jnp.asarray(np.random.default_rng(0).random((64, 3)), jnp.float32)
+    monkeypatch.setenv("EVOX_TPU_PALLAS", "1")
+    monkeypatch.setenv("EVOX_TPU_PALLAS_MIN_POP", "1")
+    monkeypatch.setenv("EVOX_TPU_PALLAS_CROWDING_MIN_POP", "1")
+    monkeypatch.setenv("EVOX_TPU_PALLAS_TOPK_MIN_POP", "1")
+    monkeypatch.delenv("EVOX_TPU_PALLAS_DOMINANCE", raising=False)
+    pallas_gate._reset_for_tests()
+    try:
+        assert not _pallas_kernel_eligible(f), "dominance must stay demoted"
+        assert _pallas_crowding_eligible(f)
+        assert _pallas_topk_eligible(f[:, 0])
+    finally:
+        pallas_gate._reset_for_tests()
+
+
+def test_kernels_off_all_default_paths(monkeypatch):
+    """Gate closed (the default): nothing dispatches, thresholds
+    notwithstanding."""
+    from evox_tpu.ops import pallas_gate
+
+    f = jnp.zeros((100_000, 3), jnp.float32)
+    monkeypatch.delenv("EVOX_TPU_PALLAS", raising=False)
+    pallas_gate._reset_for_tests()
+    try:
+        assert not _pallas_kernel_eligible(f)
+        assert not _pallas_crowding_eligible(f)
+        assert not _pallas_topk_eligible(f[:, 0])
+    finally:
+        pallas_gate._reset_for_tests()
+
+
+def test_nd_selection_identical_with_kernels_dispatched(monkeypatch):
+    """End to end: NSGA-II survivor selection with the crowding + top-k
+    kernels dispatched (interpret mode) is identical to the XLA path."""
+    from evox_tpu.operators.selection import nd_environmental_selection
+    from evox_tpu.ops import pallas_gate
+
+    key = jax.random.key(1)
+    x = jax.random.normal(key, (200, 5))
+    f = _tie_heavy(key, (200, 3))
+    ref = nd_environmental_selection(x, f, 100)
+
+    monkeypatch.setenv("EVOX_TPU_PALLAS", "1")
+    monkeypatch.setenv("EVOX_TPU_PALLAS_CROWDING_MIN_POP", "1")
+    monkeypatch.setenv("EVOX_TPU_PALLAS_TOPK_MIN_POP", "1")
+    pallas_gate._reset_for_tests()
+    try:
+        got = nd_environmental_selection(x, f, 100)
+    finally:
+        pallas_gate._reset_for_tests()
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))

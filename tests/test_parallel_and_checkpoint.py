@@ -272,14 +272,23 @@ def test_checkpoint_shape_mismatch_raises(tmp_path, key):
 
 
 def test_checkpoint_dtype_kind_mismatch_raises(tmp_path, key):
+    import numpy as np
+
     state = State(a=jnp.zeros(3, dtype=jnp.float32))
     save_state(tmp_path / "s.npz", state)
-    # Width changes cast silently (x64-writer portability)...
-    restored = load_state(tmp_path / "s.npz", State(a=jnp.zeros(3, jnp.float16)))
-    assert restored.a.dtype == jnp.float16
-    # ...kind changes do not.
+    # Full-width changes cast silently (x64-writer portability: an
+    # f64-enabled writer's archive loads into an f32 template)...
+    save_state(tmp_path / "w.npz", {"a": np.zeros(3, np.float64)})
+    restored = load_state(tmp_path / "w.npz", {"a": jnp.zeros(3, jnp.float32)})
+    assert restored["a"].dtype == jnp.float32
+    # ...kind changes do not...
     with pytest.raises(ValueError, match="cannot be safely cast"):
         load_state(tmp_path / "s.npz", State(a=jnp.zeros(3, jnp.int32)))
+    # ...and NARROW-storage widths (f16/bf16 — PrecisionPolicy storage
+    # dtypes) never cross silently either: an f32 archive refuses to
+    # narrow into an f16 template (see evox_tpu.precision).
+    with pytest.raises(ValueError, match="precision boundary"):
+        load_state(tmp_path / "s.npz", State(a=jnp.zeros(3, jnp.float16)))
 
 
 def test_checkpoint_manifest_round_trip(tmp_path, key):

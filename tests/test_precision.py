@@ -1,0 +1,475 @@
+"""The framework-wide numerics plane (ISSUE 15): ``PrecisionPolicy``
+storage/compute seam, the ``key_impl`` knob, and their identity discipline
+through checkpoints, buckets, the executable cache, and the compile
+sentinel.
+
+The contracts pinned here:
+
+* **one seam** — mapped algorithm leaves are carried in the storage dtype
+  between generations (fused scan carry included) and promoted to the
+  compute dtype inside each generation's math;
+* **opt-in per algorithm** — applying a policy to an algorithm without a
+  declared ``storage_leaves`` map raises;
+* **checkpoint guard** — a bf16 archive refuses to load as f32 and vice
+  versa (``CheckpointError``, manifest- and leaf-level), while a matched
+  resume is bit-identical to an uninterrupted run, per key impl;
+* **bucket identity** — service tenants split buckets on policy and
+  key impl, and an rbg tenant beside a threefry tenant finishes
+  bit-identical to the same tenant solo (no cross-contamination);
+* **compile-once** — flipping policy or key_impl recompiles exactly once;
+  rerunning the same configuration compiles zero extra times;
+* **documented cross-impl divergence** — threefry and rbg runs of the
+  same seed differ (gated here, so a silent convergence of the two would
+  fail as loudly as an accidental fork).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.algorithms import NSGA2, PSO, OpenES  # noqa: E402
+from evox_tpu.precision import (  # noqa: E402
+    PrecisionPolicy,
+    coerce_key,
+    key_impl_name,
+    make_key,
+    precision_identity,
+    precision_tag,
+    resolve_key_impl,
+)
+from evox_tpu.problems.numerical import Sphere  # noqa: E402
+from evox_tpu.resilience import ResilientRunner  # noqa: E402
+from evox_tpu.utils.checkpoint import (  # noqa: E402
+    CheckpointError,
+    load_state,
+    read_manifest,
+    save_state,
+)
+from evox_tpu.workflows import StdWorkflow  # noqa: E402
+
+DIM = 8
+POP = 32
+LB, UB = -5.0 * jnp.ones(DIM), 5.0 * jnp.ones(DIM)
+
+
+def _wf(**kwargs):
+    return StdWorkflow(PSO(POP, LB, UB), Sphere(), **kwargs)
+
+
+def _pol_wf(**kwargs):
+    return _wf(precision=PrecisionPolicy(), key_impl="rbg", **kwargs)
+
+
+def _f32(x):
+    return np.asarray(jnp.asarray(x).astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# policy + prng unit surface
+# ---------------------------------------------------------------------------
+
+
+def test_policy_identity_and_tags():
+    p = PrecisionPolicy()
+    assert p.identity() == ("precision", "bfloat16", "float32", None)
+    assert p.tag() == "storage=bfloat16,compute=float32"
+    assert precision_tag(None) == "storage=float32,compute=float32"
+    assert precision_identity(None) != p.identity()
+    # explicit leaf maps normalize to order-independent identity
+    a = PrecisionPolicy(leaves=("pop", "velocity"))
+    b = PrecisionPolicy(leaves=("velocity", "pop"))
+    assert a.identity() == b.identity()
+
+
+def test_policy_requires_declared_leaves():
+    class Undeclared:
+        pass
+
+    with pytest.raises(TypeError, match="storage_leaves"):
+        PrecisionPolicy().leaf_map(Undeclared())
+    # explicit override bypasses the declaration requirement
+    m = PrecisionPolicy(leaves=("pop",)).leaf_map(Undeclared())
+    assert m == {"pop": jnp.dtype(jnp.bfloat16)}
+
+
+def test_policy_validates_dtypes():
+    with pytest.raises(ValueError, match="storage"):
+        PrecisionPolicy(storage="int8")
+    with pytest.raises(ValueError, match="compute"):
+        PrecisionPolicy(compute="bfloat16")
+
+
+def test_key_impl_resolution(monkeypatch):
+    assert resolve_key_impl(None) == "threefry2x32"
+    assert resolve_key_impl("rbg") == "rbg"
+    monkeypatch.setenv("EVOX_TPU_KEY_IMPL", "rbg")
+    assert resolve_key_impl(None) == "rbg"
+    with pytest.raises(ValueError, match="unknown PRNG key impl"):
+        resolve_key_impl("xorwow")
+
+
+def test_coerce_key_accepts_legacy_raw_keys():
+    """Pre-plane code passed raw `jax.random.PRNGKey` arrays everywhere;
+    coerce_key wraps them under jax's raw-key convention instead of dying
+    in int()."""
+    raw = jax.random.PRNGKey(0)  # (2,) uint32, untyped
+    as_thr = coerce_key(raw, None)
+    assert key_impl_name(as_thr) == "threefry2x32"
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(as_thr)), np.asarray(raw)
+    )
+    assert key_impl_name(coerce_key(raw, "rbg")) == "rbg"
+
+
+def test_manifest_records_env_selected_impl(tmp_path, monkeypatch):
+    """A workflow with key_impl=None running under EVOX_TPU_KEY_IMPL still
+    records the RESOLVED impl in its checkpoint manifests — otherwise the
+    cross-impl resume guard is vacuous exactly when the knob is set
+    fleet-wide."""
+    monkeypatch.setenv("EVOX_TPU_KEY_IMPL", "rbg")
+    wf = _wf(key_impl="rbg")  # fleet-wide env would resolve the same
+    runner = ResilientRunner(wf, tmp_path / "run", checkpoint_every=4)
+    runner.run(wf.init(0), 4)
+    manifest = read_manifest(
+        sorted((tmp_path / "run").glob("ckpt_*.npz"))[-1]
+    )
+    assert manifest["key_impl"] == "rbg"
+    monkeypatch.delenv("EVOX_TPU_KEY_IMPL")
+    # plain f32/threefry runs record the default impl too (never absent)
+    wf2 = _wf()
+    runner2 = ResilientRunner(wf2, tmp_path / "run2", checkpoint_every=4)
+    runner2.run(wf2.init(jax.random.key(0)), 4)
+    manifest2 = read_manifest(
+        sorted((tmp_path / "run2").glob("ckpt_*.npz"))[-1]
+    )
+    assert manifest2["key_impl"] == "threefry2x32"
+
+
+def test_f16_leaf_never_silently_widens(tmp_path):
+    """float16 is a valid storage dtype too: an f16 archive refuses the
+    generic same-kind widen into an f32 template at the leaf level."""
+    wf16 = _wf(precision=PrecisionPolicy(storage="float16"))
+    state = jax.jit(wf16.init_step)(wf16.init(jax.random.key(0)))
+    assert state.algorithm.pop.dtype == jnp.float16
+    path = save_state(tmp_path / "ck", state)
+    f32_template = _wf().init(jax.random.key(0))
+    with pytest.raises(CheckpointError, match="precision boundary"):
+        load_state(path, f32_template)
+
+
+def test_coerce_key_matrix():
+    rbg = make_key(0, "rbg")
+    thr = make_key(0)
+    assert key_impl_name(rbg) == "rbg"
+    assert key_impl_name(thr) == "threefry2x32"
+    # matching impl passes through untouched
+    assert coerce_key(rbg, "rbg") is rbg
+    # int seeds build directly; cross-impl re-seeds deterministically
+    assert key_impl_name(coerce_key(7, "rbg")) == "rbg"
+    c1, c2 = coerce_key(thr, "rbg"), coerce_key(thr, "rbg")
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(c1)),
+        np.asarray(jax.random.key_data(c2)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the workflow seam
+# ---------------------------------------------------------------------------
+
+
+def test_storage_dtype_carried_between_generations():
+    wf = _pol_wf()
+    state = wf.init(0)
+    algo = state.algorithm
+    # mapped leaves narrow, unmapped leaves full precision
+    for leaf in ("pop", "velocity", "local_best_location", "fit"):
+        assert algo[leaf].dtype == jnp.bfloat16, leaf
+    assert algo["global_best_fit"].dtype == jnp.float32
+    assert key_impl_name(algo["key"]) == "rbg"
+    state = jax.jit(wf.init_step)(state)
+    state = jax.jit(wf.step)(state)
+    assert state.algorithm.pop.dtype == jnp.bfloat16
+    # fused segment: the scan CARRY holds the storage form too
+    final, _ = wf.run_segment(state, 4)
+    assert final.algorithm.pop.dtype == jnp.bfloat16
+
+
+def test_fused_equals_debug_under_policy():
+    """fused == debug bit-identity, policy on: the segment scan of the
+    promote/step/demote body carries exactly what a host loop of jitted
+    steps carries."""
+    wf = _pol_wf()
+    s0 = wf.init(0)
+    s0 = jax.block_until_ready(jax.jit(wf.init_step)(s0))
+
+    step = jax.jit(wf.step)
+    debug = s0
+    for _ in range(6):
+        debug = step(debug)
+    fused, _ = wf.run_segment(s0, 6)
+    np.testing.assert_array_equal(
+        _f32(debug.algorithm.pop), _f32(fused.algorithm.pop)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(debug.algorithm.key)),
+        np.asarray(jax.random.key_data(fused.algorithm.key)),
+    )
+
+
+def test_cross_impl_divergence_is_real():
+    """Documented and gated: the same seed draws DIFFERENT streams on
+    threefry vs rbg — if the two ever silently converged (an impl knob
+    that stopped reaching the draws), this fails."""
+
+    def run(key_impl):
+        wf = _wf(key_impl=key_impl)
+        st = wf.init(0)
+        st = jax.jit(wf.init_step)(st)
+        return jax.jit(wf.step)(st)
+
+    thr, rbg = run(None), run("rbg")
+    assert not np.array_equal(_f32(thr.algorithm.pop), _f32(rbg.algorithm.pop))
+
+
+def test_setup_accepts_seed_and_foreign_key():
+    """Template builders hand any key to a pinned-impl workflow: ints and
+    foreign-impl keys land deterministically on the workflow's impl."""
+    wf = _pol_wf()
+    a = wf.init(0)
+    b = wf.init(0)
+    np.testing.assert_array_equal(_f32(a.algorithm.pop), _f32(b.algorithm.pop))
+    c = wf.init(jax.random.key(0))  # threefry in, coerced
+    assert key_impl_name(c.algorithm.key) == "rbg"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip + manifest guard
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_matched_policy(tmp_path):
+    wf = _pol_wf()
+    state = wf.init(0)
+    state = jax.jit(wf.init_step)(state)
+    path = save_state(tmp_path / "ck", state, metadata={
+        "precision": precision_tag(wf.precision),
+        "key_impl": wf.key_impl,
+    })
+    manifest = read_manifest(path)
+    assert manifest["precision"] == "storage=bfloat16,compute=float32"
+    assert manifest["key_impl"] == "rbg"
+    restored = load_state(
+        path, wf.init(0), precision=wf.precision, key_impl=wf.key_impl
+    )
+    assert restored.algorithm.pop.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        _f32(restored.algorithm.pop), _f32(state.algorithm.pop)
+    )
+
+
+def test_bf16_checkpoint_refuses_f32_load(tmp_path):
+    wf = _pol_wf()
+    state = jax.jit(wf.init_step)(wf.init(0))
+    path = save_state(tmp_path / "ck", state, metadata={
+        "precision": precision_tag(wf.precision),
+    })
+    f32_wf = _wf(key_impl="rbg")
+    # manifest-level guard (before any leaf is touched)
+    with pytest.raises(CheckpointError, match="precision policy mismatch"):
+        load_state(path, f32_wf.init(0), precision=None)
+    # leaf-level guard (even without the manifest check)
+    with pytest.raises(CheckpointError, match="precision boundary|PRNG-key"):
+        load_state(path, f32_wf.init(0))
+
+
+def test_f32_checkpoint_refuses_bf16_load(tmp_path):
+    wf = _wf()
+    state = jax.jit(wf.init_step)(wf.init(jax.random.key(0)))
+    path = save_state(tmp_path / "ck", state)  # legacy: no precision tag
+    pol_wf = _pol_wf()
+    with pytest.raises(CheckpointError, match="precision policy mismatch"):
+        load_state(
+            path, pol_wf.init(0), precision=pol_wf.precision
+        )
+
+
+def test_key_impl_mismatch_refused(tmp_path):
+    wf = _wf(key_impl="rbg")
+    state = jax.jit(wf.init_step)(wf.init(0))
+    path = save_state(tmp_path / "ck", state, metadata={"key_impl": "rbg"})
+    with pytest.raises(CheckpointError, match="key-impl mismatch"):
+        load_state(path, _wf().init(jax.random.key(0)), key_impl=None)
+
+
+def test_resilient_resume_bit_identical_bf16_rbg(tmp_path):
+    """resume == uninterrupted, bf16 storage + rbg streams, through the
+    fused resilient path (the end-to-end acceptance row)."""
+
+    def runner(subdir):
+        wf = _pol_wf()
+        return wf, ResilientRunner(
+            wf, tmp_path / subdir, checkpoint_every=5
+        )
+
+    wf1, r1 = runner("run")
+    r1.run(wf1.init(0), 12)  # dies at gen 12, checkpoints at 5/10/12
+    wf2, r2 = runner("run")
+    resumed = r2.run(wf2.init(0), 25)
+    wf3, r3 = runner("clean")
+    clean = r3.run(wf3.init(0), 25)
+    np.testing.assert_array_equal(
+        _f32(resumed.algorithm.pop), _f32(clean.algorithm.pop)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(resumed.algorithm.key)),
+        np.asarray(jax.random.key_data(clean.algorithm.key)),
+    )
+    # the manifests carry the numerics identity
+    manifest = read_manifest(sorted((tmp_path / "run").glob("ckpt_*.npz"))[-1])
+    assert manifest["precision"] == "storage=bfloat16,compute=float32"
+    assert manifest["key_impl"] == "rbg"
+
+
+def test_cross_policy_resume_skips_loudly(tmp_path, capsys):
+    """A runner configured f32 pointed at a bf16 lineage never silently
+    restores: every candidate is refused (CheckpointError per candidate)
+    and the run starts fresh — the same skip-don't-trust discipline as a
+    shape-mismatched checkpoint."""
+    wf1 = _pol_wf()
+    r1 = ResilientRunner(wf1, tmp_path / "run", checkpoint_every=5)
+    r1.run(wf1.init(0), 10)
+    events = []
+    wf2 = _wf()
+    r2 = ResilientRunner(
+        wf2, tmp_path / "run", checkpoint_every=5, on_event=events.append
+    )
+    state = r2.run(wf2.init(jax.random.key(0)), 10)
+    assert state.algorithm.pop.dtype == jnp.float32
+    assert any("precision" in e or "skipped" in e for e in events), events
+
+
+# ---------------------------------------------------------------------------
+# service identity discipline
+# ---------------------------------------------------------------------------
+
+
+def _spec(tid, uid=None, **kw):
+    from evox_tpu.service import TenantSpec
+
+    return TenantSpec(
+        tid, PSO(16, LB[:4], UB[:4]), Sphere(), n_steps=8, uid=uid, **kw
+    )
+
+
+def test_bucket_split_on_policy_and_impl():
+    from evox_tpu.service.tenant import bucket_key
+
+    base = bucket_key(_spec("a"))
+    assert bucket_key(_spec("b")) == base  # same numerics -> same bucket
+    assert bucket_key(_spec("c", precision=PrecisionPolicy())) != base
+    assert bucket_key(_spec("d", key_impl="rbg")) != base
+    assert bucket_key(
+        _spec("e", precision=PrecisionPolicy(storage="float16"))
+    ) != bucket_key(_spec("f", precision=PrecisionPolicy()))
+
+
+def test_rbg_tenant_beside_threefry_tenant(tmp_path):
+    """No cross-contamination: an rbg tenant packed in a service that also
+    runs threefry and bf16 tenants finishes bit-identical to the same
+    tenant in a service of its own."""
+    from evox_tpu.service import OptimizationService
+
+    def run(specs):
+        svc = OptimizationService(
+            tempfile.mkdtemp(dir=tmp_path), lanes_per_pack=2, segment_steps=4
+        )
+        for s in specs:
+            svc.submit(s)
+        for _ in range(60):
+            if not svc.step():
+                break
+        return svc
+
+    packed = run(
+        [
+            _spec("t-thr", uid=7),
+            _spec("t-rbg", uid=9, key_impl="rbg"),
+            _spec("t-bf16", uid=11, precision=PrecisionPolicy()),
+        ]
+    )
+    solo = run([_spec("t-rbg", uid=9, key_impl="rbg")])
+    packed_r = packed.result("t-rbg")
+    solo_r = solo.result("t-rbg")
+    np.testing.assert_array_equal(
+        _f32(packed_r.algorithm.pop), _f32(solo_r.algorithm.pop)
+    )
+    # the cotenants completed too, with their own numerics
+    assert packed.result("t-bf16").algorithm.pop.dtype == jnp.bfloat16
+    assert key_impl_name(packed.result("t-thr").algorithm.key) == "threefry2x32"
+
+
+def test_tenant_checkpoint_carries_numerics_identity(tmp_path):
+    from evox_tpu.service import OptimizationService
+
+    svc = OptimizationService(
+        tmp_path / "svc", lanes_per_pack=2, segment_steps=4,
+        checkpoint_every=1,
+    )
+    svc.submit(_spec("t-bf16", uid=3, precision=PrecisionPolicy(),
+                     key_impl="rbg"))
+    for _ in range(30):
+        if not svc.step():
+            break
+    cks = sorted((tmp_path / "svc" / "tenants" / "t-bf16").glob("*.npz"))
+    if not cks:  # namespace layout fallback
+        cks = sorted((tmp_path / "svc").rglob("*.npz"))
+    manifest = read_manifest(cks[-1])
+    assert manifest["precision"] == "storage=bfloat16,compute=float32"
+    assert manifest["key_impl"] == "rbg"
+
+
+# ---------------------------------------------------------------------------
+# compile-once discipline
+# ---------------------------------------------------------------------------
+
+
+def test_policy_and_impl_flips_recompile_exactly_once():
+    """Flipping precision or key_impl changes the avals — ONE fresh
+    compile each, and zero extra compiles when rerunning the same
+    configuration (the exec-cache/bucket identity story in sentinel
+    form)."""
+    from tools.graftlint import CompileSentinel
+
+    configs = {
+        "f32_threefry": _wf(),
+        "bf16_threefry": _wf(precision=PrecisionPolicy()),
+        "bf16_rbg": _pol_wf(),
+    }
+    states = {}
+    for name, wf in configs.items():
+        st = wf.init(0)
+        states[name] = jax.block_until_ready(jax.jit(wf.init_step)(st))
+
+    steps = {name: jax.jit(wf.step) for name, wf in configs.items()}
+    with CompileSentinel() as sentinel:
+        for name in configs:
+            st = states[name]
+            for _ in range(5):
+                st = steps[name](st)
+        jax.block_until_ready(st)
+    sentinel.assert_compiles(3, match="step", exact=True)
+
+    # same configurations again, same jitted callables: zero compiles
+    with CompileSentinel() as sentinel:
+        for name in configs:
+            st = states[name]
+            for _ in range(3):
+                st = steps[name](st)
+        jax.block_until_ready(st)
+    sentinel.assert_compiles(0, match="step", exact=True)

@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Precision-plane gate: throughput twins + accuracy gates for the
+mixed-precision / partitionable-PRNG fast path (``./run_tests.sh
+--precision``).
+
+What it pins (ISSUE 15's contract):
+
+1. **Accuracy gates — always enforced.**  A ``PrecisionPolicy`` that
+   degrades convergence must fail CI, on any backend: PSO final best
+   fitness (bf16+rbg vs f32/threefry, fused segments) within
+   ``SO_TOL_FACTOR`` of the reference, and NSGA-II final IGD within
+   ``MO_TOL_FACTOR``.
+2. **End-to-end fast path.**  ``PrecisionPolicy(storage=bf16)`` +
+   ``key_impl="rbg"`` runs the *resilient fused* path (ResilientRunner,
+   checkpoint + resume) and the resumed run is bit-identical to an
+   uninterrupted one — the matrix entry the tests pin per-feature,
+   smoked here end-to-end so the lane fails fast if the plane regresses.
+3. **Throughput twins — gated on TPU, recorded as CPU-provisional
+   otherwise.**  The bf16+rbg policy config must be at least
+   ``TPU_SPEED_FLOOR`` x the f32/threefry twin on a real TPU (the
+   measured lever is +75% at the north-star shape; the lane-scale twin
+   gates a conservative floor).  CPU containers have no hardware rbg and
+   no bf16 datapath, so the CPU run records ``indicative_only``
+   BENCH_HISTORY.json entries for ``tools/run_tpu_sweep.sh`` to
+   re-anchor (joined by ``tools/check_bench_history.py``) instead of
+   gating a number the hardware cannot produce.
+
+Run via::
+
+    ./run_tests.sh --precision       # suite + graftlint + this gate
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_precision.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from evox_tpu.algorithms import NSGA2, PSO  # noqa: E402
+from evox_tpu.precision import PrecisionPolicy  # noqa: E402
+from evox_tpu.problems.numerical import DTLZ2, Sphere  # noqa: E402
+from evox_tpu.resilience import ResilientRunner  # noqa: E402
+from evox_tpu.workflows import StdWorkflow  # noqa: E402
+
+# Lane-scale throughput twin (north-star structure, CPU-feasible size).
+POP, DIM = 8192, 256
+N_STEPS = 100
+CHUNK = 25
+REPEATS = 3
+TPU_SPEED_FLOOR = 1.0  # policy must BEAT the f32 twin on real hardware
+
+# Accuracy gates (enforced everywhere).
+SO_TOL_FACTOR = 1.25
+MO_TOL_FACTOR = 1.15
+
+_HISTORY_PATH = os.path.join(REPO, "BENCH_HISTORY.json")
+
+
+def _pso_wf(policy: bool):
+    lb, ub = jnp.full((DIM,), -10.0), jnp.full((DIM,), 10.0)
+    kwargs = (
+        {"precision": PrecisionPolicy(), "key_impl": "rbg"} if policy else {}
+    )
+    return StdWorkflow(PSO(POP, lb, ub), Sphere(), **kwargs)
+
+
+def _fused_sweep(wf):
+    run_chunk = jax.jit(lambda s: wf.run(s, CHUNK, init=False))
+
+    def sweep(state):
+        for _ in range(N_STEPS // CHUNK):
+            state = run_chunk(state)
+        return jax.block_until_ready(state)
+
+    return sweep
+
+
+def measure_throughput() -> dict:
+    """Interleaved A/B fused-loop timings: f32/threefry vs bf16+rbg."""
+    prepped = {}
+    for tag, policy in (("f32_threefry", False), ("bf16_rbg", True)):
+        wf = _pso_wf(policy)
+        state = wf.init(0)
+        state = jax.block_until_ready(jax.jit(wf.init_step)(state))
+        sweep = _fused_sweep(wf)
+        sweep(state)  # warm
+        prepped[tag] = (state, sweep, [])
+    for _ in range(REPEATS):
+        for tag, (state, sweep, times) in prepped.items():
+            t0 = time.perf_counter()
+            sweep(state)
+            times.append(time.perf_counter() - t0)
+    return {
+        tag: N_STEPS / statistics.median(times)
+        for tag, (_, _, times) in prepped.items()
+    }
+
+
+def accuracy_gates() -> dict:
+    """Final-fitness (PSO) and IGD (NSGA-II) accuracy of the policy vs
+    the f32 reference at CPU-feasible shapes; raises RuntimeError on
+    degradation past tolerance.  The harness IS bench.py's
+    ``_policy_quality_so`` / ``_policy_quality_igd`` — one definition of
+    the run shape, final metrics, eps, and (negative-reference-safe)
+    band arithmetic for the CI gate and the bench configs, so the two
+    can never drift."""
+    from bench import _policy_quality_igd, _policy_quality_so
+
+    qlb, qub = jnp.full((128,), -10.0), jnp.full((128,), 10.0)
+    so = _policy_quality_so(
+        lambda: StdWorkflow(PSO(2048, qlb, qub), Sphere()),
+        lambda: StdWorkflow(
+            PSO(2048, qlb, qub),
+            Sphere(),
+            precision=PrecisionPolicy(),
+            key_impl="rbg",
+        ),
+        tol_factor=SO_TOL_FACTOR,
+    )
+
+    d, m, qpop = 12, 3, 256
+    mo = _policy_quality_igd(
+        lambda: StdWorkflow(
+            NSGA2(qpop, m, jnp.zeros(d), jnp.ones(d)), DTLZ2(d=d, m=m)
+        ),
+        lambda: StdWorkflow(
+            NSGA2(qpop, m, jnp.zeros(d), jnp.ones(d)),
+            DTLZ2(d=d, m=m),
+            precision=PrecisionPolicy(),
+            key_impl="rbg",
+        ),
+        DTLZ2(d=d, m=m).pf(),
+        tol_factor=MO_TOL_FACTOR,
+    )
+    return {"so": so, "mo": mo}
+
+
+def resilient_e2e() -> dict:
+    """bf16+rbg on the resilient fused path: checkpoint mid-run, resume,
+    and match the uninterrupted run bit-for-bit."""
+
+    def mk():
+        lb, ub = jnp.full((16,), -5.0), jnp.full((16,), 5.0)
+        return StdWorkflow(
+            PSO(64, lb, ub),
+            Sphere(),
+            precision=PrecisionPolicy(),
+            key_impl="rbg",
+        )
+
+    root = tempfile.mkdtemp(prefix="bench_precision_")
+    wf = mk()
+    runner = ResilientRunner(
+        wf, os.path.join(root, "run"), checkpoint_every=8
+    )
+    partial = runner.run(wf.init(0), 16)
+    del partial
+    resumed = ResilientRunner(
+        mk(), os.path.join(root, "run"), checkpoint_every=8
+    ).run(mk().init(0), 40)
+    uninterrupted = ResilientRunner(
+        mk(), os.path.join(root, "clean"), checkpoint_every=8
+    ).run(mk().init(0), 40)
+    identical = bool(
+        np.array_equal(
+            np.asarray(resumed.algorithm.pop.astype(jnp.float32)),
+            np.asarray(uninterrupted.algorithm.pop.astype(jnp.float32)),
+        )
+        and np.array_equal(
+            np.asarray(jax.random.key_data(resumed.algorithm.key)),
+            np.asarray(jax.random.key_data(uninterrupted.algorithm.key)),
+        )
+    )
+    if not identical:
+        raise RuntimeError(
+            "resilient e2e FAILED: bf16+rbg resume is not bit-identical "
+            "to the uninterrupted run"
+        )
+    return {"resume_bit_identical": True, "storage_dtype": "bfloat16"}
+
+
+def _record_history(platform: str, gps: dict) -> list[str]:
+    """First-run creation of the lane's BENCH_HISTORY rows (TPU rows gate
+    future sweeps; CPU rows are indicative_only awaiting the TPU
+    re-anchor — the same convention every CPU-provisional entry uses)."""
+    metrics = {
+        (
+            f"Precision-lane PSO gens/sec, f32/threefry fused "
+            f"(pop={POP}, dim={DIM}, Sphere, {CHUNK}-gen chunks)"
+        ): gps["f32_threefry"],
+        (
+            f"Precision-lane PSO gens/sec, PrecisionPolicy(bf16)+rbg fused "
+            f"(pop={POP}, dim={DIM}, Sphere, {CHUNK}-gen chunks)"
+        ): gps["bf16_rbg"],
+    }
+    history = {}
+    if os.path.exists(_HISTORY_PATH):
+        try:
+            with open(_HISTORY_PATH) as f:
+                history = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            history = {}
+    created = []
+    for metric, value in metrics.items():
+        entry = history.get(metric)
+        if entry is not None and not (
+            platform == "tpu" and entry.get("platform") == "cpu"
+        ):
+            continue  # anchored already (TPU re-anchor replaces CPU rows)
+        record = {
+            "baseline": round(value, 3),
+            "platform": platform,
+            "device_kind": jax.devices()[0].device_kind,
+            "n_runs": REPEATS,
+        }
+        if platform != "tpu":
+            record["indicative_only"] = True
+            record["note"] = (
+                "CPU-provisional: no hardware rbg / bf16 datapath on this "
+                "host; tools/run_tpu_sweep.sh re-anchors"
+            )
+        history[metric] = record
+        created.append(metric)
+    if created:
+        with open(_HISTORY_PATH, "w") as f:
+            json.dump(history, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return created
+
+
+def main() -> int:
+    platform = jax.default_backend()
+    quality = accuracy_gates()
+    e2e = resilient_e2e()
+    gps = measure_throughput()
+    ratio = gps["bf16_rbg"] / gps["f32_threefry"]
+    created = _record_history(platform, gps)
+    result = {
+        "bench": "precision_plane",
+        "backend": platform,
+        "pop": POP,
+        "dim": DIM,
+        "n_steps": N_STEPS,
+        "chunk": CHUNK,
+        "f32_threefry_gens_per_sec": round(gps["f32_threefry"], 3),
+        "bf16_rbg_gens_per_sec": round(gps["bf16_rbg"], 3),
+        "speedup": round(ratio, 4),
+        "tpu_speed_floor": TPU_SPEED_FLOOR,
+        "speed_gated": platform == "tpu",
+        "quality": quality,
+        "resilient_e2e": e2e,
+        "history_rows_created": created,
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, f"precision_plane.{platform}.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"precision plane: bf16+rbg {gps['bf16_rbg']:.1f} gen/s vs "
+        f"f32/threefry {gps['f32_threefry']:.1f} gen/s = {ratio:.2f}x "
+        f"({'GATED' if platform == 'tpu' else 'CPU-provisional, recorded'}); "
+        f"accuracy gates green (SO {quality['so']['policy']:.4g} vs ref "
+        f"{quality['so']['ref']:.4g}, MO igd {quality['mo']['policy']:.4g} "
+        f"vs ref {quality['mo']['ref']:.4g}); resilient resume "
+        f"bit-identical"
+    )
+    print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+    if platform == "tpu" and ratio < TPU_SPEED_FLOOR:
+        print(
+            f"FAIL: bf16+rbg is {ratio:.2f}x the f32/threefry twin on TPU "
+            f"(floor {TPU_SPEED_FLOOR}x) — the fast path is not fast",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,6 +38,7 @@ NAMESPACES = [
     ("operators.mutation", "evox_tpu.operators.mutation"),
     ("operators.sampling", "evox_tpu.operators.sampling"),
     ("workflows", "evox_tpu.workflows"),
+    ("precision", "evox_tpu.precision"),
     ("resilience", "evox_tpu.resilience"),
     ("service", "evox_tpu.service"),
     ("obs", "evox_tpu.obs"),

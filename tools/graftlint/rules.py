@@ -39,6 +39,14 @@ violation into a machine-checked finding:
   gating at segment boundaries, process-keyed fault schedules inside
   ``io_callback`` hooks) is the sanctioned pattern and is out of compiled
   scope by construction.
+* **GL008** — numerics discipline in compiled scope: hard ``float64``
+  references (TPUs have no native f64; XLA emulates it at a large
+  compute+bytes cost), the implicit-promotion ``dtype=float`` builtin,
+  and unannotated dtype-mixing — a state leaf ``.astype``-ed to a
+  hard-coded float dtype outside the mixed-precision plane's one
+  promote/demote seam (``StdWorkflow._step``; see
+  ``evox_tpu.precision``).  Casting to an existing leaf's ``.dtype`` is
+  policy-preserving and stays clean.
 
 **Compiled scope.**  GL002-GL005 only apply inside functions that trace
 under ``jax.jit``: methods/functions named ``step``/``init_step``/
@@ -1596,6 +1604,218 @@ class ProcessBranchRule(Rule):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# GL008 — f64 / unannotated dtype-mixing in compiled scope (precision plane)
+# ---------------------------------------------------------------------------
+
+
+class DtypeDisciplineRule(Rule):
+    code = "GL008"
+    title = "f64 / unannotated dtype-mixing in compiled scope"
+    hint = (
+        "TPUs have no native float64 (XLA emulates it at a massive "
+        "throughput cost) and the mixed-precision plane "
+        "(evox_tpu.precision) owns every storage<->compute cast at ONE "
+        "seam in StdWorkflow._step; a hard-coded f64 dtype — or an ad-hoc "
+        "float `.astype` on a state leaf inside compiled scope — either "
+        "silently multiplies the run's HBM bytes or silently moves a leaf "
+        "across the precision boundary behind the policy's back.  Use the "
+        "compute dtype, cast to an existing leaf's `.dtype` "
+        "(policy-preserving), or route the cast through a PrecisionPolicy "
+        "leaf map"
+    )
+
+    # Hard-coded float dtype tails: the rule only fires on LITERAL dtype
+    # targets — `x.astype(other.dtype)` and variable dtypes are
+    # policy-preserving/unknowable and stay clean.
+    _F64_TAILS = frozenset({"float64", "double"})
+    _FLOAT_TAILS = frozenset({"float64", "float32", "float16", "bfloat16"})
+    # Names a compiled function's evolving-state parameter goes by (the
+    # same convention the taint seeds use): `state.leaf.astype(...)` /
+    # `state["leaf"].astype(...)` with one of these receivers is a state
+    # leaf crossing a dtype boundary outside the policy seam.
+    _STATE_NAMES = frozenset({"state", "carry", "st", "new_st", "algo_state"})
+
+    def check(self, mod: Module) -> list[Finding]:
+        src = mod.source
+        # Cheap pre-filter: "float" (not "float64") so the implicit-f64
+        # `dtype=float` builtin — a documented GL008 case — cannot slip
+        # through a file that never spells the full dtype name.
+        if (
+            "float" not in src
+            and "double" not in src
+            and "astype" not in src
+        ):
+            return []  # cheap pre-filter
+        # Compiled scope: the step-family closure plus loop-body roots
+        # (the same scope GL007 analyzes); host-callback defs are exempt.
+        roots: list[ast.AST] = list(compiled_functions(mod))
+        covered = {
+            id(n)
+            for r in roots
+            for n in ast.walk(r)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        body_roots = [
+            fn
+            for fid, fn in _loop_body_functions(mod).items()
+            if fid not in covered
+        ]
+        nested_in_body: set[int] = set()
+        for fn in body_roots:
+            nested_in_body.update(
+                id(n)
+                for n in ast.walk(fn)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn
+            )
+        roots.extend(fn for fn in body_roots if id(fn) not in nested_in_body)
+        findings: list[Finding] = []
+        for fn in roots:
+            findings.extend(self._check_root(mod, fn))
+        return findings
+
+    @classmethod
+    def _dtype_tail(cls, node: ast.AST) -> str | None:
+        """The literal dtype a node names, if any: a dotted attribute tail
+        (``jnp.float64`` -> "float64"), a bare ``float64`` name, or a
+        string constant ``"float64"``."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        tail = (_dotted(node) or "").rsplit(".", 1)[-1]
+        return tail or None
+
+    def _is_state_leaf(self, node: ast.AST) -> bool:
+        """``state.leaf`` / ``state["leaf"]`` for a conventional state
+        receiver name — the expressions whose dtype IS the storage policy."""
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            base = node.value
+            return isinstance(base, ast.Name) and base.id in self._STATE_NAMES
+        return False
+
+    def _check_root(self, mod: Module, fn: ast.AST) -> list[Finding]:
+        host = _host_callback_names(fn)
+        host_nodes: set[int] = set()
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name in host
+            ):
+                host_nodes.update(id(x) for x in ast.walk(n))
+        # f64 references inside COMPARISONS are f64-AVOIDANCE guards
+        # (`if x.dtype == jnp.float64: ...` — code upholding the rule's
+        # intent), not f64 construction: exempt them from case (1).
+        compare_nodes: set[int] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Compare):
+                compare_nodes.update(id(x) for x in ast.walk(n))
+
+        findings: list[Finding] = []
+        flagged: set[int] = set()
+        for node in ast.walk(fn):
+            if id(node) in host_nodes or not hasattr(node, "lineno"):
+                continue
+            if node.lineno in flagged:
+                continue
+            # (1) hard f64: a dotted `<numpy-ish>.float64`/`.double`
+            # reference or a bare `float64` name (never a bare `double` —
+            # that is an ordinary variable name), a "float64" dtype
+            # string, or the implicit-promotion form `dtype=float` (the
+            # Python builtin is f64 under x64).
+            if (
+                isinstance(node, (ast.Attribute, ast.Name))
+                and id(node) not in compare_nodes
+            ):
+                if isinstance(node, ast.Name):
+                    hit = node.id == "float64"
+                else:
+                    dotted = _dotted(node) or ""
+                    head, _, tail = dotted.rpartition(".")
+                    numpyish = head.rsplit(".", 1)[-1] in (
+                        "np",
+                        "jnp",
+                        "numpy",
+                        "jax",
+                    )
+                    hit = tail == "float64" or (
+                        tail in self._F64_TAILS and numpyish
+                    )
+                if hit:
+                    flagged.add(node.lineno)
+                    findings.append(
+                        self.finding(
+                            mod,
+                            node,
+                            "float64 referenced in compiled scope — TPUs "
+                            "have no native f64; XLA emulation multiplies "
+                            "both compute and HBM bytes",
+                        )
+                    )
+                    continue
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg != "dtype" or id(kw.value) in host_nodes:
+                        continue
+                    tail = self._dtype_tail(kw.value)
+                    implicit = (
+                        isinstance(kw.value, ast.Name)
+                        and kw.value.id == "float"
+                    )
+                    if (
+                        tail in self._F64_TAILS or implicit
+                    ) and node.lineno not in flagged:
+                        flagged.add(node.lineno)
+                        findings.append(
+                            self.finding(
+                                mod,
+                                kw.value,
+                                "dtype=float64 (or the implicit-f64 "
+                                "`dtype=float` builtin) in compiled scope",
+                            )
+                        )
+                # (2) unannotated dtype-mixing: a state leaf `.astype`-ed
+                # to a hard-coded FLOAT dtype outside the policy seam.
+                # Integer/bool casts (index math) and `.astype(x.dtype)`
+                # (policy-preserving) stay clean.
+                dtype_arg = None
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and self._is_state_leaf(node.func.value)
+                ):
+                    # Positional or keyword spelling — `.astype(f32)` and
+                    # `.astype(dtype=f32)` are the same crossing.
+                    dtype_arg = node.args[0] if node.args else next(
+                        (kw.value for kw in node.keywords if kw.arg == "dtype"),
+                        None,
+                    )
+                if dtype_arg is not None:
+                    tail = self._dtype_tail(dtype_arg)
+                    # `.astype(float)` is the implicit-f64 builtin —
+                    # the same promotion the dtype= keyword check flags.
+                    implicit = (
+                        isinstance(dtype_arg, ast.Name)
+                        and dtype_arg.id == "float"
+                    )
+                    if (
+                        tail in self._FLOAT_TAILS or implicit
+                    ) and node.lineno not in flagged:
+                        flagged.add(node.lineno)
+                        findings.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"state leaf cast to a hard-coded float "
+                                f"dtype ({tail or 'the implicit-f64 float builtin'}) "
+                                f"inside compiled scope — "
+                                f"an unannotated crossing of the storage/"
+                                f"compute boundary the PrecisionPolicy "
+                                f"seam owns",
+                            )
+                        )
+        return findings
+
+
 RULES: list[Rule] = [
     BareAssertRule(),
     KeyReuseRule(),
@@ -1605,5 +1825,6 @@ RULES: list[Rule] = [
     ImpureStepRule(),
     AxisIndexFoldRule(),
     ProcessBranchRule(),
+    DtypeDisciplineRule(),
 ]
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -22,7 +22,9 @@ for cfg in $PROFILE_CFGS; do
   rm -rf "bench_artifacts/profile_${cfg}"
 done
 rm -f bench_artifacts/nsga2_dtlz2_pallas.tpu.json \
-      bench_artifacts/pso_northstar_pallas.tpu.json
+      bench_artifacts/pso_northstar_pallas.tpu.json \
+      bench_artifacts/crowding_50k_pallas.tpu.json \
+      bench_artifacts/topk_50k_pallas.tpu.json
 
 echo "=== sweep start $(date -u +%H:%M:%S) ==="
 # Every artifact records n_processes (jax.process_count()) alongside
@@ -116,6 +118,17 @@ if python -m evox_tpu.ops.pallas_gate; then
   EVOX_TPU_BENCH_CHILD_TIMEOUT=3600 \
   python bench.py --config pso_northstar_pallas --runs 3 --platform tpu --no-probe \
     || echo "PALLAS PSO BENCH FAILED rc=$?"
+  # The PR-15 kernel program: crowding-distance and masked top-k twins —
+  # XLA references already measured in the --all sweep (crowding_50k /
+  # topk_50k); these record the kernel side so THIS sweep decides the
+  # winners empirically (the dominance kernel's recorded loss is
+  # re-measured above via nsga2_dtlz2_pallas's explicit opt-in).
+  echo "=== pallas OK -> measuring crowding_50k_pallas $(date -u +%H:%M:%S) ==="
+  python bench.py --config crowding_50k_pallas --runs 3 --platform tpu --no-probe \
+    || echo "PALLAS CROWDING BENCH FAILED rc=$?"
+  echo "=== pallas OK -> measuring topk_50k_pallas $(date -u +%H:%M:%S) ==="
+  python bench.py --config topk_50k_pallas --runs 3 --platform tpu --no-probe \
+    || echo "PALLAS TOPK BENCH FAILED rc=$?"
   python tools/update_baseline.py || true
 else
   cp ~/.evox_tpu_pallas_probe.json bench_artifacts/pallas_probe_verdict.json 2>/dev/null
