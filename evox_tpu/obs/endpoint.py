@@ -24,6 +24,17 @@ Routes (all ``GET``, all read-only):
 * ``/flightz/<tenant_id>`` — the tenant's flight-recorder ring window as
   JSON rows (404 for unknown tenants / no recorder).
 
+One optional **write** surface rides the same port: every request under
+``/api/`` (any method — the gateway uses POST/DELETE/GET) is delegated
+verbatim to the ``api=`` callable when one is wired
+(:class:`~evox_tpu.service.Gateway` is the only in-repo owner).  The
+endpoint stays transport only: it reads the bounded request body, hands
+``(method, raw_path, headers, body)`` over, and writes back whatever
+``(status, content_type, body, extra_headers)`` comes out — routing,
+auth, idempotency, and journal ordering are entirely the API handler's
+contract.  Without ``api=``, ``/api/...`` is a 404 like any other
+unknown path and the server remains read-only GET.
+
 Providers are plain callables so any owner — daemon, fleet supervisor, a
 bare script — wires exactly the surface it has.  ``port=0`` binds an
 OS-assigned port (tests); the bound port is readable at ``.port`` after
@@ -44,6 +55,12 @@ from .metrics import MetricsRegistry
 
 __all__ = ["IntrospectionEndpoint"]
 
+# Largest request body /api/ accepts.  A pickled TenantSpec for any
+# realistic population is a few KiB; 8 MiB leaves room for large catalog
+# payloads while bounding what an unauthenticated peer can make a
+# handler thread buffer.
+MAX_API_BODY = 8 * 1024 * 1024
+
 
 class _Handler(BaseHTTPRequestHandler):
     """One request.  All routing lives here; the endpoint instance rides
@@ -58,12 +75,52 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, format: str, *args: Any) -> None:  # noqa: A002
         pass  # scrapes are high-frequency; stderr spam helps nobody
 
+    def do_POST(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler contract
+        self._write_method("POST")
+
+    def do_DELETE(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler contract
+        self._write_method("DELETE")
+
+    def _write_method(self, method: str) -> None:
+        """POST/DELETE exist only for the ``/api/`` surface."""
+        endpoint: "IntrospectionEndpoint" = self.server.endpoint  # type: ignore[attr-defined]
+        try:
+            path = urlparse(self.path).path
+            endpoint._count(path)
+            if path.startswith("/api/") and endpoint.api is not None:
+                self._api(endpoint, method)
+            elif path.startswith("/api/"):
+                self._respond(
+                    404,
+                    "application/json",
+                    json.dumps({"error": "no api handler wired"}),
+                )
+            else:
+                self._respond(
+                    405,
+                    "application/json",
+                    json.dumps({"error": f"{method} only serves /api/ paths"}),
+                )
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 - fail-safe by contract
+            try:
+                self._respond(
+                    500,
+                    "application/json",
+                    json.dumps({"error": f"{type(e).__name__}: {e}"}),
+                )
+            except Exception:  # pragma: no cover - socket already gone
+                pass
+
     def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler contract
         endpoint: "IntrospectionEndpoint" = self.server.endpoint  # type: ignore[attr-defined]
         try:
             path = urlparse(self.path).path
             endpoint._count(path)
-            if path == "/metrics":
+            if path.startswith("/api/") and endpoint.api is not None:
+                self._api(endpoint, "GET")
+            elif path == "/metrics":
                 self._metrics(endpoint)
             elif path == "/healthz":
                 self._healthz(endpoint)
@@ -95,6 +152,31 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
 
     # -- routes --------------------------------------------------------------
+    def _api(self, endpoint: "IntrospectionEndpoint", method: str) -> None:
+        """Delegate one ``/api/`` request to the wired API handler.
+
+        The handler owns routing/auth/journal ordering; this side only
+        enforces the transport bounds (body size) and the reply shape.
+        """
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except (TypeError, ValueError):
+            length = -1
+        if length < 0 or length > MAX_API_BODY:
+            self._respond(
+                413,
+                "application/json",
+                json.dumps(
+                    {"error": f"request body must be 0..{MAX_API_BODY} bytes"}
+                ),
+            )
+            return
+        body = self.rfile.read(length) if length else b""
+        status, content_type, payload, extra = endpoint.api(  # type: ignore[misc]
+            method, self.path, dict(self.headers.items()), body
+        )
+        self._respond(int(status), str(content_type), payload, extra)
+
     def _metrics(self, endpoint: "IntrospectionEndpoint") -> None:
         provider = endpoint.metrics
         if provider is None:
@@ -164,12 +246,20 @@ class _Handler(BaseHTTPRequestHandler):
             json.dumps({"tenant_id": tenant_id, "rows": list(rows)}),
         )
 
-    def _respond(self, status: int, content_type: str, body: str) -> None:
-        data = body.encode("utf-8")
+    def _respond(
+        self,
+        status: int,
+        content_type: str,
+        body: str | bytes,
+        extra_headers: dict[str, str] | None = None,
+    ) -> None:
+        data = body if isinstance(body, bytes) else body.encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         self.send_header("Cache-Control", "no-store")
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, str(value))
         self.end_headers()
         self.wfile.write(data)
 
@@ -186,6 +276,11 @@ class IntrospectionEndpoint:
         document.
     :param flight: callable mapping a tenant id to its flight-ring rows
         (a list of dicts) or ``None`` (404) for ``/flightz/<tenant_id>``.
+    :param api: callable serving every ``/api/...`` request (any method):
+        ``(method, raw_path, headers, body_bytes) -> (status,
+        content_type, body_str_or_bytes, extra_headers_or_None)``.  The
+        raw path keeps its query string.  ``None`` (default) leaves the
+        server read-only GET.
     :param registry: shorthand: wires ``metrics`` to this registry's
         ``to_prometheus`` when no explicit ``metrics`` callable is given.
     :param instrument: optional registry the endpoint counts its own
@@ -204,6 +299,11 @@ class IntrospectionEndpoint:
         healthz: Callable[[], tuple[bool, Any]] | None = None,
         statusz: Callable[[], Any] | None = None,
         flight: Callable[[str], Any] | None = None,
+        api: Callable[
+            [str, str, dict[str, str], bytes],
+            tuple[int, str, "str | bytes", "dict[str, str] | None"],
+        ]
+        | None = None,
         registry: MetricsRegistry | None = None,
         instrument: MetricsRegistry | None = None,
         host: str = "127.0.0.1",
@@ -215,6 +315,7 @@ class IntrospectionEndpoint:
         self.healthz = healthz
         self.statusz = statusz
         self.flight = flight
+        self.api = api
         self.instrument = instrument
         self.host = str(host)
         self._requested_port = int(port)
@@ -276,6 +377,8 @@ class IntrospectionEndpoint:
             # as label values would grow immortal series without bound.
             if path.startswith("/flightz"):
                 label = "/flightz"
+            elif path.startswith("/api"):
+                label = "/api"
             elif path in ("/metrics", "/healthz", "/statusz", "/", ""):
                 label = path or "/"
             else:

@@ -49,11 +49,15 @@ from .metrics import MetricsRegistry
 
 __all__ = ["SLO", "SLOTracker", "SLOStatus", "default_slos"]
 
-# The three signal streams the serving stack feeds (callers may define
-# their own signal names freely; these are the conventional ones).
+# The signal streams the serving stack feeds (callers may define their
+# own signal names freely; these are the conventional ones).
 SIGNAL_SEGMENT_SECONDS = "segment_seconds"
 SIGNAL_TENANT_GENS = "tenant_gens_per_sec"
 SIGNAL_ADMISSION = "admission"
+# Gateway request availability: pre-judged events (good = the request
+# was served without a 5xx; 4xx client mistakes are good events — the
+# service answered correctly).  Fed by evox_tpu.service.Gateway.
+SIGNAL_GATEWAY = "gateway_availability"
 
 
 @dataclass(frozen=True)

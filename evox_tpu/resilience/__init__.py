@@ -50,6 +50,12 @@ This subsystem adds the missing layer:
   flips, ``ENOSPC``/``EIO``, crash-between-temp-and-rename, and slow disks
   by save schedule, so the checkpoint pipeline itself (including mid-write
   preemption and GC ordering) is testable deterministically.
+* :class:`FaultyTransport` (``transport.py``) — the wire-side chaos twin:
+  dropped/duplicated/torn/delayed requests **and replies** by request
+  schedule, wrapping the gateway client's transport seam, so the network
+  front door's exactly-once admission contract is testable
+  deterministically (the dropped-*reply* case is the post-journal-append
+  crash window seen from the wire).
 * Elastic topology (``elastic.py``) — checkpoint manifests record the mesh
   topology they were written under (:class:`MeshTopology`), and the runner's
   resume **re-meshes**: a run checkpointed on an N-device ``pop`` mesh
@@ -115,6 +121,7 @@ from .restart import (
     incumbent_best,
     perturb_prng_keys,
 )
+from .transport import FaultyTransport, TransportError
 from .runner import (
     CheckpointSkip,
     ResilienceError,
@@ -160,6 +167,8 @@ __all__ = [
     "perturb_prng_keys",
     "FaultyProblem",
     "FaultyStore",
+    "FaultyTransport",
+    "TransportError",
     "InjectedBackendError",
     "InjectedFatalError",
     "InjectedStorageError",

@@ -24,9 +24,22 @@ programs persist across restarts (zero cold-start via
 brown-out cadence stretching) — kill the daemon at any point and a
 restart reconstructs the exact service state with no lost acknowledged
 work and no XLA compile on the hot path.
+
+:class:`Gateway` + :class:`GatewayClient` (PR 16) are the network front
+door on the daemon's endpoint plane: authenticated submit/steer/withdraw/
+fetch over HTTP where every mutating reply is sent only after the journal
+append, client idempotency keys ride the journal for exactly-once
+admission across retries AND daemon restarts, bearer-token principals
+namespace tenant ids (and thus checkpoint/flight directories), and
+overload maps to 429/503 with ``Retry-After`` from the live measured
+segment cadence — chaos-tested by
+:class:`~evox_tpu.resilience.FaultyTransport` and a kill-at-every-
+boundary HTTP matrix.
 """
 
-from .daemon import DaemonStats, ServiceDaemon, TenantClass
+from .client import GatewayClient, GatewayError, HttpTransport, encode_spec
+from .daemon import STEER_KNOBS, DaemonStats, ServiceDaemon, TenantClass
+from .gateway import Gateway
 from .journal import JournalDamage, JournalError, JournalRecord, RequestJournal
 from .pack import TenantPack, assign_fault_lane
 from .service import (
@@ -34,6 +47,7 @@ from .service import (
     OptimizationService,
     Rejection,
     ServiceStats,
+    retry_after_seconds,
 )
 from .tenant import (
     TenantRecord,
@@ -41,11 +55,17 @@ from .tenant import (
     TenantStatus,
     bucket_key,
     static_signature,
+    validate_tenant_id,
 )
 
 __all__ = [
     "AdmissionError",
     "DaemonStats",
+    "Gateway",
+    "GatewayClient",
+    "GatewayError",
+    "HttpTransport",
+    "STEER_KNOBS",
     "JournalDamage",
     "JournalError",
     "JournalRecord",
@@ -61,5 +81,8 @@ __all__ = [
     "TenantStatus",
     "assign_fault_lane",
     "bucket_key",
+    "encode_spec",
+    "retry_after_seconds",
     "static_signature",
+    "validate_tenant_id",
 ]

@@ -70,10 +70,21 @@ from ..resilience.preemption import Preempted, PreemptionGuard
 from ..utils.checkpoint import CheckpointStore, ReadOnlyCheckpointStore
 from ..utils.exec_cache import ExecutableCache, enable_xla_compilation_cache
 from .journal import JournalError, RequestJournal
-from .service import AdmissionError, OptimizationService
+from .service import (
+    AdmissionError,
+    OptimizationService,
+    retry_after_seconds,
+)
 from .tenant import TenantRecord, TenantSpec, TenantStatus
 
-__all__ = ["ServiceDaemon", "TenantClass", "DaemonStats"]
+__all__ = ["ServiceDaemon", "TenantClass", "DaemonStats", "STEER_KNOBS"]
+
+#: The journaled ``steer`` record's adjustable scheduling knobs: the
+#: tenant's generation budget, checkpoint cadence, and restart budget.
+#: Values only — steering changes when the scheduler acts on a tenant,
+#: never what any lane computes, which is why a replayed steer is
+#: bit-identical by construction.
+STEER_KNOBS = ("n_steps", "checkpoint_every", "max_restarts")
 
 
 @dataclass(frozen=True)
@@ -383,6 +394,14 @@ class ServiceDaemon:
         # class of each live tenant, by uid (replayed + submitted).
         self._class_by_uid: dict[int, str] = {}
         self._last_segment_seconds: float | None = None
+        # Journaled-but-not-yet-applied steer knobs, by uid: acked by
+        # :meth:`steer` (journal append BEFORE the ack, like submits) and
+        # materialized onto the tenant record at the next boundary.
+        self._pending_steer: dict[int, dict[str, int]] = {}
+        # An attached network gateway (evox_tpu.service.Gateway) registers
+        # itself here so /statusz grows a "gateway" section (request /
+        # error / retry-after counters, per-principal tenant counts).
+        self.gateway: Any | None = None
 
     # -- events / metrics ---------------------------------------------------
     def _event(self, msg: str, *, warn: bool = False, **payload: Any) -> None:
@@ -503,8 +522,14 @@ class ServiceDaemon:
                 "brownout_entries": self.stats.brownout_entries,
                 "replayed_tenants": self.stats.replayed_tenants,
                 "journal_append_failures": self.stats.journal_append_failures,
+                "steers_pending": len(self._pending_steer),
             },
         }
+        if self.gateway is not None:
+            try:
+                out["gateway"] = self.gateway.statusz_payload()
+            except Exception as e:  # noqa: BLE001 - read-only, fail-safe
+                out["gateway"] = {"error": f"{type(e).__name__}: {e}"}
         if self.exec_cache is not None:
             cache = self.exec_cache.stats
             hits = int(getattr(cache, "hits", 0))
@@ -617,6 +642,7 @@ class ServiceDaemon:
         # (at-least-once: duplicates collapse, last state wins).
         live: dict[int, dict[str, Any]] = {}
         parked: set[int] = set()
+        steers: dict[int, dict[str, int]] = {}
         for rec in records:
             uid = rec.data.get("uid")
             if uid is None:
@@ -627,18 +653,45 @@ class ServiceDaemon:
                 parked.discard(uid)
                 # A re-submit after a journaled completion (readmission
                 # with a refreshed budget) re-arms the completion record,
-                # exactly like the live submit() path.
+                # exactly like the live submit() path.  It also supersedes
+                # any earlier steering — the fresh spec carries the
+                # caller's current intent (same contract as the live
+                # submit path clearing pending steers).
                 self._journaled_complete.discard(uid)
+                steers.pop(uid, None)
             elif rec.kind == "evict":
                 parked.add(uid)
             elif rec.kind == "retire":
                 live.pop(uid, None)
                 parked.discard(uid)
                 self._journaled_complete.discard(uid)
+                steers.pop(uid, None)
             elif rec.kind == "complete":
                 # Stays live: resubmission materializes the final result
                 # from the namespace without occupying a lane.
                 self._journaled_complete.add(uid)
+            elif rec.kind == "steer":
+                if uid in live:
+                    # At-least-once: duplicate steer records collapse
+                    # (last value per knob wins, same as replaying them
+                    # in sequence).
+                    steers.setdefault(uid, {}).update(
+                        {
+                            k: int(rec.data[k])
+                            for k in STEER_KNOBS
+                            if rec.data.get(k) is not None
+                        }
+                    )
+                else:
+                    # A steer can only follow the submit that admitted
+                    # its tenant — anything else in the stream is journal
+                    # damage or a spliced tail; skip it loudly.
+                    self._event(
+                        f"journal replay: steer record #{rec.seq} targets "
+                        f"uid {uid} with no live submit before it; "
+                        f"skipped",
+                        warn=True,
+                    )
         restored = 0
         if live:
             # Replay must never bounce off the queue bound the journal
@@ -663,6 +716,17 @@ class ServiceDaemon:
                     # growth ladder, solution transform, budget) replays
                     # exactly as submitted.
                     spec = dataclass_replace(spec, uid=uid)
+                    # Acked steers materialize BEFORE resubmission: a
+                    # budget raised past a journaled completion must
+                    # resume the tenant instead of re-materializing the
+                    # stale final result.  (Live semantics are "at the
+                    # next boundary"; for a steer acked but unapplied at
+                    # the kill, resubmission IS the next boundary.)
+                    knobs = steers.get(uid, {})
+                    if "n_steps" in knobs:
+                        spec = dataclass_replace(
+                            spec, n_steps=knobs["n_steps"]
+                        )
                     try:
                         record = self.service.submit(spec)
                     except AdmissionError as e:
@@ -674,6 +738,13 @@ class ServiceDaemon:
                         )
                         continue
                     self._class_by_uid[uid] = data.get("class", "standard")
+                    record.steer.update(
+                        {
+                            k: v
+                            for k, v in knobs.items()
+                            if k in ("checkpoint_every", "max_restarts")
+                        }
+                    )
                     restored += 1
                     if uid in parked:
                         # Operator-evicted: journaled intent is "off the
@@ -739,13 +810,23 @@ class ServiceDaemon:
 
     # -- admission ----------------------------------------------------------
     def submit(
-        self, spec: TenantSpec, *, tenant_class: str = "standard"
+        self,
+        spec: TenantSpec,
+        *,
+        tenant_class: str = "standard",
+        journal_extra: dict[str, Any] | None = None,
     ) -> "TenantRecord":
         """Admit one tenant durably: SLO admission control, then the
         service's queue, then the journal — the record is fsync'd before
         this returns (the ack).  Raises :class:`AdmissionError` with a
-        structured reason (and a ``retry_after_segments`` hint for
-        overload sheds) when refused."""
+        structured reason (and ``retry_after_segments`` /
+        measured-cadence ``retry_after_seconds`` hints for overload
+        sheds) when refused.
+
+        ``journal_extra`` rides extra fields on the journaled submit
+        record (the gateway's idempotency key and principal — replay
+        rebuilds its exactly-once dedup map from them); keys must not
+        collide with the record's own fields."""
         self.start()
         cls = self.classes.get(tenant_class)
         if cls is None:
@@ -782,6 +863,7 @@ class ServiceDaemon:
                 n_steps=int(spec.n_steps),
                 **{"class": cls.name},
                 spec=_encode_spec(spec),
+                **(journal_extra or {}),
             )
         except JournalError as e:
             # Un-admit: an un-journaled tenant must not run (a crash
@@ -799,9 +881,16 @@ class ServiceDaemon:
                 "journal-failed",
                 f"the admission record could not be made durable ({e})",
                 retry_after_segments=1,
+                retry_after_seconds=retry_after_seconds(
+                    1, self._last_segment_seconds
+                ),
             )
         self._journaled_complete.discard(record.uid)
         self._class_by_uid[record.uid] = cls.name
+        # A (re)submit supersedes earlier steering: the fresh spec carries
+        # the caller's current intent (mirrors the replay fold).
+        self._pending_steer.pop(record.uid, None)
+        record.steer.clear()
         self._slo_admission(cls.name, True)
         self._gauge(
             "evox_daemon_queue_depth",
@@ -861,6 +950,7 @@ class ServiceDaemon:
     ) -> None:
         budget = cls.queue_budget if budget is None else budget
         hint = self._retry_after(cls)
+        wall = retry_after_seconds(hint, self._last_segment_seconds)
         self.stats.sheds += 1
         self._slo_admission(cls.name, False)
         self._inc(
@@ -869,9 +959,8 @@ class ServiceDaemon:
             **{"class": cls.name},
         )
         seconds = (
-            f" (~{hint * self._last_segment_seconds:.1f}s at the current "
-            f"segment cadence)"
-            if self._last_segment_seconds
+            f" (~{wall:.1f}s at the current segment cadence)"
+            if wall is not None
             else ""
         )
         tightened = (
@@ -887,6 +976,7 @@ class ServiceDaemon:
             f"({budget}{tightened}); retry after ~{hint} segment "
             f"boundaries{seconds}",
             retry_after_segments=hint,
+            retry_after_seconds=wall,
         )
 
     # -- brown-out ----------------------------------------------------------
@@ -964,10 +1054,12 @@ class ServiceDaemon:
 
     # -- lifecycle ----------------------------------------------------------
     def step(self) -> bool:  # graftlint: disable=GL005
-        """One supervised scheduling round: brown-out check, one service
-        round, then journal the round's completions.  :class:`Preempted`
-        is journaled before it propagates."""
+        """One supervised scheduling round: acked steers materialized,
+        brown-out check, one service round, then journal the round's
+        completions.  :class:`Preempted` is journaled before it
+        propagates."""
         self.start()
+        self._apply_steers()
         self._update_brownout()
         t0 = time.perf_counter()
         try:
@@ -1054,6 +1146,139 @@ class ServiceDaemon:
             if installed:
                 guard.uninstall()
             self.journal.close()
+
+    def steer(
+        self,
+        tenant_id: str,
+        *,
+        n_steps: int | None = None,
+        checkpoint_every: int | None = None,
+        max_restarts: int | None = None,
+        journal_extra: dict[str, Any] | None = None,
+    ) -> dict[str, int]:
+        """Adjust one live tenant's scheduling knobs **durably**: the
+        generation budget (``n_steps`` — raise to extend a promising run,
+        lower to wind one down at the next boundary), the checkpoint
+        cadence, and the per-tenant restart budget.  The ``steer`` record
+        is journaled BEFORE this returns (the ack — same crash-safety
+        contract as submits), and the knobs materialize at the **next
+        segment boundary**; a daemon killed between the ack and the
+        boundary replays the steer at restart, so an acked steer is never
+        lost.  Values only — steering never touches lane state, which is
+        why a steered, killed, and restarted run stays bit-identical to a
+        steered uninterrupted one.
+
+        Knobs are validated before the journal write (a doomed call
+        leaves no record): ``n_steps >= 1``, ``checkpoint_every >= 1``,
+        ``max_restarts >= 0``, at least one knob set.  Raises
+        ``KeyError`` for unknown tenants (a steer can only follow the
+        submit that admitted its tenant — the journal replay enforces
+        the same ordering) and ``RuntimeError`` for COMPLETED ones.
+        Returns the accepted knob dict.  A later (re)submit of the same
+        tenant supersedes pending steering."""
+        self.start()
+        record = self.service.tenant(tenant_id)
+        knobs: dict[str, int] = {}
+        for name, value, floor in (
+            ("n_steps", n_steps, 1),
+            ("checkpoint_every", checkpoint_every, 1),
+            ("max_restarts", max_restarts, 0),
+        ):
+            if value is None:
+                continue
+            value = int(value)
+            if value < floor:
+                raise ValueError(
+                    f"steer {name} must be >= {floor}, got {value}"
+                )
+            knobs[name] = value
+        if not knobs:
+            raise ValueError(
+                f"steer of {tenant_id!r} adjusts nothing (set at least "
+                f"one of {', '.join(STEER_KNOBS)})"
+            )
+        if record.status is TenantStatus.COMPLETED:
+            raise RuntimeError(
+                f"tenant {tenant_id!r} is completed; resubmit it (with a "
+                f"refreshed budget) instead of steering"
+            )
+        self._journal(
+            "steer",
+            required=True,
+            tenant_id=tenant_id,
+            uid=record.uid,
+            **knobs,
+            **(journal_extra or {}),
+        )
+        self._pending_steer.setdefault(record.uid, {}).update(knobs)
+        self._event(
+            f"steer acked for tenant {tenant_id!r} (uid {record.uid}): "
+            + ", ".join(f"{k}={v}" for k, v in sorted(knobs.items()))
+            + " — applies at the next segment boundary"
+        )
+        return knobs
+
+    def _apply_steers(self) -> None:
+        """Materialize acked steer knobs onto their tenant records — the
+        boundary half of :meth:`steer` (runs at the top of every
+        :meth:`step`, before the service round, so admission and verdict
+        logic in the round already sees the steered values)."""
+        if not self._pending_steer:
+            return
+        for uid, knobs in list(self._pending_steer.items()):
+            record = self.service._tenants_by_uid.get(uid)
+            del self._pending_steer[uid]
+            if record is None:  # retired between ack and boundary
+                continue
+            if "n_steps" in knobs:
+                record.spec = dataclass_replace(
+                    record.spec, n_steps=knobs["n_steps"]
+                )
+                # A raised budget re-arms a completion record exactly
+                # like the readmission path would.
+                if knobs["n_steps"] > record.generations:
+                    self._journaled_complete.discard(uid)
+            record.steer.update(
+                {
+                    k: v
+                    for k, v in knobs.items()
+                    if k in ("checkpoint_every", "max_restarts")
+                }
+            )
+            self._inc(
+                "evox_daemon_steers_applied_total",
+                "Journaled steer records materialized at a boundary.",
+            )
+            self._event(
+                f"steer applied to tenant {record.spec.tenant_id!r}: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(knobs.items()))
+            )
+
+    def park(self, tenant_id: str) -> str:
+        """Withdraw a tenant from service durably, whatever its phase:
+        a RUNNING tenant is evicted (checkpoint + lane freed — exactly
+        :meth:`evict`), a QUEUED one is withdrawn from the admission
+        queue to the same parked EVICTED status; both journal the same
+        ``evict`` record BEFORE mutating, so restart replay parks the
+        tenant either way.  The gateway's ``DELETE`` maps here.  Returns
+        the resulting status string; raises ``KeyError`` for unknown
+        tenants and ``RuntimeError`` for tenants already off a lane
+        (COMPLETED/EVICTED/QUARANTINED — nothing to withdraw)."""
+        self.start()
+        record = self.service.tenant(tenant_id)
+        if record.status is TenantStatus.RUNNING:
+            self.evict(tenant_id)
+            return record.status.value
+        if record.status is not TenantStatus.QUEUED:
+            raise RuntimeError(
+                f"tenant {tenant_id!r} is {record.status.value} and holds "
+                f"no lane or queue slot; forget it to retire the record"
+            )
+        self._journal(
+            "evict", required=True, tenant_id=tenant_id, uid=record.uid
+        )
+        self.service.withdraw(tenant_id, to_status=TenantStatus.EVICTED)
+        return record.status.value
 
     def evict(self, tenant_id: str) -> None:
         """Checkpoint + free a tenant's lane, durably.  The record is

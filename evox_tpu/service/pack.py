@@ -38,6 +38,7 @@ the segment program.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Any, Sequence
 
 import jax
@@ -211,7 +212,13 @@ class TenantPack:
             else sorted({int(n) for n in n_steps})
         )
         results: dict[str, bool] = {}
-        init_label = f"pack_init[{label}][lanes={self.lanes}]"
+        # The segment config changes the compiled program (flight
+        # telemetry adds outputs; health metrics add reductions) but not
+        # the *input* signature, so it must be part of the cache label:
+        # a daemon restarted with the flight recorder newly armed (or
+        # disarmed) must not load the other configuration's executable.
+        cfg_tag = hashlib.sha256(repr(self.cfg).encode()).hexdigest()[:8]
+        init_label = f"pack_init[{label}][lanes={self.lanes}][cfg={cfg_tag}]"
         # Abstract init pass: post-init shapes for the segment signature
         # AND the trace-time capture of the init sink metadata (meta is
         # identical under abstract evaluation — it records static site
@@ -247,7 +254,10 @@ class TenantPack:
         for n in lengths:
             if n < 1:
                 raise ValueError(f"n_steps must be >= 1, got {n}")
-            seg_label = f"pack_segment[{label}][lanes={self.lanes}][n={n}]"
+            seg_label = (
+                f"pack_segment[{label}][lanes={self.lanes}]"
+                f"[cfg={cfg_tag}][n={n}]"
+            )
             if n in self._aot_segment:
                 results[seg_label] = self._aot_from_cache.get(n, False)
                 continue

@@ -70,14 +70,42 @@ from ..utils.checkpoint import (
 )
 from ..workflows import EvalMonitor, StdWorkflow
 from .pack import TenantPack, assign_fault_lane
-from .tenant import TenantRecord, TenantSpec, TenantStatus, bucket_key
+from .tenant import (
+    TenantRecord,
+    TenantSpec,
+    TenantStatus,
+    bucket_key,
+    validate_tenant_id,
+)
 
 __all__ = [
     "OptimizationService",
     "AdmissionError",
     "ServiceStats",
     "Rejection",
+    "retry_after_seconds",
 ]
+
+
+def retry_after_seconds(
+    retry_after_segments: int | None, segment_seconds: float | None
+) -> float | None:
+    """Convert a scheduler retry hint (in segment boundaries — the
+    service's scheduling quantum) into wall-clock seconds using the
+    **measured** segment cadence.  The one shared conversion: the serving
+    daemon's ``stats.rejections`` rows, the raised
+    :class:`AdmissionError`, and the gateway's ``Retry-After`` header all
+    go through here, so a client and an operator dashboard always read
+    the same number.
+
+    Returns ``None`` when either half is unknown (no hint, or no segment
+    has been measured yet — a fabricated cadence would be worse than an
+    honest "unknown")."""
+    if retry_after_segments is None:
+        return None
+    if not segment_seconds or segment_seconds <= 0:
+        return None
+    return float(retry_after_segments) * float(segment_seconds)
 
 
 class AdmissionError(RuntimeError):
@@ -94,7 +122,11 @@ class AdmissionError(RuntimeError):
         capacity should free up; a client that waits this many boundary
         intervals before retrying lands on the first likely-free slot
         instead of hammering the queue.  ``None`` for rejects that a
-        retry cannot fix (id/uid collisions)."""
+        retry cannot fix (id/uid collisions).
+    :ivar retry_after_seconds: the same hint in wall-clock seconds via
+        the live measured segment cadence
+        (:func:`retry_after_seconds` — the serving daemon fills it in);
+        ``None`` when no cadence has been measured yet."""
 
     def __init__(
         self,
@@ -102,38 +134,52 @@ class AdmissionError(RuntimeError):
         *,
         reason: str,
         retry_after_segments: int | None = None,
+        retry_after_seconds: float | None = None,
     ):
         super().__init__(message)
         self.reason = reason
         self.retry_after_segments = (
             None if retry_after_segments is None else int(retry_after_segments)
         )
+        self.retry_after_seconds = (
+            None if retry_after_seconds is None else float(retry_after_seconds)
+        )
 
 
 class Rejection(tuple):
     """One refused submission: a ``(tenant_id, reason)`` pair (tuple-
     compatible with every pre-existing consumer) carrying the structured
-    ``retry_after_segments`` hint as an attribute — so
-    ``stats.rejections`` records exactly what the raised
-    :class:`AdmissionError` told the caller."""
+    ``retry_after_segments`` hint — and its wall-clock twin
+    ``retry_after_seconds`` (measured-cadence conversion via
+    :func:`retry_after_seconds`) — as attributes, so ``stats.rejections``
+    records exactly what the raised :class:`AdmissionError` told the
+    caller."""
 
     retry_after_segments: int | None
+    retry_after_seconds: float | None
 
     def __new__(
         cls,
         tenant_id: str,
         reason: str,
         retry_after_segments: int | None = None,
+        retry_after_seconds: float | None = None,
     ):
         self = super().__new__(cls, (tenant_id, reason))
         self.retry_after_segments = retry_after_segments
+        self.retry_after_seconds = retry_after_seconds
         return self
 
     def __getnewargs__(self):
         # tuple's default reduce passes the tuple CONTENTS to __new__,
         # which does not match this signature — without this, pickling
         # (fleet transport of ServiceStats) and deepcopy raise TypeError.
-        return (self[0], self[1], self.retry_after_segments)
+        return (
+            self[0],
+            self[1],
+            self.retry_after_segments,
+            self.retry_after_seconds,
+        )
 
 
 @dataclass
@@ -469,9 +515,15 @@ class OptimizationService:
         detail: str,
         *,
         retry_after_segments: int | None = None,
+        retry_after_seconds: float | None = None,
     ):
         self.stats.rejections.append(
-            Rejection(spec.tenant_id, reason, retry_after_segments)
+            Rejection(
+                spec.tenant_id,
+                reason,
+                retry_after_segments,
+                retry_after_seconds,
+            )
         )
         self._inc(
             "evox_service_rejections_total",
@@ -489,6 +541,7 @@ class OptimizationService:
             f"({reason}): {detail}",
             reason=reason,
             retry_after_segments=retry_after_segments,
+            retry_after_seconds=retry_after_seconds,
         )
 
     def retry_hint_segments(self) -> int:
@@ -590,7 +643,11 @@ class OptimizationService:
 
     # -- checkpoint namespaces ----------------------------------------------
     def namespace(self, tenant_id: str) -> Path:
-        """The tenant's private checkpoint directory."""
+        """The tenant's private checkpoint directory.  The id is
+        re-validated as a safe path component here (defense in depth —
+        every :class:`TenantSpec` already validated at construction, but
+        this method is also reachable with raw strings)."""
+        validate_tenant_id(tenant_id)
         return self.root / "tenants" / tenant_id
 
     def _ckpt_path(self, record: TenantRecord, generation: int) -> Path:
@@ -1142,7 +1199,9 @@ class OptimizationService:
                     )
                     continue
             if report.healthy:
-                if record.segments_since_checkpoint >= self.checkpoint_every:
+                if record.segments_since_checkpoint >= (
+                    self._tenant_checkpoint_every(record)
+                ):
                     self._checkpoint_tenant(
                         record, bucket.pack.lane_state(lane)
                     )
@@ -1151,6 +1210,17 @@ class OptimizationService:
 
     def _record_by_uid(self, uid: int) -> TenantRecord:
         return self._tenants_by_uid[uid]
+
+    # -- per-tenant steering overrides ---------------------------------------
+    # A journaled daemon ``steer`` record may shadow the service-wide
+    # restart budget / checkpoint cadence for ONE tenant (values live in
+    # ``record.steer``); every budget/cadence consult goes through these
+    # two reads so the override is honored everywhere or nowhere.
+    def _tenant_max_restarts(self, record: TenantRecord) -> int:
+        return int(record.steer.get("max_restarts", self.max_restarts))
+
+    def _tenant_checkpoint_every(self, record: TenantRecord) -> int:
+        return int(record.steer.get("checkpoint_every", self.checkpoint_every))
 
     def _evict_for_trend(self, record: TenantRecord, trend: Any) -> bool:
         """Act on a controller ``evict`` decision, through the durable
@@ -1210,7 +1280,7 @@ class OptimizationService:
             decision = self.controller.tenant_action(
                 trend,
                 restarts_used=record.restarts,
-                max_restarts=self.max_restarts,
+                max_restarts=self._tenant_max_restarts(record),
                 generation=record.generations,
                 tenant_id=record.spec.tenant_id,
             )
@@ -1248,7 +1318,7 @@ class OptimizationService:
         self, bucket: _Bucket, record: TenantRecord, report: Any
     ) -> None:
         reasons = "; ".join(report.reasons)
-        if record.restarts < self.max_restarts:
+        if record.restarts < self._tenant_max_restarts(record):
             resumed = self._resume_state(bucket, record)
             if resumed is not None:
                 state, generations = resumed
@@ -1308,7 +1378,7 @@ class OptimizationService:
         # Growths share the restart budget: a ladder at its budget
         # quarantines like any other degenerating tenant instead of
         # growing without bound.
-        if record.restarts + record.grows >= self.max_restarts:
+        if record.restarts + record.grows >= self._tenant_max_restarts(record):
             return False
         try:
             state = bucket.pack.lane_state(record.lane)
@@ -1429,6 +1499,7 @@ class OptimizationService:
             record,
             f"quarantined at generation {record.generations} (lane "
             f"frozen; restart budget "
-            f"{record.restarts}/{self.max_restarts} spent): {reasons}",
+            f"{record.restarts}/{self._tenant_max_restarts(record)} "
+            f"spent): {reasons}",
             warn=True,
         )

@@ -41,7 +41,51 @@ __all__ = [
     "TenantRecord",
     "bucket_key",
     "static_signature",
+    "validate_tenant_id",
 ]
+
+#: Upper bound on tenant id length: the id is a directory component of the
+#: checkpoint namespace and a flight-bundle path, and most filesystems cap
+#: components at 255 bytes — leave room for ``ckpt_########.npz`` siblings
+#: and principal prefixes.
+MAX_TENANT_ID_LEN = 128
+
+
+def validate_tenant_id(tenant_id: Any) -> str:
+    """Validate one externally-supplied tenant id as a **safe path
+    component** — the id names the tenant's checkpoint namespace directory
+    (``<root>/tenants/<id>/``) and its flight-bundle paths, so this is the
+    single choke point every id passes before it can touch a filesystem
+    path: :class:`TenantSpec` construction, the service's
+    :meth:`~evox_tpu.service.OptimizationService.namespace`, and the
+    network gateway (which maps the :class:`ValueError` to a structured
+    400) all call it.
+
+    Rejects (``ValueError``): non-strings, empty ids, anything outside
+    ``[A-Za-z0-9._-]`` (separators, traversal slashes, ``%``-escapes,
+    NULs...), the dot-only ids ``"."``/``".."``/``"..."``... (every
+    dot-only string — ``.`` and ``..`` are path navigation, and keeping
+    the whole family out is cheaper than reasoning about each), and ids
+    longer than ``MAX_TENANT_ID_LEN``.  Returns the id unchanged."""
+    if not isinstance(tenant_id, str) or not re.fullmatch(
+        r"[A-Za-z0-9._-]+", tenant_id or ""
+    ):
+        raise ValueError(
+            f"tenant_id must be a non-empty [A-Za-z0-9._-] string (it "
+            f"names the tenant's checkpoint namespace directory), got "
+            f"{tenant_id!r}"
+        )
+    if set(tenant_id) == {"."}:
+        raise ValueError(
+            f"tenant_id {tenant_id!r} is a dot-only path component "
+            f"('.'/'..' are directory navigation, not names)"
+        )
+    if len(tenant_id) > MAX_TENANT_ID_LEN:
+        raise ValueError(
+            f"tenant_id is {len(tenant_id)} chars; max is "
+            f"{MAX_TENANT_ID_LEN} (it becomes a filesystem path component)"
+        )
+    return tenant_id
 
 
 class TenantStatus(Enum):
@@ -129,12 +173,7 @@ class TenantSpec:
     key_impl: str | None = None
 
     def __post_init__(self) -> None:
-        if not re.fullmatch(r"[A-Za-z0-9._-]+", self.tenant_id or ""):
-            raise ValueError(
-                f"tenant_id must be a non-empty [A-Za-z0-9._-] string (it "
-                f"names the tenant's checkpoint namespace directory), got "
-                f"{self.tenant_id!r}"
-            )
+        validate_tenant_id(self.tenant_id)
         if self.n_steps < 1:
             raise ValueError(f"n_steps must be >= 1, got {self.n_steps}")
         if self.uid is not None and self.uid < 0:
@@ -200,6 +239,13 @@ class TenantRecord:
     # the pack's lane-demuxed flight telemetry, dumps postmortem bundles
     # into the tenant's own namespace on tenant-warning bus events.
     flight: Any | None = None
+    # Per-tenant scheduling-knob overrides applied by a journaled daemon
+    # ``steer`` record at a segment boundary: ``max_restarts`` /
+    # ``checkpoint_every`` here shadow the service-wide values for THIS
+    # tenant (budget changes rewrite ``spec.n_steps`` directly).  Values
+    # only, never state: steering affects when the scheduler acts, not
+    # what any lane computes.
+    steer: dict[str, int] = field(default_factory=dict)
 
 
 def _hash_code(h: "hashlib._Hash", code: Any) -> None:

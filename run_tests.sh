@@ -67,6 +67,24 @@
 #                               retention gate (artifacts under
 #                               bench_artifacts/).  Runs under a HARD
 #                               wall-clock timeout like --multihost.
+#   ./run_tests.sh --gateway    network front-door lane: the gateway suite
+#                               (bearer-token auth + per-principal tenant
+#                               namespacing, idempotency keys riding the
+#                               journal for exactly-once admission across
+#                               retries AND daemon restarts, FaultyTransport
+#                               wire chaos — dropped/duplicated/torn/delayed
+#                               requests and replies, the kill-the-daemon-at-
+#                               every-boundary matrix driven entirely over
+#                               HTTP with bit-identical results vs the
+#                               Python API, 429/503 + Retry-After from live
+#                               measured cadence, hostile-tenant-id path
+#                               safety, result/flight long-polls) — then
+#                               tools/bench_gateway.py: submit-to-first-
+#                               flight latency + the 98% per-tenant gen/s
+#                               floor under a separate-process 1 Hz
+#                               mutating HTTP client (artifact under
+#                               bench_artifacts/).  Runs under a HARD
+#                               wall-clock timeout like --multihost.
 #   ./run_tests.sh --obs        observability lane: the obs-plane suite
 #                               (event-bus ordering + JSONL rotation,
 #                               registry snapshot vs a real faulty run's
@@ -240,6 +258,16 @@ if [ "$1" = "--serve" ]; then
   timeout -k 30 "$SERVE_TIMEOUT" \
     "${CPU_ENV[@]}" python -m pytest tests/test_daemon.py -q "$@" || exit 1
   exec timeout -k 30 900 "${CPU_ENV[@]}" python tools/bench_daemon.py
+fi
+if [ "$1" = "--gateway" ]; then
+  shift
+  # Hard timeout (SIGKILL escalation), same pattern as --serve: a wedged
+  # long-poll, a stuck chaos transport, or a hung daemon restart in the
+  # kill matrix must fail loudly, never hang the lane.
+  GATEWAY_TIMEOUT="${EVOX_TPU_GATEWAY_TIMEOUT:-1500}"
+  timeout -k 30 "$GATEWAY_TIMEOUT" \
+    "${CPU_ENV[@]}" python -m pytest tests/test_gateway.py -q "$@" || exit 1
+  exec timeout -k 30 900 "${CPU_ENV[@]}" python tools/bench_gateway.py
 fi
 if [ "$1" = "--obs" ]; then
   shift

@@ -37,6 +37,7 @@ from evox_tpu.service import (
     TenantClass,
     TenantSpec,
     TenantStatus,
+    retry_after_seconds,
 )
 from evox_tpu.utils import ExecutableCache, abstract_signature
 from evox_tpu.utils.checkpoint import ReadOnlyCheckpointStore, read_manifest
@@ -724,6 +725,160 @@ def test_forget_is_durable_restart_drops_record(tmp_path):
     with pytest.raises(KeyError):
         restarted.tenant("a")
     assert restarted.tenant("b").status is TenantStatus.COMPLETED
+
+
+# -- steer: journaled knob adjustments under replay chaos --------------------
+
+
+def test_steer_is_durable_kill_restart_bit_identical(tmp_path):
+    """A steer acked mid-run, then SIGKILL before the knobs materialize:
+    the restart replays the steer record, and the finished run is
+    bit-identical to an uninterrupted daemon steered the same way."""
+    ref = make_daemon(tmp_path / "ref")
+    ref.start()
+    ref.submit(pso_spec("t0", 0, n_steps=8))
+    ref.steer("t0", n_steps=16, checkpoint_every=2)
+    run_silently(ref)
+    expected = ref.result("t0")
+    _, expected_digests = last_checkpoint_digests(tmp_path / "ref", "t0")
+    ref.close()
+
+    root = tmp_path / "svc"
+    daemon = make_daemon(root)
+    daemon.start()
+    daemon.submit(pso_spec("t0", 0, n_steps=8))
+    run_silently(daemon, max_rounds=1)
+    daemon.steer("t0", n_steps=16, checkpoint_every=2)
+    del daemon  # SIGKILL: ack journaled, knobs never applied
+
+    restarted = make_daemon(root)
+    assert silent(restarted.start) == 1
+    # The replayed spec already carries the steered budget, and the
+    # cadence knob is on the record.
+    assert restarted.tenant("t0").spec.n_steps == 16
+    assert restarted.tenant("t0").steer["checkpoint_every"] == 2
+    run_silently(restarted)
+    record = restarted.tenant("t0")
+    assert record.status is TenantStatus.COMPLETED
+    assert record.generations >= 16
+    assert_states_equal(expected, restarted.result("t0"), "steered")
+    _, digests = last_checkpoint_digests(root, "t0")
+    assert digests == expected_digests
+    restarted.close()
+
+
+def test_steer_torn_journal_tail_quarantined_keeps_acked_steer(tmp_path):
+    """A crash tearing the journal mid-record AFTER an acked steer: the
+    restart quarantines the torn tail but still replays the steer."""
+    root = tmp_path / "svc"
+    daemon = make_daemon(root)
+    daemon.start()
+    daemon.submit(pso_spec("t0", 0, n_steps=8))
+    daemon.steer("t0", n_steps=16)
+    del daemon
+    with open(root / ServiceDaemon.JOURNAL_NAME, "ab") as f:
+        f.write(b'{"body":{"seq":99,"kind":"ste')
+    restarted = make_daemon(root)
+    assert silent(restarted.start) == 1
+    assert len(restarted.stats.journal_damage) == 1
+    assert restarted.tenant("t0").spec.n_steps == 16
+    run_silently(restarted)
+    assert restarted.tenant("t0").generations >= 16
+    restarted.close()
+
+
+def test_steer_duplicate_records_collapse_last_knob_wins(tmp_path):
+    """At-least-once journal semantics: duplicate/successive steer
+    records for one uid fold into a single knob dict on replay — per
+    knob, the last value wins, same as applying them in sequence."""
+    root = tmp_path / "svc"
+    daemon = make_daemon(root)
+    daemon.start()
+    daemon.submit(pso_spec("t0", 0, n_steps=8))
+    daemon.steer("t0", n_steps=16, max_restarts=5)
+    # A retried/duplicated append of the same logical steer, plus a later
+    # one that supersedes the budget knob only.
+    daemon.journal.append("steer", tenant_id="t0", uid=0, n_steps=16)
+    daemon.journal.append("steer", tenant_id="t0", uid=0, n_steps=12)
+    del daemon
+
+    restarted = make_daemon(root)
+    assert silent(restarted.start) == 1
+    record = restarted.tenant("t0")
+    assert record.spec.n_steps == 12  # last value per knob wins
+    assert record.steer["max_restarts"] == 5  # untouched by later records
+    restarted.close()
+
+
+def test_steer_before_submit_skipped_loudly_on_replay(tmp_path):
+    """A steer record with no live submit before it (spliced or damaged
+    journal) is warn-skipped on replay, never fabricating a tenant."""
+    root = tmp_path / "svc"
+    daemon = make_daemon(root)
+    daemon.start()
+    daemon.submit(pso_spec("t0", 0, n_steps=8))
+    daemon.journal.append("steer", tenant_id="ghost", uid=7, n_steps=16)
+    del daemon
+
+    restarted = make_daemon(root)
+    with pytest.warns(UserWarning, match="no live submit"):
+        assert restarted.start() == 1
+    assert restarted.tenant("t0").spec.n_steps == 8  # untouched
+    with pytest.raises(KeyError):
+        restarted.tenant("ghost")
+    restarted.close()
+
+
+def test_steer_validates_before_journaling(tmp_path):
+    """A doomed steer call must leave no journal record, and steering an
+    unknown or completed tenant is refused with the documented errors."""
+    root = tmp_path / "svc"
+    daemon = make_daemon(root)
+    daemon.start()
+    daemon.submit(pso_spec("t0", 0, n_steps=8))
+    with pytest.raises(ValueError, match="n_steps"):
+        daemon.steer("t0", n_steps=0)
+    with pytest.raises(ValueError, match="adjusts nothing"):
+        daemon.steer("t0")
+    with pytest.raises(KeyError):
+        daemon.steer("nope", n_steps=16)
+    run_silently(daemon)
+    with pytest.raises(RuntimeError, match="completed"):
+        daemon.steer("t0", n_steps=4)
+    records, _ = RequestJournal(root / ServiceDaemon.JOURNAL_NAME).replay()
+    assert [r.kind for r in records if r.kind == "steer"] == []
+    daemon.close()
+
+
+def test_retry_after_seconds_conversion(tmp_path):
+    # The one shared conversion behind stats.rejections rows, the raised
+    # AdmissionError, and the gateway's Retry-After header — injected
+    # timings, pure unit.
+    assert retry_after_seconds(3, 2.0) == 6.0
+    assert retry_after_seconds(1, 0.25) == 0.25
+    assert retry_after_seconds(0, 2.0) == 0.0
+    assert retry_after_seconds(None, 2.0) is None
+    assert retry_after_seconds(3, None) is None
+    assert retry_after_seconds(3, 0.0) is None
+    # The daemon fills the wall-clock hint from its measured cadence.
+    daemon = make_daemon(
+        tmp_path / "svc", classes=[TenantClass("standard", 1)]
+    )
+    daemon.start()
+    daemon._last_segment_seconds = 2.5
+    daemon.submit(pso_spec("t0", 0))
+    with pytest.raises(AdmissionError) as err:
+        silent(daemon.submit, pso_spec("t1", 1))
+    assert err.value.reason == "shed"
+    assert err.value.retry_after_segments is not None
+    assert err.value.retry_after_seconds == pytest.approx(
+        err.value.retry_after_segments * 2.5
+    )
+    row = daemon.service.stats.rejections[-1]
+    assert row.retry_after_seconds == pytest.approx(
+        err.value.retry_after_seconds
+    )
+    daemon.close()
 
 
 def test_preempted_daemon_journals_and_restart_resumes(tmp_path):

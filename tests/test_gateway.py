@@ -1,0 +1,712 @@
+"""Network front-door tests: exactly-once admission under network and
+process chaos, driven entirely through HTTP.
+
+The headline suite is the **kill-at-every-boundary HTTP matrix**
+(acceptance): a client retrying one idempotency key across a daemon
+SIGKILL+restart at each lifecycle boundary — pre-journal-append,
+post-append/pre-reply (the lost ack), mid-run, post-checkpoint — gets
+exactly one admitted tenant whose final state, monitor history, and
+checkpoint leaf digests are bit-identical to the same specs submitted
+via the Python API.  SIGKILL is modelled as in ``test_daemon.py``:
+the endpoint's sockets close (what the OS does) and the daemon object is
+abandoned with no shutdown path; a fresh daemon+gateway is built over
+the same root.  Around it: bearer auth (401 + reject counters),
+hostile-tenant-id 400s (the path-safety satellite), idempotent replay
+in-process and across restarts, ``FaultyTransport`` wire chaos
+(dropped/duplicated/torn/delayed requests and replies never double-admit
+or lose an ack), overload → 429/503 with measured-cadence
+``Retry-After``, long-poll result/flight reads, and per-principal
+namespace isolation.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from evox_tpu.obs import FlightRecorder, MetricsRegistry, Observability
+from evox_tpu.resilience import FaultyStore, FaultyTransport, TransportError
+from evox_tpu.service import (
+    Gateway,
+    GatewayClient,
+    GatewayError,
+    HttpTransport,
+    TenantClass,
+    TenantStatus,
+)
+from test_daemon import (
+    assert_states_equal,
+    last_checkpoint_digests,
+    make_daemon,
+    pso_spec,
+    run_silently,
+    silent,
+)
+
+TOKENS = {"tok-alice": "alice", "tok-bob": "bob"}
+N = 2  # tenants in the kill matrix
+
+
+def gw_daemon(root, **overrides):
+    daemon = make_daemon(root, **overrides)
+    gateway = Gateway(daemon, tokens=TOKENS)
+    return daemon, gateway
+
+
+def kill(daemon):
+    """SIGKILL model: the OS tears down the process's sockets (endpoint
+    listener included) but no daemon shutdown logic runs — the journal is
+    left unclosed, nothing flushes."""
+    daemon.endpoint.stop()
+
+
+def client_for(daemon, token="tok-alice", **kwargs):
+    kwargs.setdefault("backoff", 0.01)
+    kwargs.setdefault("retry_after_cap", 0.05)
+    return GatewayClient(daemon.endpoint.url, token, **kwargs)
+
+
+def qualified(tenant_id, principal="alice"):
+    return f"{principal}--{tenant_id}"
+
+
+# -- auth + path safety ------------------------------------------------------
+
+
+def test_missing_and_unknown_tokens_rejected_and_counted(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        # No Authorization header at all (raw urllib, no client sugar).
+        request = urllib.request.Request(
+            f"{daemon.endpoint.url}/api/v1/tenants", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(request)
+        assert err.value.code == 401
+        with pytest.raises(GatewayError) as err2:
+            client_for(daemon, token="tok-wrong").status("t0")
+        assert err2.value.status == 401
+        assert err2.value.error == "unauthenticated"
+        section = gateway.statusz_payload()
+        assert section["auth_rejects"] == 2
+    finally:
+        daemon.close()
+
+
+@pytest.mark.parametrize(
+    "hostile",
+    ["..", ".", "../evil", "a/b", "a\\b", "", "x" * 200, "a b", "%2e%2e"],
+)
+def test_hostile_tenant_ids_structured_400(tmp_path, hostile):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        client = client_for(daemon)
+        with pytest.raises(GatewayError) as err:
+            client.submit(
+                catalog={
+                    "tenant_id": hostile,
+                    "n_steps": 4,
+                    "algorithm": {
+                        "kind": "PSO",
+                        "pop_size": 8,
+                        "dim": 4,
+                        "lb": -32.0,
+                        "ub": 32.0,
+                    },
+                    "problem": {"kind": "Ackley"},
+                }
+            )
+        assert err.value.status == 400
+        assert err.value.error in ("bad-tenant-id", "bad-spec")
+        # Nothing hostile became a directory component.
+        tenants_dir = tmp_path / "svc" / "tenants"
+        assert not tenants_dir.is_dir() or list(tenants_dir.iterdir()) == []
+    finally:
+        daemon.close()
+
+
+def test_path_traversal_ids_rejected_on_read_routes(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        client = client_for(daemon)
+        for route in ("status", "result", "flight", "withdraw"):
+            with pytest.raises(GatewayError) as err:
+                getattr(client, route)("../../etc")
+            assert err.value.status == 400, route
+            assert err.value.error == "bad-tenant-id", route
+    finally:
+        daemon.close()
+
+
+def test_cross_principal_isolation(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        alice = client_for(daemon)
+        bob = client_for(daemon, token="tok-bob")
+        alice.submit(pso_spec("t0", 0, n_steps=4))
+        # Bob can neither see alice's tenant nor collide with its id.
+        with pytest.raises(GatewayError) as err:
+            bob.status("t0")
+        assert err.value.status == 404
+        ack = bob.submit(pso_spec("t0", 1, n_steps=4))
+        assert ack["uid"] == 1
+        assert set(daemon.service._tenants) == {
+            "alice--t0",
+            "bob--t0",
+        }
+        section = gateway.statusz_payload()
+        assert section["principals"] == {"alice": 1, "bob": 1}
+    finally:
+        daemon.close()
+
+
+# -- idempotency -------------------------------------------------------------
+
+
+def test_submit_requires_idempotency_key(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        status, _headers, body = HttpTransport(
+            "127.0.0.1", daemon.endpoint.port
+        ).request(
+            "POST",
+            "/api/v1/tenants",
+            {"Authorization": "Bearer tok-alice"},
+            b"{}",
+        )
+        assert status == 400
+        assert json.loads(body)["error"] == "missing-idempotency-key"
+    finally:
+        daemon.close()
+
+
+def test_idempotent_submit_in_process_and_across_restart(tmp_path):
+    root = tmp_path / "svc"
+    daemon, gateway = gw_daemon(root)
+    gateway.start()
+    client = client_for(daemon)
+    key = client.new_idem_key()
+    spec = pso_spec("t0", 0, n_steps=8)
+    first = client.submit(spec, idem_key=key)
+    assert first["uid"] == 0 and "idempotent_replay" not in first
+    again = client.submit(spec, idem_key=key)
+    assert again["idempotent_replay"] is True and again["uid"] == 0
+    # A different key for the same live id is a truthful 409, never a
+    # second admission masked as a replay.
+    with pytest.raises(GatewayError) as err:
+        silent(client.submit, spec)
+    assert err.value.status == 409
+    assert len(daemon.service._tenants) == 1
+    kill(daemon)
+    del gateway, daemon
+
+    daemon, gateway = gw_daemon(root)
+    silent(gateway.start)
+    try:
+        replay = client_for(daemon).submit(spec, idem_key=key)
+        assert replay["idempotent_replay"] is True and replay["uid"] == 0
+        assert len(daemon.service._tenants) == 1
+        assert gateway.statusz_payload()["idem_replays"] == 1
+    finally:
+        daemon.close()
+
+
+# -- overload → HTTP ---------------------------------------------------------
+
+
+def test_shed_maps_to_429_with_measured_cadence_retry_after(tmp_path):
+    daemon, gateway = gw_daemon(
+        tmp_path / "svc", classes=[TenantClass("standard", 2)]
+    )
+    gateway.start()
+    try:
+        daemon._last_segment_seconds = 2.0  # injected measured cadence
+        client = client_for(daemon, max_retries=0)
+        for i in range(2):
+            client.submit(pso_spec(f"t{i}", i, n_steps=8))
+        with pytest.raises(GatewayError) as err:
+            silent(client.submit, pso_spec("t2", 2, n_steps=8))
+        assert err.value.status == 429
+        assert err.value.error == "shed"
+        # Retry-After is wall-clock from the measured cadence: the hint
+        # is >= 1 segment at 2 s/segment.
+        assert err.value.retry_after is not None
+        assert err.value.retry_after >= 2.0
+        assert gateway.statusz_payload()["retry_after_sent"] == 1
+    finally:
+        daemon.close()
+
+
+def test_queue_full_maps_to_503_with_retry_after(tmp_path):
+    daemon, gateway = gw_daemon(
+        tmp_path / "svc",
+        max_queue=1,
+        classes=[TenantClass("standard", 99, sheddable=False)],
+    )
+    gateway.start()
+    try:
+        daemon._last_segment_seconds = 0.5
+        client = client_for(daemon, max_retries=0)
+        client.submit(pso_spec("t0", 0, n_steps=8))
+        with pytest.raises(GatewayError) as err:
+            silent(client.submit, pso_spec("t1", 1, n_steps=8))
+        assert err.value.status == 503
+        assert err.value.error == "queue-full"
+        assert err.value.retry_after is not None and err.value.retry_after >= 1
+    finally:
+        daemon.close()
+
+
+def test_client_retries_429_until_capacity_frees(tmp_path):
+    daemon, gateway = gw_daemon(
+        tmp_path / "svc", classes=[TenantClass("standard", 1)]
+    )
+    gateway.start()
+    try:
+        fail_fast = client_for(daemon, max_retries=0)
+        fail_fast.submit(pso_spec("t0", 0, n_steps=8))
+        spec = pso_spec("t1", 1, n_steps=8)
+        # Overloaded now: a no-retry client gets the truthful 429 ...
+        with pytest.raises(GatewayError) as err:
+            silent(fail_fast.submit, spec, idem_key="retry-me")
+        assert err.value.status == 429
+        # ... and a retrying client with the SAME key lands the submit by
+        # itself once a pump thread drains capacity.
+        pump = threading.Thread(target=lambda: silent(gateway.pump))
+        pump.start()
+        ack = client_for(daemon, max_retries=30).submit(
+            spec, idem_key="retry-me"
+        )
+        pump.join(timeout=60)
+        assert ack["uid"] == 1
+        silent(gateway.pump)
+        assert (
+            daemon.tenant(qualified("t1")).status is TenantStatus.COMPLETED
+        )
+    finally:
+        daemon.close()
+
+
+# -- wire chaos --------------------------------------------------------------
+
+
+def test_faulty_transport_never_double_admits_or_loses_ack(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        faulty = FaultyTransport(
+            HttpTransport("127.0.0.1", daemon.endpoint.port),
+            drop_requests=[0],
+            drop_replies=[1],
+            torn_replies=[2],
+            duplicate_requests=[3],
+        )
+        client = GatewayClient(
+            daemon.endpoint.url,
+            "tok-alice",
+            transport=faulty,
+            max_retries=8,
+            backoff=0.01,
+        )
+        # One logical submit rides: a dropped request, a delivered-but-
+        # lost-ack (server admits!), a torn reply, then a duplicated
+        # delivery — and still resolves to exactly one admission.
+        ack = client.submit(pso_spec("t0", 0, n_steps=8))
+        assert ack["uid"] == 0
+        assert [kind for _i, kind in faulty.events] == [
+            "drop-request",
+            "drop-reply",
+            "torn-reply",
+            "duplicate-request",
+        ]
+        assert client.retries == 3
+        assert list(daemon.service._tenants) == [qualified("t0")]
+        # Attempts 1..4 hit the server; only the first admitted, the
+        # rest were idempotent replays (the duplicate counts twice).
+        assert gateway.statusz_payload()["idem_replays"] == 3
+    finally:
+        daemon.close()
+
+
+def test_dropped_reply_on_steer_and_withdraw_is_safe_to_retry(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        client_for(daemon).submit(pso_spec("t0", 0, n_steps=8))
+        faulty = FaultyTransport(
+            HttpTransport("127.0.0.1", daemon.endpoint.port),
+            drop_replies=[0, 2],
+        )
+        client = GatewayClient(
+            daemon.endpoint.url,
+            "tok-alice",
+            transport=faulty,
+            max_retries=4,
+            backoff=0.01,
+        )
+        knobs = client.steer("t0", n_steps=16)
+        assert knobs.get("idempotent_replay") is True
+        assert knobs["knobs"] == {"n_steps": 16}
+        # The steer journaled exactly once despite the lost ack.
+        records, _ = daemon.journal.replay()
+        steers = [r for r in records if r.kind == "steer"]
+        assert len(steers) == 1
+        gone = client.withdraw("t0")
+        assert gone.get("idempotent_replay") is True
+        assert daemon.tenant(qualified("t0")).status is TenantStatus.EVICTED
+        records, _ = daemon.journal.replay()
+        assert len([r for r in records if r.kind == "evict"]) == 1
+    finally:
+        daemon.close()
+
+
+# -- the kill-at-every-boundary HTTP matrix (acceptance) ---------------------
+
+
+def _reference(tmp_path, n_steps=10):
+    """The same specs submitted via the Python API, under the qualified
+    ids the gateway will mint — the bit-identity baseline."""
+    ref = make_daemon(tmp_path / "ref")
+    ref.start()
+    for i in range(N):
+        ref.submit(pso_spec(qualified(f"t{i}"), i, n_steps=n_steps))
+    run_silently(ref)
+    results, digests, history = {}, {}, {}
+    for i in range(N):
+        tid = qualified(f"t{i}")
+        results[tid] = ref.result(tid)
+        digests[tid] = last_checkpoint_digests(tmp_path / "ref", tid)
+        history[tid] = [
+            np.asarray(row)
+            for row in ref.tenant(tid).monitor.fitness_history
+        ]
+    return results, digests, history
+
+
+@pytest.mark.parametrize(
+    "kill_point",
+    ["pre-append", "post-append-pre-reply", "mid-run", "post-checkpoint"],
+)
+def test_kill_at_every_boundary_http_matrix(tmp_path, kill_point):
+    expected, expected_digests, expected_history = _reference(tmp_path)
+    root = tmp_path / "killed"
+    keys = [f"idem-{i}" for i in range(N)]
+    specs = [pso_spec(f"t{i}", i, n_steps=10) for i in range(N)]
+
+    if kill_point == "pre-append":
+        # The journal append for the LAST submit dies before any record
+        # is durable: the client sees a structured 503 (no ack) and the
+        # half-admitted tenant is withdrawn — the crash loses nothing
+        # that was acknowledged.
+        store = FaultyStore(enospc_saves=[N - 1])
+        daemon, gateway = gw_daemon(root, store=store, exec_cache=None)
+        gateway.start()
+        client = client_for(daemon, max_retries=0)
+        for i in range(N - 1):
+            client.submit(specs[i], idem_key=keys[i])
+        with pytest.raises(GatewayError) as err:
+            silent(client.submit, specs[N - 1], idem_key=keys[N - 1])
+        assert err.value.status == 503
+        assert err.value.error == "journal-failed"
+        assert qualified(f"t{N-1}") not in daemon.service._tenants
+    elif kill_point == "post-append-pre-reply":
+        daemon, gateway = gw_daemon(root)
+        gateway.start()
+        client = client_for(daemon, max_retries=0)
+        client.submit(specs[0], idem_key=keys[0])
+        # The last submit's reply is lost AFTER the journal append: the
+        # server admitted, the client holds nothing.
+        faulty = FaultyTransport(
+            HttpTransport("127.0.0.1", daemon.endpoint.port),
+            drop_replies=[0],
+        )
+        lossy = GatewayClient(
+            daemon.endpoint.url, "tok-alice", transport=faulty, max_retries=0
+        )
+        with pytest.raises(TransportError):
+            lossy.submit(specs[1], idem_key=keys[1])
+        assert qualified("t1") in daemon.service._tenants
+    elif kill_point == "mid-run":
+        daemon, gateway = gw_daemon(root)
+        gateway.start()
+        client = client_for(daemon)
+        for i in range(N):
+            client.submit(specs[i], idem_key=keys[i])
+        silent(gateway.pump, 1)
+    else:  # post-checkpoint
+        daemon, gateway = gw_daemon(root)
+        gateway.start()
+        client = client_for(daemon)
+        for i in range(N):
+            client.submit(specs[i], idem_key=keys[i])
+        silent(gateway.pump, 2)
+    kill(daemon)
+    del gateway, daemon  # SIGKILL: nothing else runs
+
+    daemon, gateway = gw_daemon(root)
+    silent(gateway.start)
+    client = client_for(daemon)
+    # The client holds its keys and retries every submit — it cannot
+    # know which acks the dead daemon got out.  Exactly-once means each
+    # retry is either the original ack replayed or (pre-append only) a
+    # fresh first admission; never a duplicate.
+    for i in range(N):
+        ack = client.submit(specs[i], idem_key=keys[i])
+        assert ack["uid"] == i, f"{kill_point}: t{i} re-keyed"
+    live = [t for t in daemon.service._tenants if t.startswith("alice--")]
+    assert sorted(live) == [qualified(f"t{i}") for i in range(N)]
+    silent(gateway.pump)
+    for i in range(N):
+        tid = qualified(f"t{i}")
+        record = daemon.tenant(tid)
+        assert record.status is TenantStatus.COMPLETED, f"{kill_point}: {tid}"
+        assert record.uid == i
+        assert_states_equal(
+            expected[tid], daemon.result(tid), f"{kill_point}: {tid}"
+        )
+        assert last_checkpoint_digests(root, tid) == expected_digests[tid], (
+            f"{kill_point}: {tid} final checkpoint digests differ"
+        )
+        # Host-side monitor history: a restart resumes from the newest
+        # checkpoint, so the restarted record holds the history tail from
+        # the resume point on (the in-state monitor compared above is the
+        # full bit-identical record).  Every row it does hold must be
+        # bit-identical to the uninterrupted run's same-generation row.
+        got_history = [
+            np.asarray(row) for row in record.monitor.fitness_history
+        ]
+        assert 1 <= len(got_history) <= len(expected_history[tid])
+        tail = expected_history[tid][-len(got_history) :]
+        for g, (got, want) in enumerate(zip(got_history, tail)):
+            assert np.array_equal(got, want), (
+                f"{kill_point}: {tid} monitor history differs at tail "
+                f"row {g}"
+            )
+    # And the acks the retries returned are truthful re-reads, not
+    # duplicate admissions: the journal holds exactly one submit per key.
+    records, _ = daemon.journal.replay()
+    for i in range(N):
+        assert (
+            len(
+                [
+                    r
+                    for r in records
+                    if r.kind == "submit" and r.data.get("idem") == keys[i]
+                ]
+            )
+            == 1
+        ), f"{kill_point}: key {keys[i]} admitted more than once"
+    kill(daemon)
+
+
+# -- read routes -------------------------------------------------------------
+
+
+def test_result_long_poll_and_npz_bit_identity(tmp_path):
+    expected, expected_digests, _history = _reference(tmp_path)
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        client = client_for(daemon)
+        for i in range(N):
+            client.submit(pso_spec(f"t{i}", i, n_steps=10))
+        pump = threading.Thread(target=lambda: silent(gateway.pump))
+        pump.start()
+        doc = client.result("t0", wait=30)
+        pump.join(timeout=60)
+        assert doc["status"] == "completed"
+        assert doc["generations"] >= 10
+        name, digests = expected_digests[qualified("t0")]
+        assert doc["checkpoint"] == name
+        assert doc["leaf_digests"] == digests
+        assert len(doc["fitness_history"]) == doc["generations"]
+        # The archive a client downloads holds bit-identical leaves to
+        # the one the Python-API run published.
+        got_name, blob = client.result_npz("t0")
+        assert got_name == name
+        import io
+
+        got = np.load(io.BytesIO(blob))
+        want = np.load(
+            tmp_path / "ref" / "tenants" / qualified("t0") / name
+        )
+        assert sorted(got.files) == sorted(want.files)
+        for leaf in want.files:
+            if leaf in ("__manifest__", "__digest__"):
+                # The manifest embeds written_at (wall clock) and the
+                # archive digest covers the manifest — state-leaf content
+                # identity is pinned by the leaf_digests assert above.
+                continue
+            assert np.array_equal(got[leaf], want[leaf]), leaf
+    finally:
+        daemon.close()
+
+
+def test_result_202_while_running(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        client = client_for(daemon)
+        client.submit(pso_spec("t0", 0, n_steps=8))
+        doc = client.result("t0", wait=0)
+        assert doc["status"] == "queued"
+        assert "fitness_history" not in doc
+    finally:
+        daemon.close()
+
+
+def test_flight_long_poll_streams_rows(tmp_path):
+    obs = Observability(
+        registry=MetricsRegistry(),
+        flight=FlightRecorder(tmp_path / "pm", window=64),
+    )
+    daemon, gateway = gw_daemon(tmp_path / "svc", obs=obs)
+    gateway.start()
+    try:
+        client = client_for(daemon)
+        client.submit(pso_spec("t0", 0, n_steps=12))
+        pump = threading.Thread(target=lambda: silent(gateway.pump))
+        pump.start()
+        rows = client.flight("t0", after=-1, wait=30)
+        pump.join(timeout=60)
+        assert rows, "long-poll returned no flight rows"
+        assert all("generation" in row for row in rows)
+        generations = [row["generation"] for row in rows]
+        assert generations == sorted(generations)
+        # Cursoring: only rows past the watermark come back.  The run has
+        # completed (pump joined), so re-fetch the final row set — the
+        # long-poll snapshot above may predate the last generations.
+        final = client.flight("t0", after=-1, wait=0)
+        assert [r["generation"] for r in final][: len(rows)] == generations
+        assert client.flight("t0", after=final[-1]["generation"], wait=0) == []
+    finally:
+        daemon.close()
+
+
+def test_flight_404_when_not_armed(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        client = client_for(daemon)
+        client.submit(pso_spec("t0", 0, n_steps=8))
+        with pytest.raises(GatewayError) as err:
+            client.flight("t0")
+        assert err.value.status == 404
+        assert err.value.error == "no-flight"
+    finally:
+        daemon.close()
+
+
+# -- mutating routes (beyond submit) ----------------------------------------
+
+
+def test_withdraw_parks_and_double_withdraw_409(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        client = client_for(daemon)
+        client.submit(pso_spec("t0", 0, n_steps=8))
+        gone = client.withdraw("t0")
+        assert gone["status"] == "evicted"
+        with pytest.raises(GatewayError) as err:
+            client.withdraw("t0")
+        assert err.value.status == 409
+        with pytest.raises(GatewayError) as err2:
+            client.withdraw("never-submitted")
+        assert err2.value.status == 404
+    finally:
+        daemon.close()
+
+
+def test_steer_via_http_changes_budget_at_boundary(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        client = client_for(daemon)
+        client.submit(pso_spec("t0", 0, n_steps=8))
+        ack = client.steer("t0", n_steps=16, checkpoint_every=1)
+        assert ack["knobs"] == {"n_steps": 16, "checkpoint_every": 1}
+        silent(gateway.pump)
+        record = daemon.tenant(qualified("t0"))
+        assert record.status is TenantStatus.COMPLETED
+        assert record.spec.n_steps == 16
+        assert record.generations >= 16
+        with pytest.raises(GatewayError) as err:
+            client.steer("t0", n_steps=0)
+        assert err.value.status == 400
+    finally:
+        daemon.close()
+
+
+def test_catalog_submit_and_unknown_kinds_400(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        client = client_for(daemon)
+        catalog = {
+            "tenant_id": "curl0",
+            "n_steps": 8,
+            "algorithm": {
+                "kind": "PSO",
+                "pop_size": 8,
+                "dim": 4,
+                "lb": -32.0,
+                "ub": 32.0,
+            },
+            "problem": {"kind": "Ackley"},
+        }
+        ack = client.submit(catalog=catalog)
+        assert ack["status"] == "queued"
+        silent(gateway.pump)
+        assert (
+            daemon.tenant(qualified("curl0")).status
+            is TenantStatus.COMPLETED
+        )
+        for field, bad in (("algorithm", "Nope"), ("problem", "Nope")):
+            broken = dict(catalog, tenant_id="curl1")
+            broken[field] = dict(catalog[field], kind=bad)
+            with pytest.raises(GatewayError) as err:
+                client.submit(catalog=broken)
+            assert err.value.status == 400
+    finally:
+        daemon.close()
+
+
+# -- telemetry surfaces ------------------------------------------------------
+
+
+def test_statusz_and_metrics_carry_gateway_counters(tmp_path):
+    daemon, gateway = gw_daemon(tmp_path / "svc")
+    gateway.start()
+    try:
+        client = client_for(daemon)
+        client.submit(pso_spec("t0", 0, n_steps=8))
+        client.status("t0")
+        with pytest.raises(GatewayError):
+            client_for(daemon, token="tok-wrong").status("t0")
+        status = json.loads(
+            urllib.request.urlopen(f"{daemon.endpoint.url}/statusz")
+            .read()
+            .decode()
+        )
+        section = status["gateway"]
+        assert section["requests"]["submit:201"] == 1
+        assert section["requests"]["status:200"] == 1
+        assert section["auth_rejects"] == 1
+        assert section["principals"] == {"alice": 1}
+        metrics = (
+            urllib.request.urlopen(f"{daemon.endpoint.url}/metrics")
+            .read()
+            .decode()
+        )
+        assert "evox_gateway_requests_total" in metrics
+        assert "evox_gateway_auth_rejects_total" in metrics
+    finally:
+        daemon.close()

@@ -598,6 +598,14 @@ def test_evoxtop_renders_and_probes(tmp_path):
                  "burn_rate": 2.0, "budget_remaining": -1.0,
                  "good": 8, "bad": 2}],
         "decisions": [{"seq": 0, "kind": "brownout", "action": "enter"}],
+        "gateway": {
+            "requests": {"submit:201": 4, "status:200": 6, "submit:429": 2},
+            "errors": 2,
+            "auth_rejects": 7,
+            "idem_replays": 1,
+            "retry_after_sent": 2,
+            "principals": {"alice": 1, "bob": 1},
+        },
         "tenants": {
             "alice-1": {"status": "running", "generations": 32,
                         "n_steps": 100, "lane": 0, "class": "standard"},
@@ -615,12 +623,25 @@ def test_evoxtop_renders_and_probes(tmp_path):
     assert "75% hit rate" in screen
     assert "alice-1" in screen and "running" in screen
     assert "0:ok@gen32" in screen
+    assert "gateway: 12 requests" in screen
+    assert "auth-rejects 7" in screen and "idem-replays 1" in screen
+    assert "principals: alice 1  bob 1" in screen
     # Probe semantics against a live endpoint: rc 0 healthy, 2 unhealthy.
     ep = IntrospectionEndpoint(
         statusz=lambda: status, healthz=lambda: (False, {"dead": [0]})
     ).start()
     try:
         assert evoxtop.main([ep.url]) == 2
+    finally:
+        ep.stop()
+    # Auth-reject storm detector: healthy daemon, hammered front door.
+    ep = IntrospectionEndpoint(
+        statusz=lambda: status, healthz=lambda: (True, {})
+    ).start()
+    try:
+        assert evoxtop.main([ep.url]) == 0
+        assert evoxtop.main([ep.url, "--max-auth-rejects", "100"]) == 0
+        assert evoxtop.main([ep.url, "--max-auth-rejects", "5"]) == 3
     finally:
         ep.stop()
 

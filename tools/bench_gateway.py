@@ -1,0 +1,362 @@
+#!/usr/bin/env python
+"""CPU microbenchmark: network front-door cost on a loaded serving daemon.
+
+The gateway's contract is that the control plane is free-ish: an operator
+(or a fleet of retrying clients) steering, polling, and scraping a daemon
+over HTTP at a realistic cadence must not tax the tenants it serves.
+This gate runs ONE warmed :class:`~evox_tpu.service.ServiceDaemon` behind
+a :class:`~evox_tpu.service.Gateway` (flight recorder armed) and measures
+two things:
+
+* **submit-to-first-flight latency** — wall seconds from the HTTP submit
+  ack to the first flight row observable through the HTTP long-poll
+  (the freshness a dashboard actually sees); reported, not gated.
+* **mutating-client overhead** — per-tenant throughput over identical
+  tenant batches in two interleaved conditions: *quiet* (gateway up,
+  idle) vs *loaded*, where a separate client PROCESS (like the real
+  operator tooling it stands in for) hits the front door once per
+  second with MUTATING traffic — an authenticated ``steer`` of a
+  queued sacrificial tenant (journal append + fsync on the ack path)
+  plus a status GET and a ``/statusz`` scrape.  Both conditions run
+  the same 8-measured + 1-sacrificial batch, so the comparison
+  isolates exactly the gateway handling.
+
+Gate: loaded throughput >= 98% of quiet (best-of-N per side).  FAILS
+(exit 1) when the floor is violated or the client's mutations never
+landed.  Artifact: ``bench_artifacts/gateway_overhead.<backend>.json``
+(CPU-provisional in BENCH_HISTORY like every bench since PR 6).
+
+Run via::
+
+    ./run_tests.sh --gateway    # suite + this gate
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_gateway.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.algorithms import PSO  # noqa: E402
+from evox_tpu.obs import (  # noqa: E402
+    FlightRecorder,
+    MetricsRegistry,
+    Observability,
+)
+from evox_tpu.problems.numerical import Ackley  # noqa: E402
+from evox_tpu.service import (  # noqa: E402
+    Gateway,
+    GatewayClient,
+    ServiceDaemon,
+    TenantSpec,
+)
+
+TENANTS = 8
+LANES = 8
+POP, DIM = 8, 4          # the dispatch-bound service gate config (PR 8)
+SEGMENT = 16
+N_STEPS = 4096           # per tenant per repeat: ~seconds of wall on CPU,
+                         # enough for several 1 Hz client ticks to land
+SACRIFICIAL_STEPS = 16   # the steered 9th tenant's short post-batch tail
+REPEATS = 3
+FLOOR = 0.98
+CLIENT_HZ = 1.0
+TOKEN = "bench-token"
+PRINCIPAL = "bench"
+
+LB = -5.0 * jnp.ones(DIM)
+UB = 5.0 * jnp.ones(DIM)
+
+_HISTORY_PATH = os.path.join(REPO, "BENCH_HISTORY.json")
+
+
+def _spec(name: str, n_steps: int) -> TenantSpec:
+    return TenantSpec(name, PSO(POP, LB, UB), Ackley(), n_steps=n_steps)
+
+
+def _submit_batch(client: GatewayClient, round_id: int) -> None:
+    # 8 measured tenants fill the lanes; the 9th stays queued — the
+    # client's steer target (its journal appends land while the batch
+    # runs, its short tail runs identically in both conditions).
+    for i in range(TENANTS):
+        client.submit(_spec(f"r{round_id}-t{i}", N_STEPS))
+    client.submit(_spec(f"r{round_id}-parked", SACRIFICIAL_STEPS))
+
+
+def _timed_round(
+    daemon: ServiceDaemon, gateway: Gateway, client: GatewayClient, round_id: int
+) -> float:
+    _submit_batch(client, round_id)
+    t0 = time.perf_counter()
+    gateway.pump()
+    seconds = time.perf_counter() - t0
+    for i in range(TENANTS):  # retire so records/namespaces stay bounded
+        daemon.forget(f"{PRINCIPAL}--r{round_id}-t{i}")
+    daemon.forget(f"{PRINCIPAL}--r{round_id}-parked")
+    return seconds
+
+
+_CLIENT_SRC = """
+import json, sys, time, urllib.error, urllib.request
+base, token, target, hz = sys.argv[1], sys.argv[2], sys.argv[3], float(sys.argv[4])
+mutations = reads = benign = failures = 0
+tick = 0
+def call(method, path, body=None):
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        base + path, data=data, method=method,
+        headers={"Authorization": "Bearer " + token,
+                 "Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=5)
+try:
+    while True:
+        time.sleep(1.0 / hz)
+        tick += 1
+        try:
+            call("POST", "/api/v1/tenants/%s/steer" % target,
+                 {"checkpoint_every": 4 if tick % 2 else 8}).read()
+            mutations += 1
+        except urllib.error.HTTPError as e:
+            # 404/409: the sacrificial finished or was retired between
+            # rounds — an honest answer, not a gateway failure.
+            e.read()
+            if e.code in (404, 409):
+                benign += 1
+            else:
+                failures += 1
+        except Exception:
+            failures += 1
+        for path in ("/api/v1/tenants/" + target, "/statusz"):
+            try:
+                call("GET", path).read()
+                reads += 1
+            except urllib.error.HTTPError as e:
+                e.read()
+                benign += 1
+            except Exception:
+                failures += 1
+        sys.stdout.write(json.dumps(
+            {"m": mutations, "r": reads, "b": benign, "f": failures}) + "\\n")
+        sys.stdout.flush()
+except KeyboardInterrupt:
+    pass
+"""
+
+
+class _MutatingClient:
+    """A 1 Hz operator in its OWN process — like the real tooling it
+    stands in for.  (An in-process client thread would also charge the
+    daemon for the CLIENT half of every request through the GIL, which
+    no deployment pays.)"""
+
+    def __init__(self, url: str, target: str):
+        import subprocess
+
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", _CLIENT_SRC, url, TOKEN, target,
+             str(CLIENT_HZ)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+        )
+        self.mutations = self.reads = self.benign = self.failures = 0
+
+    def stop(self) -> None:
+        self.proc.terminate()
+        out, _ = self.proc.communicate(timeout=30)
+        lines = [l for l in out.decode().splitlines() if l.strip()]
+        if lines:
+            last = json.loads(lines[-1])
+            self.mutations = int(last["m"])
+            self.reads = int(last["r"])
+            self.benign = int(last["b"])
+            self.failures = int(last["f"])
+
+
+def _first_flight_latency(
+    gateway: Gateway, client: GatewayClient
+) -> float:
+    """Wall seconds from submit ack to the first HTTP-visible flight row."""
+    t0 = time.perf_counter()
+    client.submit(_spec("latency-probe", SEGMENT * 2))
+    acked = time.perf_counter()
+    pump = threading.Thread(target=gateway.pump)
+    pump.start()
+    rows = client.flight("latency-probe", after=-1, wait=60)
+    latency = time.perf_counter() - acked
+    pump.join(timeout=120)
+    if not rows:
+        raise RuntimeError("no flight row ever surfaced over HTTP")
+    gateway.daemon.forget(f"{PRINCIPAL}--latency-probe")
+    return latency
+
+
+def _record_history(platform: str, loaded_gps: float) -> list[str]:
+    """First-run creation of the lane's BENCH_HISTORY row (TPU rows gate
+    future sweeps; CPU rows are indicative_only awaiting the TPU
+    re-anchor — the same convention every CPU-provisional entry uses)."""
+    metric = (
+        f"Gateway-loaded serving gens/sec/tenant, 1 Hz mutating HTTP "
+        f"client (pop={POP}, dim={DIM}, {TENANTS} tenants, "
+        f"{SEGMENT}-gen segments)"
+    )
+    history = {}
+    if os.path.exists(_HISTORY_PATH):
+        try:
+            with open(_HISTORY_PATH) as f:
+                history = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            history = {}
+    entry = history.get(metric)
+    if entry is not None and not (
+        platform == "tpu" and entry.get("platform") == "cpu"
+    ):
+        return []  # anchored already (TPU re-anchor replaces CPU rows)
+    record = {
+        "baseline": round(loaded_gps, 3),
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_runs": REPEATS,
+    }
+    if platform != "tpu":
+        record["indicative_only"] = True
+        record["note"] = (
+            "CPU-provisional: dispatch-bound host timing; "
+            "tools/run_tpu_sweep.sh re-anchors"
+        )
+    history[metric] = record
+    with open(_HISTORY_PATH, "w") as f:
+        json.dump(history, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return [metric]
+
+
+def main() -> int:
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    workdir = tempfile.mkdtemp(prefix="evox_gateway_bench_", dir=base)
+    try:
+        daemon = ServiceDaemon(
+            os.path.join(workdir, "root"),
+            lanes_per_pack=LANES,
+            segment_steps=SEGMENT,
+            seed=0,
+            preemption=False,
+            obs=Observability(
+                registry=MetricsRegistry(),
+                flight=FlightRecorder(
+                    os.path.join(workdir, "flight"), window=64
+                ),
+            ),
+        )
+        gateway = Gateway(daemon, tokens={TOKEN: PRINCIPAL})
+        gateway.start()
+        client = GatewayClient(gateway.url, TOKEN)
+        _timed_round(daemon, gateway, client, 99)  # warm: compiles amortized
+        latency = _first_flight_latency(gateway, client)
+        seconds = {"quiet": [], "loaded": []}
+        mutations = reads = failures = 0
+        for r in range(REPEATS):
+            seconds["quiet"].append(
+                _timed_round(daemon, gateway, client, 2 * r)
+            )
+            # The API id is principal-relative: the gateway qualifies it
+            # with the token's principal server-side.
+            operator = _MutatingClient(
+                daemon.endpoint.url, f"r{2 * r + 1}-parked"
+            )
+            try:
+                seconds["loaded"].append(
+                    _timed_round(daemon, gateway, client, 2 * r + 1)
+                )
+            finally:
+                operator.stop()
+            mutations += operator.mutations
+            reads += operator.reads
+            failures += operator.failures
+        gateway.close()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    per_tenant = {
+        side: N_STEPS / min(times) for side, times in seconds.items()
+    }
+    ratio = per_tenant["loaded"] / per_tenant["quiet"]
+    created = _record_history(jax.default_backend(), per_tenant["loaded"])
+    result = {
+        "bench": "gateway_overhead",
+        "backend": jax.default_backend(),
+        "tenants": TENANTS,
+        "lanes": LANES,
+        "pop_size": POP,
+        "dim": DIM,
+        "segment_steps": SEGMENT,
+        "n_steps": N_STEPS,
+        "repeats": REPEATS,
+        "client_hz": CLIENT_HZ,
+        "submit_to_first_flight_seconds": round(latency, 4),
+        "mutations_landed": mutations,
+        "reads_landed": reads,
+        "client_failures": failures,
+        "seconds": seconds,
+        "per_tenant_gens_per_sec": per_tenant,
+        "throughput_ratio": ratio,
+        "floor_ratio": FLOOR,
+        "within_budget": ratio >= FLOOR and failures == 0 and mutations > 0,
+        "history_rows_created": created,
+    }
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(
+        out_dir, f"gateway_overhead.{jax.default_backend()}.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(
+        f"gateway front-door overhead ({TENANTS} tenants x {N_STEPS} gens, "
+        f"{CLIENT_HZ:.0f} Hz mutating client, best-of-{REPEATS}):\n"
+        f"  quiet  {per_tenant['quiet']:7.1f} gen/s/tenant\n"
+        f"  loaded {per_tenant['loaded']:7.1f} gen/s/tenant = "
+        f"{ratio * 100:5.1f}% (floor {FLOOR * 100:.0f}%)\n"
+        f"  submit->first-flight {latency * 1000:.0f} ms\n"
+        f"  {mutations} mutations + {reads} reads landed, "
+        f"{failures} failures"
+    )
+    print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+    if mutations == 0:
+        print(
+            "FAIL: the operator process never landed a mutation — the "
+            "measurement is vacuous (rounds too short?)",
+            file=sys.stderr,
+        )
+        return 1
+    if failures:
+        print(
+            f"FAIL: {failures} client request(s) failed against a live "
+            f"gateway",
+            file=sys.stderr,
+        )
+        return 1
+    if ratio < FLOOR:
+        print(
+            f"FAIL: loaded throughput {ratio * 100:.1f}% is under the "
+            f"{FLOOR * 100:.0f}% floor",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,9 @@ Usage::
 jax-free and stdlib-only: runs anywhere the endpoint is reachable.
 Exit code 0 on a healthy scrape, 2 when ``/healthz`` reports unhealthy
 (so the one-shot mode doubles as a probe), 1 when the endpoint is
-unreachable.
+unreachable, and 3 when the daemon is healthy but its network gateway
+reports an auth-reject storm (``--max-auth-rejects``) — a scanner or a
+fleet with a rotated-out token hammering the front door.
 """
 
 from __future__ import annotations
@@ -120,6 +122,25 @@ def render(
             f"  budget {_fmt(slo.get('budget_remaining'))}"
             f"  ({_fmt(slo.get('good'))} good / {_fmt(slo.get('bad'))} bad)"
         )
+    gateway = status.get("gateway") or {}
+    if gateway:
+        requests = gateway.get("requests") or {}
+        lines.append(
+            f"gateway: {_fmt(sum(requests.values()))} requests"
+            f"  errors {_fmt(gateway.get('errors'))}"
+            f"  auth-rejects {_fmt(gateway.get('auth_rejects'))}"
+            f"  idem-replays {_fmt(gateway.get('idem_replays'))}"
+            f"  retry-after {_fmt(gateway.get('retry_after_sent'))}"
+        )
+        principals = gateway.get("principals") or {}
+        if principals:
+            lines.append(
+                "  principals: "
+                + "  ".join(
+                    f"{name} {count}"
+                    for name, count in sorted(principals.items())
+                )
+            )
     decisions = status.get("decisions") or []
     if decisions:
         tail = decisions[-3:]
@@ -194,6 +215,13 @@ def main(argv: list | None = None) -> int:
     parser.add_argument(
         "--timeout", type=float, default=5.0, help="per-request timeout"
     )
+    parser.add_argument(
+        "--max-auth-rejects",
+        type=int,
+        default=None,
+        help="probe mode: exit 3 when the gateway's cumulative 401 count "
+        "exceeds this (auth-reject storm detector; default: off)",
+    )
     args = parser.parse_args(argv)
     base = args.url.rstrip("/")
     while True:
@@ -208,7 +236,21 @@ def main(argv: list | None = None) -> int:
         )
         if args.interval is None:
             print(screen)
-            return 0 if health_code == 200 else 2
+            if health_code != 200:
+                return 2
+            rejects = (status.get("gateway") or {}).get("auth_rejects")
+            if (
+                args.max_auth_rejects is not None
+                and rejects is not None
+                and rejects > args.max_auth_rejects
+            ):
+                print(
+                    f"evoxtop: auth-reject storm: {rejects} gateway 401s "
+                    f"(> {args.max_auth_rejects})",
+                    file=sys.stderr,
+                )
+                return 3
+            return 0
         # ANSI clear + home: a poor man's top, no curses dependency.
         sys.stdout.write("\x1b[2J\x1b[H" + screen + "\n")
         sys.stdout.flush()
