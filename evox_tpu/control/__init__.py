@@ -33,6 +33,7 @@ ever traced (the graftlint sweep keeps GL002/GL003 clean over it).
 from .controller import (
     Controller,
     decide,
+    decide_autoscale,
     decide_brownout,
     decide_cadence,
     decide_hpo_grow,
@@ -47,6 +48,7 @@ __all__ = [
     "Controller",
     "Decision",
     "decide",
+    "decide_autoscale",
     "decide_brownout",
     "decide_cadence",
     "decide_hpo_grow",

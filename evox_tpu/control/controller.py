@@ -54,6 +54,7 @@ from .decision import Decision
 __all__ = [
     "Controller",
     "decide",
+    "decide_autoscale",
     "decide_brownout",
     "decide_cadence",
     "decide_hpo_grow",
@@ -266,7 +267,50 @@ def decide_hpo_grow(evidence: Mapping[str, Any]) -> str:
     return str(new_pop)
 
 
+def decide_autoscale(evidence: Mapping[str, Any]) -> str:
+    """Fleet-size policy for a :class:`~evox_tpu.service.TenantRouter`:
+    ``"grow"`` / ``"drain:<i>"`` / ``"retire:<i>"`` / ``"hold"``.
+
+    Pressure wins: sustained shedding (``shed_rounds`` consecutive
+    shedding rounds at/over ``shed_sustain``) or SLO burn (``burn_rate``
+    at/over ``burn_enter``) requests growth while ``members`` is under
+    ``max_members`` (``None`` = unbounded).  Without pressure the fleet
+    shrinks drain-first: a fully-drained draining member (its index in
+    ``drained_member``) retires; otherwise, when nothing is queued and
+    the non-draining count exceeds ``min_members``, the idlest member
+    (``idle_member`` — zero live tenants) starts draining.  Missing or
+    ``None`` signals hold — scaling is advisory, never load-bearing."""
+    members = int(_num(evidence, "members") or 0)
+    if members < 1:
+        return "hold"
+    shed_sustain = _num(evidence, "shed_sustain")
+    shed_rounds = _num(evidence, "shed_rounds") or 0.0
+    burn_enter = _num(evidence, "burn_enter")
+    burn = _num(evidence, "burn_rate")
+    pressured = (
+        shed_sustain is not None
+        and shed_sustain > 0
+        and shed_rounds >= shed_sustain
+    ) or (burn_enter is not None and burn is not None and burn >= burn_enter)
+    if pressured:
+        cap = _num(evidence, "max_members")
+        if cap is None or members < cap:
+            return "grow"
+        return "hold"
+    drained = evidence.get("drained_member")
+    if drained is not None:
+        return f"retire:{int(drained)}"
+    idle = evidence.get("idle_member")
+    draining = int(_num(evidence, "draining") or 0)
+    min_members = int(_num(evidence, "min_members") or 1)
+    queued = int(_num(evidence, "queued") or 0)
+    if idle is not None and queued == 0 and (members - draining) > min_members:
+        return f"drain:{int(idle)}"
+    return "hold"
+
+
 _DECIDERS: dict[str, Callable[[Mapping[str, Any]], Any]] = {
+    "autoscale": decide_autoscale,
     "trend": lambda e: decide_trend(e) or "",
     "cadence": lambda e: str(decide_cadence(e)),
     "brownout": decide_brownout,
@@ -849,6 +893,47 @@ class Controller:
             )
 
         return self._guard("hpo-grow", act, generation=generation)
+
+    def autoscale(
+        self,
+        *,
+        evidence: Mapping[str, Any],
+        generation: int = 0,
+    ) -> str:
+        """Consult the fleet-size policy with one router-built evidence
+        dict (live/draining member counts, sustained-shed rounds, worst
+        SLO burn, queue depth, idle/drained member indexes).  Returns
+        :func:`decide_autoscale`'s action — ``"grow"`` /
+        ``"drain:<i>"`` / ``"retire:<i>"`` / ``"hold"`` — with every
+        non-hold action journaled as an ``autoscale``
+        :class:`~evox_tpu.control.Decision` (replayable bit-for-bit)
+        under the shared per-key quiet window, so a grown or drained
+        fleet gets ``grace`` rounds to settle before the next scaling
+        verdict.  Never raises — failures degrade the ``autoscale``
+        plane to ``"hold"`` with one structured warning and the fleet
+        keeps its current size."""
+
+        def act() -> str:
+            key = "autoscale"
+            if generation <= self._quiet_until.get(key, -1):
+                return "hold"
+            action = decide_autoscale(evidence)
+            if action == "hold":
+                return "hold"
+            self._quiet_until[key] = int(generation) + self.grace
+            self._emit(
+                "autoscale",
+                action,
+                generation=generation,
+                evidence=evidence,
+                policy="autoscale",
+                warn=action == "grow",
+            )
+            return action
+
+        return self._guard(
+            "autoscale", act, generation=generation, default="hold"
+        )
 
     def brownout(
         self,

@@ -35,12 +35,28 @@ overload maps to 429/503 with ``Retry-After`` from the live measured
 segment cadence — chaos-tested by
 :class:`~evox_tpu.resilience.FaultyTransport` and a kill-at-every-
 boundary HTTP matrix.
+
+:class:`TenantRouter` + :class:`ServiceMember` (PR 17) are the
+cross-host scheduler over the same planes: per-host daemons advertise
+capacity (free lanes per bucket, queue depths, cadence, cache warmth)
+through their :class:`~evox_tpu.parallel.HostHeartbeat` payloads, the
+router places each submit by bucket affinity and journals every
+placement as a ``kind="placement"`` record BEFORE acking (router
+SIGKILL+restart replays to the same placement map; the gateway's
+idempotency keys ride the router journal end-to-end), dead members'
+tenants migrate onto survivors bit-identically via their checkpoint
+namespaces, member-link chaos degrades to structured 503 +
+``Retry-After``, and a journaled ``autoscale`` decider
+(:func:`~evox_tpu.control.decide_autoscale`) drains-then-retires idle
+members and requests growth under shed pressure or SLO burn.
 """
 
 from .client import GatewayClient, GatewayError, HttpTransport, encode_spec
 from .daemon import STEER_KNOBS, DaemonStats, ServiceDaemon, TenantClass
 from .gateway import Gateway
 from .journal import JournalDamage, JournalError, JournalRecord, RequestJournal
+from .member import MEMBER_API_PREFIX, ServiceMember
+from .router import TenantRouter
 from .pack import TenantPack, assign_fault_lane
 from .service import (
     AdmissionError,
@@ -65,6 +81,7 @@ __all__ = [
     "GatewayClient",
     "GatewayError",
     "HttpTransport",
+    "MEMBER_API_PREFIX",
     "STEER_KNOBS",
     "JournalDamage",
     "JournalError",
@@ -73,10 +90,12 @@ __all__ = [
     "Rejection",
     "RequestJournal",
     "ServiceDaemon",
+    "ServiceMember",
     "ServiceStats",
     "TenantClass",
     "TenantPack",
     "TenantRecord",
+    "TenantRouter",
     "TenantSpec",
     "TenantStatus",
     "assign_fault_lane",

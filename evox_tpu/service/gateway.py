@@ -822,6 +822,11 @@ class Gateway:
             "shed": 429,
             "queue-full": 503,
             "journal-failed": 503,
+            # Router (TenantRouter) refusals: the placement decision is
+            # journaled, so a retry lands exactly once — all retryable.
+            "member-link": 503,
+            "member-down": 503,
+            "no-members": 503,
             "id-collision": 409,
             "uid-collision": 409,
             "uid-mismatch": 409,

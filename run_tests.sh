@@ -85,6 +85,25 @@
 #                               mutating HTTP client (artifact under
 #                               bench_artifacts/).  Runs under a HARD
 #                               wall-clock timeout like --multihost.
+#   ./run_tests.sh --router     cross-host tenant scheduler lane: the
+#                               router suite (capacity-aware bucket-
+#                               affinity placement, journal-before-ack
+#                               exactly-once admission with the router
+#                               killed at every forward boundary,
+#                               dead-member survivor migration with
+#                               bit-identical results + checkpoint
+#                               digests vs a single daemon, member-link
+#                               FaultyTransport chaos degrading to
+#                               retryable refusals, the journaled
+#                               decide_autoscale drain/retire/grow
+#                               flows, gateway-over-router HTTP
+#                               exactly-once) — then
+#                               tools/bench_router.py: routed-fleet
+#                               per-tenant gen/s >= 90% of a direct
+#                               daemon, with the fleet SLO burn-rate
+#                               report in the artifact (under
+#                               bench_artifacts/).  Runs under a HARD
+#                               wall-clock timeout like --multihost.
 #   ./run_tests.sh --obs        observability lane: the obs-plane suite
 #                               (event-bus ordering + JSONL rotation,
 #                               registry snapshot vs a real faulty run's
@@ -268,6 +287,16 @@ if [ "$1" = "--gateway" ]; then
   timeout -k 30 "$GATEWAY_TIMEOUT" \
     "${CPU_ENV[@]}" python -m pytest tests/test_gateway.py -q "$@" || exit 1
   exec timeout -k 30 900 "${CPU_ENV[@]}" python tools/bench_gateway.py
+fi
+if [ "$1" = "--router" ]; then
+  shift
+  # Hard timeout (SIGKILL escalation), same pattern as --serve: a wedged
+  # member forward, a stuck migration, or a hung router restart in the
+  # boundary matrix must fail loudly, never hang the lane.
+  ROUTER_TIMEOUT="${EVOX_TPU_ROUTER_TIMEOUT:-1500}"
+  timeout -k 30 "$ROUTER_TIMEOUT" \
+    "${CPU_ENV[@]}" python -m pytest tests/test_router.py -q "$@" || exit 1
+  exec timeout -k 30 900 "${CPU_ENV[@]}" python tools/bench_router.py
 fi
 if [ "$1" = "--obs" ]; then
   shift

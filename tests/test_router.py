@@ -1,0 +1,703 @@
+"""Cross-host tenant scheduler tests: capacity-aware placement,
+journal-before-ack exactly-once admission, survivor migration, and
+controller-driven fleet autoscale.
+
+The headline suites are the two acceptance matrices:
+
+* **Router kill-at-every-forward-boundary** — a router abandoned
+  (SIGKILL model: no shutdown path runs) at each point of the submit
+  path — pre-journal-append, post-journal/pre-forward, and
+  post-forward/pre-ack (the lost member reply) — restarts over the same
+  root, replays its placement journal, and the client's retry lands the
+  tenant exactly once (one ``submit`` record in the member's journal,
+  one ``placement`` record in the router's) with results bit-identical
+  to an uninterrupted single daemon.
+* **Dead-member migration** — a member whose heartbeat freezes mid-run
+  is declared dead by the fleet supervisor; its tenants migrate to the
+  survivor (journaled ``migration`` records, checkpoint namespaces
+  copied) and every tenant finishes with final state, monitor history,
+  and checkpoint leaf digests bit-identical to the same specs run on an
+  uninterrupted single daemon.
+
+Around them: fleet-config validation (shared heartbeat plane, agreeing
+seed/cadence, distinct roots), bucket-affinity placement,
+``FaultyTransport`` member-link chaos (degrades to a retryable refusal
+that the gateway maps to 503 + Retry-After; a retry reuses the
+journaled placement), the pure/journal-replayable ``decide_autoscale``
+decider, drain-then-retire of surplus idle members, shed-pressure fleet
+growth, and the gateway-over-router HTTP plane.
+"""
+
+import time
+
+import pytest
+
+from evox_tpu.control import Controller, decide, decide_autoscale
+from evox_tpu.resilience import FaultyStore, FaultyTransport
+from evox_tpu.service import (
+    AdmissionError,
+    Gateway,
+    GatewayClient,
+    RequestJournal,
+    ServiceMember,
+    TenantRouter,
+)
+from test_daemon import (
+    N_TENANTS,
+    _reference_results,
+    assert_states_equal,
+    last_checkpoint_digests,
+    make_daemon,
+    pso_spec,
+    run_silently,
+    shared_cache,
+    silent,
+)
+
+TOKENS = {"tok-alice": "alice"}
+
+
+def make_member(index, root, heartbeat_dir, **overrides):
+    kwargs = dict(
+        lanes_per_pack=4,
+        segment_steps=4,
+        seed=0,
+        preemption=False,
+        brownout_threshold=None,
+        exec_cache=shared_cache(),
+    )
+    kwargs.update(overrides)
+    return ServiceMember(index, root, heartbeat_dir=heartbeat_dir, **kwargs)
+
+
+def make_fleet(tmp_path, n=2, member_overrides=None, **router_kwargs):
+    beats = tmp_path / "beats"
+    members = [
+        make_member(i, tmp_path / f"m{i}", beats, **(member_overrides or {}))
+        for i in range(n)
+    ]
+    router_kwargs.setdefault("fleet_dead_after", 300.0)
+    router_kwargs.setdefault("fleet_start_grace", 0.0)
+    router = TenantRouter(tmp_path / "router", members, **router_kwargs)
+    return router, members
+
+
+def journal_kinds(path, tenant_id=None):
+    records, damage = RequestJournal(path).replay()
+    assert damage is None
+    counts = {}
+    for rec in records:
+        if tenant_id is not None and rec.data.get("tenant_id") != tenant_id:
+            continue
+        counts[rec.kind] = counts.get(rec.kind, 0) + 1
+    return counts
+
+
+def member_submit_count(member_root, tenant_id):
+    return journal_kinds(member_root / "journal.jsonl", tenant_id).get(
+        "submit", 0
+    )
+
+
+# -- fleet configuration validation -----------------------------------------
+
+
+def test_fleet_config_validation(tmp_path):
+    beats = tmp_path / "beats"
+    with pytest.raises(ValueError, match="at least one member"):
+        TenantRouter(tmp_path / "r0", [])
+    # Split heartbeat planes: FleetHealth verdicts need one beat dir.
+    split = [
+        make_member(0, tmp_path / "a0", tmp_path / "beats-a"),
+        make_member(1, tmp_path / "a1", tmp_path / "beats-b"),
+    ]
+    with pytest.raises(ValueError, match="heartbeat directories"):
+        TenantRouter(tmp_path / "r1", split)
+    # Seed disagreement: migration would not be bit-identical.
+    mixed_seed = [
+        make_member(0, tmp_path / "b0", beats),
+        make_member(1, tmp_path / "b1", beats, seed=7),
+    ]
+    with pytest.raises(ValueError, match="seed"):
+        TenantRouter(tmp_path / "r2", mixed_seed)
+    # Cadence disagreement: checkpoints would land on different grids.
+    mixed_cadence = [
+        make_member(0, tmp_path / "c0", beats),
+        make_member(1, tmp_path / "c1", beats, segment_steps=8),
+    ]
+    with pytest.raises(ValueError, match="segment_steps"):
+        TenantRouter(tmp_path / "r3", mixed_cadence)
+    # Duplicate index / shared root: identity and journals must be 1:1.
+    with pytest.raises(ValueError, match="duplicate member index"):
+        TenantRouter(
+            tmp_path / "r4",
+            [
+                make_member(0, tmp_path / "d0", beats),
+                make_member(0, tmp_path / "d1", beats),
+            ],
+        )
+    shared = make_member(0, tmp_path / "e0", beats)
+    with pytest.raises(ValueError, match="distinct"):
+        TenantRouter(
+            tmp_path / "r5",
+            [shared, ServiceMember(1, tmp_path / "e0", daemon=shared.daemon)],
+        )
+    with pytest.raises(ValueError, match="min_members"):
+        TenantRouter(
+            tmp_path / "r6",
+            [make_member(0, tmp_path / "f0", beats)],
+            min_members=2,
+            max_members=1,
+        )
+
+
+# -- placement ---------------------------------------------------------------
+
+
+def test_placement_spreads_and_journals_before_ack(tmp_path):
+    router, members = make_fleet(tmp_path)
+    try:
+        router.start()
+        for i in range(4):
+            router.submit(
+                pso_spec(f"t{i}", i),
+                journal_extra={"idempotency_key": f"k{i}"},
+            )
+        placed = {
+            tid: p["member"] for tid, p in router._placements.items()
+        }
+        # Least-loaded spread with ties to the lowest index: 2 + 2.
+        assert sorted(placed.values()).count(0) == 2
+        assert sorted(placed.values()).count(1) == 2
+        records, damage = RequestJournal(
+            router.root / TenantRouter.JOURNAL_NAME
+        ).replay()
+        assert damage is None
+        placements = [r for r in records if r.kind == "placement"]
+        assert len(placements) == 4
+        # The ack carried the gateway idempotency key into the journal,
+        # and every record landed with the uid pinned at placement time.
+        assert {r.data["idempotency_key"] for r in placements} == {
+            "k0",
+            "k1",
+            "k2",
+            "k3",
+        }
+        assert all(p["confirmed"] for p in router._placements.values())
+    finally:
+        router.close()
+
+
+def test_bucket_affinity_packs_dense(tmp_path):
+    router, members = make_fleet(tmp_path)
+    try:
+        router.start()
+        router.submit(pso_spec("t0", 0, n_steps=8))
+        first = router._placements["t0"]["member"]
+        router.step()  # t0 is now RUNNING: its bucket has a warm lane
+        router.submit(pso_spec("t1", 1, n_steps=8))
+        # Affinity beats least-loaded: the same-bucket tenant lands
+        # beside t0 even though the other member is empty.
+        assert router._placements["t1"]["member"] == first
+        run_silently(router)
+    finally:
+        router.close()
+
+
+def test_no_members_refusal_is_retryable(tmp_path):
+    router, members = make_fleet(tmp_path, n=1)
+    try:
+        router.start()
+        members[0].draining = True
+        with pytest.raises(AdmissionError) as err:
+            router.submit(pso_spec("t0", 0))
+        assert err.value.reason == "no-members"
+        # No cadence measured yet, so the hint is in segments (the
+        # daemon's shed contract): the gateway still sends Retry-After.
+        assert err.value.retry_after_segments == 1
+        members[0].draining = False
+        router.submit(pso_spec("t0", 0))  # the retry lands
+        run_silently(router)
+        assert router.result("t0") is not None
+    finally:
+        router.close()
+
+
+# -- acceptance: routed == single daemon, bit for bit ------------------------
+
+
+def test_routed_fleet_bit_identical_to_single_daemon(tmp_path):
+    reference, ref_digests = _reference_results(tmp_path)
+    router, members = make_fleet(tmp_path)
+    try:
+        router.start()
+        for i in range(N_TENANTS):
+            router.submit(pso_spec(f"t{i}", i))
+        run_silently(router)
+        for i in range(N_TENANTS):
+            tid = f"t{i}"
+            assert_states_equal(
+                router.result(tid), reference[tid], context=tid
+            )
+            owner = router._placements[tid]["member"]
+            assert (
+                last_checkpoint_digests(tmp_path / f"m{owner}", tid)
+                == ref_digests[tid]
+            )
+    finally:
+        router.close()
+
+
+# -- acceptance: kill the router at every forward boundary -------------------
+
+
+@pytest.mark.parametrize(
+    "boundary",
+    ["pre-journal", "post-journal-pre-forward", "post-forward-pre-ack"],
+)
+def test_router_kill_at_forward_boundary_exactly_once(tmp_path, boundary):
+    ref = make_daemon(tmp_path / "ref")
+    ref.start()
+    ref.submit(pso_spec("t0", 0))
+    run_silently(ref)
+    expected = ref.result("t0")
+    ref.close()
+
+    router, members = make_fleet(tmp_path)
+    if boundary == "pre-journal":
+        # The placement record never reaches the disk: ENOSPC mid-append.
+        router.journal.close()
+        router.journal = RequestJournal(
+            router.root / TenantRouter.JOURNAL_NAME,
+            store=FaultyStore(enospc_saves=[0]),
+        )
+        router.controller.journal = router.journal
+    router.start()
+    if boundary == "post-journal-pre-forward":
+        router.links[0] = FaultyTransport(members[0], drop_requests=[0])
+    elif boundary == "post-forward-pre-ack":
+        router.links[0] = FaultyTransport(members[0], drop_replies=[0])
+    with pytest.raises(AdmissionError) as err:
+        silent(router.submit, pso_spec("t0", 0))
+    assert err.value.reason == (
+        "journal-failed" if boundary == "pre-journal" else "member-link"
+    )
+    # SIGKILL model: the router object is abandoned — no close(), no
+    # flush — and a fresh router is built over the same root + members.
+    router2 = TenantRouter(
+        tmp_path / "router",
+        members,
+        fleet_dead_after=300.0,
+        fleet_start_grace=0.0,
+    )
+    try:
+        restored = silent(router2.start)
+        assert restored == (0 if boundary == "pre-journal" else 1)
+        ack = router2.submit(pso_spec("t0", 0))  # the client's retry
+        assert int(ack.uid) == 0
+        run_silently(router2)
+        assert_states_equal(router2.result("t0"), expected, context=boundary)
+        # Exactly once on both planes: one member admission, one router
+        # placement decision — no matter where the first attempt died.
+        assert member_submit_count(tmp_path / "m0", "t0") == 1
+        kinds = journal_kinds(
+            router2.root / TenantRouter.JOURNAL_NAME, "t0"
+        )
+        assert kinds.get("placement", 0) == 1
+    finally:
+        router2.close()
+
+
+def test_router_restart_rebuilds_placement_map_and_dedups(tmp_path):
+    router, members = make_fleet(tmp_path)
+    router.start()
+    for i in range(N_TENANTS):
+        router.submit(pso_spec(f"t{i}", i))
+    router.step()
+    before = {
+        tid: (p["member"], p["uid"]) for tid, p in router._placements.items()
+    }
+    # Abandon mid-run (no shutdown path), rebuild over the same root.
+    router2 = TenantRouter(
+        tmp_path / "router",
+        members,
+        fleet_dead_after=300.0,
+        fleet_start_grace=0.0,
+    )
+    try:
+        assert router2.start() == N_TENANTS
+        after = {
+            tid: (p["member"], p["uid"])
+            for tid, p in router2._placements.items()
+        }
+        assert after == before
+        # A duplicate submit of an already-confirmed placement is an
+        # idempotent ack: same uid, no new journal record.
+        ack = router2.submit(pso_spec("t0", 0))
+        assert int(ack.uid) == before["t0"][1]
+        kinds = journal_kinds(router2.root / TenantRouter.JOURNAL_NAME)
+        assert kinds.get("placement", 0) == N_TENANTS
+        run_silently(router2)
+        for i in range(N_TENANTS):
+            assert router2.result(f"t{i}") is not None
+    finally:
+        router2.close()
+
+
+# -- member-link chaos -------------------------------------------------------
+
+
+def test_member_link_chaos_degrades_then_retry_reuses_placement(tmp_path):
+    router, members = make_fleet(tmp_path, n=1)
+    try:
+        router.start()
+        # Torn reply: the member ADMITS but the router never hears it.
+        router.links[0] = FaultyTransport(members[0], torn_replies=[0])
+        with pytest.raises(AdmissionError) as err:
+            silent(router.submit, pso_spec("t0", 0))
+        assert err.value.reason == "member-link"
+        assert err.value.retry_after_segments == 1
+        assert router._link_faults[0] == 1
+        # The retry reuses the journaled placement (no re-append) and
+        # reconciles against the member's resident tenant by uid (the
+        # member's own duplicate rejection warns, then the uid match
+        # converts it into the ack).
+        ack = silent(router.submit, pso_spec("t0", 0))
+        assert int(ack.uid) == 0
+        assert member_submit_count(tmp_path / "m0", "t0") == 1
+        kinds = journal_kinds(router.root / TenantRouter.JOURNAL_NAME, "t0")
+        assert kinds.get("placement", 0) == 1
+        run_silently(router)
+        assert router.result("t0") is not None
+    finally:
+        router.close()
+
+
+# -- steer / park through the router ----------------------------------------
+
+
+def test_steer_forwarded_and_journaled(tmp_path):
+    router, members = make_fleet(tmp_path, n=1)
+    try:
+        router.start()
+        router.submit(pso_spec("t0", 0, n_steps=8))
+        knobs = router.steer(
+            "t0", n_steps=16, journal_extra={"idempotency_key": "s1"}
+        )
+        assert knobs["n_steps"] == 16
+        records, _ = RequestJournal(
+            router.root / TenantRouter.JOURNAL_NAME
+        ).replay()
+        steers = [r for r in records if r.kind == "steer"]
+        assert len(steers) == 1
+        assert steers[0].data["idempotency_key"] == "s1"
+        with pytest.raises(KeyError):
+            router.steer("nope", n_steps=4)
+        # A steer to a dead owner is a structured retryable refusal:
+        # the tenant migrates at the next health check.
+        router._dead.add(0)
+        with pytest.raises(AdmissionError) as err:
+            router.steer("t0", n_steps=20)
+        assert err.value.reason == "member-down"
+        router._dead.clear()
+        run_silently(router)
+        # The steered budget applied: the tenant ran past its original
+        # 8-generation budget to the new one.
+        assert router.tenant("t0").generations >= 16
+    finally:
+        router.close()
+
+
+# -- acceptance: dead-member migration is bit-identical ----------------------
+
+
+def test_dead_member_migration_bit_identical(tmp_path):
+    reference, ref_digests = _reference_results(tmp_path)
+    router, members = make_fleet(tmp_path)
+    try:
+        router.start()
+        for i in range(N_TENANTS):
+            router.submit(pso_spec(f"t{i}", i))
+        for _ in range(2):  # warm: every tenant runs + checkpoints
+            router.step()
+        victims = {p["member"] for p in router._placements.values()}
+        victim = min(victims)
+        survivor = 1 - victim
+        victim_tenants = [
+            tid
+            for tid, p in router._placements.items()
+            if p["member"] == victim
+        ]
+        assert victim_tenants
+        # Freeze the victim's heartbeat (the process vanished); keep the
+        # survivor visibly alive, then tighten the staleness threshold —
+        # the next round's verdict declares the victim dead.
+        deadline = time.time() + 0.7
+        while time.time() < deadline:
+            members[survivor].beat()
+            time.sleep(0.05)
+        router.fleet_dead_after = 0.4
+        silent(router.step)
+        assert victim in router._dead
+        for tid in victim_tenants:
+            assert router._placements[tid]["member"] == survivor
+        run_silently(router)
+        # Every tenant — migrated or not — finishes bit-identical to the
+        # uninterrupted single-daemon reference: final state, monitor
+        # history, and checkpoint leaf digests.
+        for i in range(N_TENANTS):
+            tid = f"t{i}"
+            assert_states_equal(
+                router.result(tid), reference[tid], context=tid
+            )
+            owner = router._placements[tid]["member"]
+            assert (
+                last_checkpoint_digests(tmp_path / f"m{owner}", tid)
+                == ref_digests[tid]
+            )
+        # The migrations are journaled (replayable placement authority)
+        # and surfaced on the status plane.
+        records, _ = RequestJournal(
+            router.root / TenantRouter.JOURNAL_NAME
+        ).replay()
+        migrations = [r for r in records if r.kind == "migration"]
+        assert {r.data["tenant_id"] for r in migrations} == set(
+            victim_tenants
+        )
+        assert all(r.data["from"] == victim for r in migrations)
+        status = router._statusz()
+        assert status["router"]["members"][str(victim)]["state"] == "dead"
+        assert len(status["router"]["migrations"]) == len(victim_tenants)
+        healthy, payload = router._healthz()
+        assert not healthy and payload["dead_members"] == [victim]
+    finally:
+        router.close()
+
+
+# -- autoscale ---------------------------------------------------------------
+
+
+def _evidence(**overrides):
+    evidence = {
+        "members": 2,
+        "draining": 0,
+        "min_members": 1,
+        "max_members": None,
+        "shed_rounds": 0,
+        "shed_sustain": None,
+        "burn_rate": None,
+        "burn_enter": None,
+        "queued": 0,
+        "idle_member": None,
+        "drained_member": None,
+    }
+    evidence.update(overrides)
+    return evidence
+
+
+def test_decide_autoscale_is_pure_and_total():
+    assert decide_autoscale(_evidence()) == "hold"
+    assert (
+        decide_autoscale(_evidence(shed_rounds=3, shed_sustain=2)) == "grow"
+    )
+    assert (
+        decide_autoscale(
+            _evidence(shed_rounds=3, shed_sustain=2, max_members=2)
+        )
+        == "hold"  # pressure, but the fleet is at its cap
+    )
+    assert (
+        decide_autoscale(_evidence(burn_rate=2.5, burn_enter=2.0)) == "grow"
+    )
+    assert decide_autoscale(_evidence(drained_member=1)) == "retire:1"
+    assert decide_autoscale(_evidence(idle_member=1)) == "drain:1"
+    assert (
+        decide_autoscale(_evidence(idle_member=1, members=1)) == "hold"
+    )  # never drain below min_members
+    assert (
+        decide_autoscale(_evidence(idle_member=1, queued=3)) == "hold"
+    )  # queued work wants those lanes
+    # Pure: the same evidence always yields the same action, via the
+    # shared decide() registry too.
+    evidence = _evidence(shed_rounds=5, shed_sustain=2)
+    assert all(
+        decide("autoscale", evidence) == "grow" for _ in range(3)
+    )
+
+
+def test_autoscale_drains_then_retires_idle_member(tmp_path):
+    router, members = make_fleet(
+        tmp_path,
+        controller=Controller(grace=1),
+        autoscale_drain=True,
+        min_members=1,
+    )
+    try:
+        router.start()
+        router.submit(pso_spec("t0", 0, n_steps=4))
+        run_silently(router)
+        for _ in range(6):  # idle rounds: drain fires, then retire
+            silent(router.step)
+        retired = [i for i, m in router.members.items() if m.retired]
+        assert len(retired) == 1
+        live = [
+            i
+            for i, m in router.members.items()
+            if not m.retired and not m.draining
+        ]
+        assert len(live) == router.min_members
+        # Completed results stay fetchable even off a retired member.
+        assert router.result("t0") is not None
+        # Every non-hold decision is journaled with its full evidence
+        # and replays bit-for-bit through the pure decider.
+        records, _ = RequestJournal(
+            router.root / TenantRouter.JOURNAL_NAME
+        ).replay()
+        kinds = {r.kind for r in records}
+        assert {"drain-member", "retire-member"} <= kinds
+        decisions = [
+            r.data["decision"]
+            for r in records
+            if r.kind == "decision"
+            and r.data["decision"]["kind"] == "autoscale"
+        ]
+        assert [d["action"] for d in decisions] == [
+            f"drain:{retired[0]}",
+            f"retire:{retired[0]}",
+        ]
+        for d in decisions:
+            assert decide("autoscale", d["evidence"]) == d["action"]
+        # The retirement is durable: a rebuilt router replays it.
+        router3 = TenantRouter(
+            tmp_path / "router", members, fleet_start_grace=0.0
+        )
+        silent(router3.start)
+        assert router3.members[retired[0]].retired
+    finally:
+        router.close()
+
+
+def test_autoscale_grows_under_shed_pressure(tmp_path):
+    beats = tmp_path / "beats"
+
+    def spawn(index):
+        return make_member(index, tmp_path / f"m{index}", beats)
+
+    router, members = make_fleet(
+        tmp_path,
+        n=1,
+        controller=Controller(grace=1),
+        autoscale_shed_rounds=2,
+        max_members=2,
+        spawn_member=spawn,
+    )
+    try:
+        router.start()
+        # Sustained shed pressure on the evidence plane: the admission
+        # layer counted sheds in consecutive rounds.
+        for _ in range(2):
+            members[0].daemon.stats.sheds += 1
+            silent(router.step)
+        assert router.growth_requested == 1
+        assert sorted(router.members) == [0, 1]
+        assert router.members[1].daemon.started
+        # At the cap: more pressure holds instead of growing.
+        for _ in range(3):
+            members[0].daemon.stats.sheds += 1
+            silent(router.step)
+        assert router.growth_requested == 1
+        # The new member is immediately placeable.
+        members[0].draining = True
+        router.submit(pso_spec("t0", 0, n_steps=4))
+        assert router._placements["t0"]["member"] == 1
+        run_silently(router)
+        assert router.result("t0") is not None
+    finally:
+        router.close()
+
+
+# -- the HTTP plane: gateway over router -------------------------------------
+
+
+def test_gateway_over_router_exactly_once_and_status_planes(tmp_path):
+    router, members = make_fleet(tmp_path)
+    gateway = Gateway(router, tokens=TOKENS)
+    gateway.start()
+    try:
+        client = GatewayClient(
+            router.endpoint.url,
+            "tok-alice",
+            backoff=0.01,
+            retry_after_cap=0.05,
+        )
+        spec = pso_spec("t0", None, n_steps=8)
+        ack = client.submit(spec, idem_key="key-1")
+        replay = client.submit(spec, idem_key="key-1")
+        assert replay["uid"] == ack["uid"]
+        # Internally the tenant lives under its principal-qualified id.
+        assert "alice--t0" in router._placements
+        owner = router._placements["alice--t0"]["member"]
+        assert member_submit_count(tmp_path / f"m{owner}", "alice--t0") == 1
+        # Member-link chaos under a live client: the refusal surfaces as
+        # 503 + Retry-After and the client's automatic retry lands the
+        # tenant exactly once on the journaled placement.
+        router.links[owner] = FaultyTransport(
+            router.members[owner], drop_requests=[0]
+        )
+        router.links[1 - owner] = FaultyTransport(
+            router.members[1 - owner], drop_requests=[0]
+        )
+        ack2 = silent(client.submit, pso_spec("t1", None, n_steps=8))
+        assert client.retries >= 1
+        owner2 = router._placements["alice--t1"]["member"]
+        assert (
+            member_submit_count(tmp_path / f"m{owner2}", "alice--t1") == 1
+        )
+        run_silently(router)
+        assert client.result("t0")["status"] == "completed"
+        assert client.result("t1")["status"] == "completed"
+        # One status document spans all three planes: fleet, control,
+        # and front door.
+        status = router._statusz()
+        assert "router" in status and "gateway" in status
+        assert status["gateway"]["principals"]["alice"] == 2
+        assert ack2["uid"] != ack["uid"]
+        assert "alice--t1" in status["tenants"]
+        healthy, _ = router._healthz()
+        assert healthy
+    finally:
+        router.close()
+
+
+# -- evoxtop: the operator view ----------------------------------------------
+
+
+def test_evoxtop_renders_router_view_and_probes_dead_members(tmp_path):
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    try:
+        import evoxtop
+    finally:
+        sys.path.pop(0)
+    router, members = make_fleet(tmp_path)
+    try:
+        router.start()
+        router.submit(pso_spec("t0", 0, n_steps=4))
+        run_silently(router)
+        status = router._statusz()
+        screen = evoxtop.render(status, 200, {"healthy": True})
+        assert "router members (2)" in screen
+        assert evoxtop.router_dead_members(status) == []
+        drill = evoxtop.render(status, 200, {"healthy": True}, member=0)
+        assert "member 0 [ok]" in drill
+        # A dead member flips the one-shot probe to rc 2.
+        router._dead.add(1)
+        status = router._statusz()
+        assert evoxtop.router_dead_members(status) == [1]
+        assert "1:dead" in evoxtop.render(status, 200, {"healthy": False})
+    finally:
+        router.close()

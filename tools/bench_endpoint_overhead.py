@@ -48,6 +48,7 @@ from evox_tpu.algorithms import PSO  # noqa: E402
 from evox_tpu.obs import OBS_SCHEMA_VERSION, default_slos  # noqa: E402
 from evox_tpu.problems.numerical import Ackley  # noqa: E402
 from evox_tpu.service import ServiceDaemon, TenantSpec  # noqa: E402
+from tools.bench_floor import floor_gate, floor_gated  # noqa: E402
 
 TENANTS = 8
 LANES = 8
@@ -186,6 +187,7 @@ def main() -> int:
         "per_tenant_gens_per_sec": per_tenant,
         "throughput_ratio": ratio,
         "floor_ratio": FLOOR,
+        "floor_gated": floor_gated(jax.default_backend()),
         "within_budget": ratio >= FLOOR and failures == 0 and scrapes > 0,
     }
     out_dir = os.path.join(REPO, "bench_artifacts")
@@ -218,14 +220,12 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
-    if ratio < FLOOR:
-        print(
-            f"FAIL: scraped throughput {ratio * 100:.1f}% is under the "
-            f"{FLOOR * 100:.0f}% floor",
-            file=sys.stderr,
-        )
-        return 1
-    return 0
+    return floor_gate(
+        "scraped throughput",
+        ratio,
+        FLOOR,
+        backend=jax.default_backend(),
+    )
 
 
 if __name__ == "__main__":

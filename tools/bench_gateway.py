@@ -61,6 +61,7 @@ from evox_tpu.service import (  # noqa: E402
     ServiceDaemon,
     TenantSpec,
 )
+from tools.bench_floor import floor_gate, floor_gated  # noqa: E402
 
 TENANTS = 8
 LANES = 8
@@ -312,6 +313,7 @@ def main() -> int:
         "per_tenant_gens_per_sec": per_tenant,
         "throughput_ratio": ratio,
         "floor_ratio": FLOOR,
+        "floor_gated": floor_gated(jax.default_backend()),
         "within_budget": ratio >= FLOOR and failures == 0 and mutations > 0,
         "history_rows_created": created,
     }
@@ -348,14 +350,12 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
-    if ratio < FLOOR:
-        print(
-            f"FAIL: loaded throughput {ratio * 100:.1f}% is under the "
-            f"{FLOOR * 100:.0f}% floor",
-            file=sys.stderr,
-        )
-        return 1
-    return 0
+    return floor_gate(
+        "loaded throughput",
+        ratio,
+        FLOOR,
+        backend=jax.default_backend(),
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,250 @@
+"""Routed-fleet serving overhead: the scaled-down multi-host load test.
+
+The question this gate pins: what does cross-host scheduling COST?  A
+:class:`~evox_tpu.service.TenantRouter` fronting two packed
+:class:`~evox_tpu.service.ServiceMember` daemons adds, per round, a
+capacity read + heartbeat publish per member, journal-before-ack
+placement on every submit, and fleet-health verdicts — none of which may
+eat the serving throughput.  The bench runs the same packed tenant batch
+two ways:
+
+* **direct** — one ServiceDaemon with all the lanes (the PR-11 serving
+  baseline),
+* **routed** — the same total lanes split across two members behind a
+  TenantRouter, every submit placed + journaled by the router.
+
+and gates routed per-tenant gen/s at ≥ ``FLOOR`` (90%) of direct.  The
+routed condition also runs with declarative SLOs armed on every member,
+and the artifact carries the fleet's full burn-rate report (per member,
+per objective) — the SLO evidence the router's autoscale decider
+consumes, exported here so a load run leaves an auditable SLO trail.
+
+Floors follow the shared ``tools/bench_floor`` policy: anchored (TPU/GPU
+or multi-core CPU) runs gate; a starved 1-core CPU container reports
+instead of flaking.  Artifact: ``bench_artifacts/router_overhead.
+<backend>.json`` (CPU-provisional in BENCH_HISTORY like every bench
+since PR 6).
+
+Run::
+
+    ./run_tests.sh --router     # suite + this gate
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/bench_router.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from evox_tpu.algorithms import PSO  # noqa: E402
+from evox_tpu.obs import default_slos  # noqa: E402
+from evox_tpu.problems.numerical import Ackley  # noqa: E402
+from evox_tpu.service import (  # noqa: E402
+    ServiceDaemon,
+    ServiceMember,
+    TenantRouter,
+    TenantSpec,
+)
+from tools.bench_floor import floor_gate, floor_gated  # noqa: E402
+
+MEMBERS = 2
+TENANTS = 8              # fills every lane across the fleet
+LANES = 8                # direct-daemon lanes; split across the members
+POP, DIM = 1024, 16      # compute-weighted: placement cost must drown in
+                         # real segment work, as it would at service scale
+SEGMENT = 16
+N_STEPS = 256            # per tenant per repeat
+REPEATS = 3
+FLOOR = 0.90             # routed keeps >= 90% of direct per-tenant gen/s
+
+LB = -5.0 * jnp.ones(DIM)
+UB = 5.0 * jnp.ones(DIM)
+
+_HISTORY_PATH = os.path.join(REPO, "BENCH_HISTORY.json")
+
+_SLOS = dict(segment_seconds=60.0, gens_per_sec=0.001, window_seconds=300.0)
+
+
+def _spec(name: str, uid: int) -> TenantSpec:
+    return TenantSpec(name, PSO(POP, LB, UB), Ackley(), n_steps=N_STEPS, uid=uid)
+
+
+def _drain(steppable) -> float:
+    t0 = time.perf_counter()
+    while steppable.step():
+        pass
+    return time.perf_counter() - t0
+
+
+def _direct_round(daemon: ServiceDaemon, round_id: int) -> float:
+    for i in range(TENANTS):
+        daemon.submit(_spec(f"d{round_id}-t{i}", round_id * TENANTS + i))
+    seconds = _drain(daemon)
+    for i in range(TENANTS):
+        daemon.forget(f"d{round_id}-t{i}")
+    return seconds
+
+
+def _routed_round(router: TenantRouter, round_id: int) -> float:
+    for i in range(TENANTS):
+        router.submit(_spec(f"r{round_id}-t{i}", round_id * TENANTS + i))
+    seconds = _drain(router)
+    for i in range(TENANTS):
+        placement = router._placements.pop(f"r{round_id}-t{i}")
+        router.members[placement["member"]].daemon.forget(f"r{round_id}-t{i}")
+    return seconds
+
+
+def _record_history(platform: str, routed_gps: float) -> list[str]:
+    metric = (
+        f"Routed-fleet serving gens/sec/tenant, {MEMBERS} members "
+        f"(pop={POP}, dim={DIM}, {TENANTS} tenants, "
+        f"{SEGMENT}-gen segments)"
+    )
+    history = {}
+    if os.path.exists(_HISTORY_PATH):
+        try:
+            with open(_HISTORY_PATH) as f:
+                history = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            history = {}
+    entry = history.get(metric)
+    if entry is not None and not (
+        platform == "tpu" and entry.get("platform") == "cpu"
+    ):
+        return []  # anchored already (TPU re-anchor replaces CPU rows)
+    record = {
+        "baseline": round(routed_gps, 3),
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_runs": REPEATS,
+    }
+    if platform != "tpu":
+        record["indicative_only"] = True
+        record["note"] = (
+            "CPU-provisional: dispatch-bound host timing; "
+            "tools/run_tpu_sweep.sh re-anchors"
+        )
+    history[metric] = record
+    with open(_HISTORY_PATH, "w") as f:
+        json.dump(history, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return [metric]
+
+
+def main() -> int:
+    workdir = tempfile.mkdtemp(prefix="bench_router_")
+    try:
+        direct = ServiceDaemon(
+            os.path.join(workdir, "direct"),
+            lanes_per_pack=LANES,
+            segment_steps=SEGMENT,
+            seed=0,
+            preemption=False,
+            slos=default_slos(**_SLOS),
+        )
+        direct.start()
+        members = [
+            ServiceMember(
+                i,
+                os.path.join(workdir, f"member{i}"),
+                heartbeat_dir=os.path.join(workdir, "heartbeats"),
+                lanes_per_pack=LANES // MEMBERS,
+                segment_steps=SEGMENT,
+                seed=0,
+                preemption=False,
+                slos=default_slos(**_SLOS),
+            )
+            for i in range(MEMBERS)
+        ]
+        router = TenantRouter(
+            os.path.join(workdir, "router"),
+            members,
+            fleet_start_grace=3600.0,
+            fleet_dead_after=3600.0,  # a timing run must not self-migrate
+        )
+        router.start()
+
+        _direct_round(direct, 99)   # warm: compiles amortized out
+        _routed_round(router, 99)
+        seconds = {"direct": [], "routed": []}
+        for r in range(REPEATS):
+            seconds["direct"].append(_direct_round(direct, r))
+            seconds["routed"].append(_routed_round(router, r))
+
+        per_tenant = {
+            side: N_STEPS / min(times) for side, times in seconds.items()
+        }
+        ratio = per_tenant["routed"] / per_tenant["direct"]
+
+        # The SLO burn-rate report: every member's standing on every
+        # declared objective — the evidence plane decide_autoscale eats.
+        slo_report = {
+            str(m.index): m.daemon.slo.describe() for m in members
+        }
+        placements = router.journal.replay()[0]
+        placement_kinds: dict[str, int] = {}
+        for rec in placements:
+            placement_kinds[rec.kind] = placement_kinds.get(rec.kind, 0) + 1
+
+        created = _record_history(jax.default_backend(), per_tenant["routed"])
+        result = {
+            "bench": "router_overhead",
+            "backend": jax.default_backend(),
+            "members": MEMBERS,
+            "tenants": TENANTS,
+            "lanes_direct": LANES,
+            "lanes_per_member": LANES // MEMBERS,
+            "pop_size": POP,
+            "dim": DIM,
+            "segment_steps": SEGMENT,
+            "n_steps": N_STEPS,
+            "repeats": REPEATS,
+            "seconds": seconds,
+            "per_tenant_gens_per_sec": per_tenant,
+            "throughput_ratio": ratio,
+            "floor_ratio": FLOOR,
+            "floor_gated": floor_gated(jax.default_backend()),
+            "router_journal_records": placement_kinds,
+            "slo_burn_report": slo_report,
+            "within_budget": ratio >= FLOOR,
+            "history_rows_created": created,
+        }
+        out_dir = os.path.join(REPO, "bench_artifacts")
+        os.makedirs(out_dir, exist_ok=True)
+        out_path = os.path.join(
+            out_dir, f"router_overhead.{jax.default_backend()}.json"
+        )
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(
+            f"direct {per_tenant['direct']:.1f} gen/s/tenant, "
+            f"routed {per_tenant['routed']:.1f} gen/s/tenant "
+            f"({ratio * 100:.1f}% of direct) across {MEMBERS} members"
+        )
+        print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+        router.close()
+        direct.close()
+        return floor_gate(
+            "routed throughput",
+            ratio,
+            FLOOR,
+            backend=jax.default_backend(),
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,12 +12,19 @@ Usage::
     python tools/evoxtop.py http://127.0.0.1:8080 -n 2      # refresh every 2s
     python tools/evoxtop.py http://127.0.0.1:8080 --tenants 40
 
+Pointed at a :class:`~evox_tpu.service.TenantRouter` endpoint, the
+screen grows the router view — per-member state/capacity/placement
+counts, the migration event tail, and autoscale actions — and
+``--member <i>`` drills into one member (its lanes per bucket, queue
+depths, exec-cache warmth, link faults, and resident tenants).
+
 jax-free and stdlib-only: runs anywhere the endpoint is reachable.
 Exit code 0 on a healthy scrape, 2 when ``/healthz`` reports unhealthy
-(so the one-shot mode doubles as a probe), 1 when the endpoint is
-unreachable, and 3 when the daemon is healthy but its network gateway
-reports an auth-reject storm (``--max-auth-rejects``) — a scanner or a
-fleet with a rotated-out token hammering the front door.
+OR any router member is dead (so the one-shot mode doubles as a probe),
+1 when the endpoint is unreachable, and 3 when the daemon is healthy but
+its network gateway reports an auth-reject storm
+(``--max-auth-rejects``) — a scanner or a fleet with a rotated-out token
+hammering the front door.
 """
 
 from __future__ import annotations
@@ -55,8 +62,119 @@ def _fmt(value, digits: int = 2) -> str:
     return str(value)
 
 
+def _render_router(
+    lines: list, status: dict, member: "int | None"
+) -> None:
+    """The router section: member strip, event tails, optional one-member
+    drill-down."""
+    router = status.get("router") or {}
+    if not router:
+        if member is not None:
+            lines.append(
+                f"  --member {member}: this endpoint serves no router view"
+            )
+        return
+    members = router.get("members") or {}
+    strip = []
+    for idx in sorted(members, key=int):
+        m = members[idx]
+        cap = m.get("capacity") or {}
+        strip.append(
+            f"{idx}:{m.get('state', '?')}"
+            f" p{_fmt(m.get('placements'))}"
+            f" r{_fmt(cap.get('running'))}"
+            f" q{_fmt(cap.get('queued'))}"
+        )
+    lines.append(f"router members ({len(members)}): " + "  ".join(strip))
+    lines.append(
+        f"  placements {_fmt(router.get('placements'))}"
+        f"  rounds {_fmt(router.get('rounds'))}"
+        f"  shed-rounds {_fmt(router.get('shed_rounds'))}"
+        f"  growth-requested {_fmt(router.get('growth_requested'))}"
+    )
+    migrations = router.get("migrations") or []
+    if migrations:
+        lines.append(
+            "  migrations: "
+            + "  ".join(
+                f"{m.get('tenant_id')} {_fmt(m.get('from'))}->"
+                f"{_fmt(m.get('to'))} ({m.get('reason') or '-'})"
+                for m in migrations[-4:]
+            )
+        )
+    autoscale = router.get("autoscale") or []
+    if autoscale:
+        lines.append(
+            "  autoscale: "
+            + "  ".join(
+                f"r{_fmt(a.get('round'))} {a.get('action')}"
+                for a in autoscale[-4:]
+            )
+        )
+    if member is None:
+        return
+    m = members.get(str(member))
+    if m is None:
+        lines.append(f"  member {member}: not in this fleet")
+        return
+    cap = m.get("capacity") or {}
+    lines.append(
+        f"  member {member} [{m.get('state', '?')}]:"
+        f" tenants {_fmt(cap.get('tenants'))}"
+        f"  running {_fmt(cap.get('running'))}"
+        f"  queued {_fmt(cap.get('queued'))}"
+        f"  lanes/pack {_fmt(cap.get('lanes_per_pack'))}"
+        f"  link-faults {_fmt(m.get('link_faults'))}"
+        f"  segment {_fmt(cap.get('segment_seconds'), 3)}s"
+    )
+    free = cap.get("free_lanes") or {}
+    if free:
+        lines.append(
+            "    free lanes: "
+            + "  ".join(f"{b}:{n}" for b, n in sorted(free.items()))
+        )
+    depth = cap.get("queue_depth") or {}
+    if depth:
+        lines.append(
+            "    queue: "
+            + "  ".join(f"{c} {d}" for c, d in sorted(depth.items()))
+        )
+    cache = cap.get("exec_cache") or {}
+    if cache:
+        rate = cache.get("hit_rate")
+        lines.append(
+            f"    exec cache: {_fmt(cache.get('hits'))} hits / "
+            f"{_fmt(cache.get('misses'))} misses"
+            + (f"  ({rate * 100:.0f}% hit rate)" if rate is not None else "")
+        )
+    resident = sorted(
+        tid
+        for tid, t in (status.get("tenants") or {}).items()
+        if t.get("member") == member
+    )
+    if resident:
+        lines.append(
+            f"    placed here ({len(resident)}): "
+            + "  ".join(resident[:8])
+            + ("  ..." if len(resident) > 8 else "")
+        )
+
+
+def router_dead_members(status: dict) -> list:
+    """Indexes of members the router view reports dead (probe signal)."""
+    members = (status.get("router") or {}).get("members") or {}
+    return sorted(
+        int(i) for i, m in members.items() if m.get("state") == "dead"
+    )
+
+
 def render(
-    status: dict, health_code: int, health: dict, *, max_tenants: int = 20
+    status: dict,
+    health_code: int,
+    health: dict,
+    *,
+    max_tenants: int = 20,
+    member: "int | None" = None,
 ) -> str:
     """One screenful from a /statusz + /healthz pair."""
     lines: list[str] = []
@@ -141,6 +259,7 @@ def render(
                     for name, count in sorted(principals.items())
                 )
             )
+    _render_router(lines, status, member)
     decisions = status.get("decisions") or []
     if decisions:
         tail = decisions[-3:]
@@ -163,9 +282,11 @@ def render(
             )
         )
     if tenants:
+        routed = any("member" in t for t in tenants.values())
+        slot = "mbr" if routed else "lane"
         lines.append(
             f"  {'id':<24} {'status':<12} {'gens':>6} {'of':>6} "
-            f"{'lane':>4}  class"
+            f"{slot:>4}  class"
         )
         shown = 0
         # Running first, then queued — the rows an operator acts on.
@@ -185,7 +306,8 @@ def render(
             lines.append(
                 f"  {tid[:24]:<24} {t.get('status', '?'):<12} "
                 f"{_fmt(t.get('generations')):>6} {_fmt(t.get('n_steps')):>6} "
-                f"{_fmt(t.get('lane')):>4}  {t.get('class', '-')}"
+                f"{_fmt(t.get('member') if routed else t.get('lane')):>4}"
+                f"  {t.get('class', '-')}"
             )
             shown += 1
     return "\n".join(lines)
@@ -216,6 +338,13 @@ def main(argv: list | None = None) -> int:
         "--timeout", type=float, default=5.0, help="per-request timeout"
     )
     parser.add_argument(
+        "--member",
+        type=int,
+        default=None,
+        help="router drill-down: show this member's full capacity view "
+        "(lanes per bucket, queue depths, cache warmth, resident tenants)",
+    )
+    parser.add_argument(
         "--max-auth-rejects",
         type=int,
         default=None,
@@ -232,11 +361,22 @@ def main(argv: list | None = None) -> int:
             print(f"evoxtop: {base} unreachable ({e})", file=sys.stderr)
             return 1
         screen = render(
-            status, health_code, health, max_tenants=args.tenants
+            status,
+            health_code,
+            health,
+            max_tenants=args.tenants,
+            member=args.member,
         )
         if args.interval is None:
             print(screen)
             if health_code != 200:
+                return 2
+            dead = router_dead_members(status)
+            if dead:
+                print(
+                    f"evoxtop: router members {dead} are dead",
+                    file=sys.stderr,
+                )
                 return 2
             rejects = (status.get("gateway") or {}).get("auth_rejects")
             if (
