@@ -252,7 +252,11 @@ class JsonlFileSink:
 
     def _open(self) -> None:
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        self._file = open(self.path, "a", buffering=1)
+        # Advisory line-buffered JSONL event log with size-based rotation
+        # and reopen-on-error: the service journal is the durable record;
+        # a torn tail line here is skipped by readers, and append-mode has
+        # no staged-publish equivalent.
+        self._file = open(self.path, "a", buffering=1)  # graftlint: disable=GL009
         self._size = self._file.tell()
 
     def _rotate(self) -> None:

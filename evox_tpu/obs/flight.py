@@ -590,16 +590,21 @@ class FlightRecorder:
         }
         if detail:
             manifest["detail"] = dict(detail)
+        from ..utils.checkpoint import atomic_write_text
+
         try:
             bundle.mkdir(parents=True, exist_ok=True)
-            with open(bundle / "flight.jsonl", "w") as f:
-                for row in rows:
-                    f.write(json.dumps(row) + "\n")
+            atomic_write_text(
+                bundle / "flight.jsonl",
+                "".join(json.dumps(row) + "\n" for row in rows),
+            )
             # Manifest last: its presence marks the bundle complete, so a
-            # reader never consumes a half-written dump.
-            with open(bundle / "manifest.json", "w") as f:
-                json.dump(manifest, f, indent=1, default=repr)
-                f.write("\n")
+            # reader never consumes a half-written dump — and the atomic
+            # publish means the completeness marker itself can never tear.
+            atomic_write_text(
+                bundle / "manifest.json",
+                json.dumps(manifest, indent=1, default=repr) + "\n",
+            )
         except OSError:
             return None
         # Commit the dedup cursor only after a durable bundle exists —

@@ -222,10 +222,11 @@ class Tracer:
 
     def write(self, path: Union[str, Path]) -> Path:
         """Write :meth:`to_chrome_trace` as JSON (loadable by
-        ``json.load`` and the Perfetto UI)."""
+        ``json.load`` and the Perfetto UI).  Published atomically: a
+        crash mid-write never leaves a torn file Perfetto rejects."""
+        from ..utils.checkpoint import atomic_write_text
+
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(self.to_chrome_trace(), f)
-            f.write("\n")
+        atomic_write_text(path, json.dumps(self.to_chrome_trace()) + "\n")
         return path

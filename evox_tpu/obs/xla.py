@@ -162,6 +162,8 @@ def write_cost_analysis(
     dump must never kill the timing run it decorates."""
     cost = program_costs(compiled)
     mem = program_memory(compiled)
+    from ..utils.checkpoint import atomic_write_text
+
     try:
         os.makedirs(profile_dir, exist_ok=True)
         if cost is not None:
@@ -169,15 +171,15 @@ def write_cost_analysis(
                 **(dict(extra) if extra else {}),
                 **dict(sorted(cost.items())),
             }
-            with open(
-                os.path.join(profile_dir, "cost_analysis.json"), "w"
-            ) as f:
-                json.dump(payload, f, indent=1)
+            atomic_write_text(
+                os.path.join(profile_dir, "cost_analysis.json"),
+                json.dumps(payload, indent=1),
+            )
         if mem is not None:
-            with open(
-                os.path.join(profile_dir, "memory_analysis.json"), "w"
-            ) as f:
-                json.dump({"schema": OBS_SCHEMA_VERSION, **mem}, f, indent=1)
+            atomic_write_text(
+                os.path.join(profile_dir, "memory_analysis.json"),
+                json.dumps({"schema": OBS_SCHEMA_VERSION, **mem}, indent=1),
+            )
     except OSError:
         pass
     return cost

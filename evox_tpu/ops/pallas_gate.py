@@ -179,9 +179,10 @@ def run_capability_probe(timeout_s: float = _PROBE_TIMEOUT_S) -> dict:
     record["attachment"] = key
     records = _load_records()
     records[key] = record
+    from ..utils.checkpoint import atomic_write_text
+
     try:
-        with open(PROBE_RECORD_PATH, "w") as f:
-            json.dump(records, f, indent=1)
+        atomic_write_text(PROBE_RECORD_PATH, json.dumps(records, indent=1))
     except OSError:
         pass
     return record

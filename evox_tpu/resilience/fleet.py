@@ -161,7 +161,10 @@ class _PopenWorker:
     file under the heartbeat directory (the supervisor's flight recorder)."""
 
     def __init__(self, argv: Sequence[str], env: Mapping[str, str], log: Path):
-        self._log = open(log, "wb")
+        # A live subprocess stdout/stderr sink cannot be staged-and-renamed:
+        # the OS writes into it for the worker's whole lifetime.  Loss past
+        # the last flush on a crash is acceptable flight-recorder semantics.
+        self._log = open(log, "wb")  # graftlint: disable=GL009
         self.proc = subprocess.Popen(
             list(argv), env=dict(env), stdout=self._log, stderr=self._log
         )

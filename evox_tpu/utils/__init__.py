@@ -25,6 +25,7 @@ from .checkpoint import (
     CheckpointError,
     CheckpointStore,
     ReadOnlyCheckpointStore,
+    atomic_write_text,
     load_state,
     read_manifest,
     save_state,
@@ -57,6 +58,7 @@ __all__ = [
     "randint",
     "ParamsAndVector",
     "save_state",
+    "atomic_write_text",
     "load_state",
     "read_manifest",
     "verify_checkpoint",

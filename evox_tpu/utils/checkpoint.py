@@ -70,6 +70,7 @@ import numpy as np
 
 __all__ = [
     "save_state",
+    "atomic_write_text",
     "load_state",
     "read_manifest",
     "verify_checkpoint",
@@ -369,6 +370,45 @@ def save_state(
             store.fsync_dir(path.parent or Path("."))
     except BaseException:
         # Leave no temp litter on failure; the destination is untouched.
+        try:
+            store.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def atomic_write_text(
+    path: Union[str, Path],
+    text: str,
+    *,
+    durable: bool = False,
+    store: CheckpointStore | None = None,
+) -> Path:
+    """Publish ``text`` at ``path`` atomically through the
+    :class:`CheckpointStore` seam: temp file in the same directory,
+    ``os.replace`` into place, optional file+directory fsync.
+
+    This is the one sanctioned route for every host-side artifact writer
+    that is not a checkpoint or a journal (trace dumps, profile JSON,
+    flight-recorder bundles, probe records): a reader never observes a
+    torn file, and chaos tests can inject faults at the same seam the
+    checkpoint plane uses.  ``durable=False`` (the default) skips the
+    fsyncs — observability artifacts need atomicity, not
+    survive-power-loss durability."""
+    store = store if store is not None else _DEFAULT_STORE
+    path = Path(path)
+    parent = path.parent or Path(".")
+    fd, tmp = store.open_temp(parent, path.name + ".tmp.")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            if durable:
+                store.fsync_file(f)
+        store.publish(tmp, path)
+        if durable:
+            store.fsync_dir(parent)
+    except BaseException:
         try:
             store.unlink(tmp)
         except OSError:

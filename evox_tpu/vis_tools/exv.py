@@ -100,7 +100,11 @@ class EvoXVisionAdapter:
         :param buffering: passed to ``open``; 0 = unbuffered (each write
             lands immediately — the format is designed for streaming).
         """
-        self.writer = open(file_path, "wb", buffering=buffering)
+        # The .exv format streams length-prefixed records to an external
+        # live viewer as the run progresses — atomicity would defeat the
+        # streaming purpose, and a torn trailing record is skipped by the
+        # reader.  Not durable state; never replayed.
+        self.writer = open(file_path, "wb", buffering=buffering)  # graftlint: disable=GL009
         self.metadata: dict | None = None
         self.header_written = False
 

@@ -215,14 +215,19 @@
 #                               BENCH_HISTORY rows recorded otherwise,
 #                               artifacts under bench_artifacts/)
 #   ./run_tests.sh --lint       repo lints: the graftlint static-analysis
-#                               suite (GL000 assert ratchet + GL001-GL007
-#                               JAX-purity rules), then the lint test suite
-#                               incl. the compile-cache sentinel gate (an
-#                               algorithm matrix must compile exactly once
-#                               across 10 generations and checkpoint resume)
+#                               suite (GL000 assert ratchet + GL001-GL008
+#                               JAX-purity rules + GL009-GL013 host-plane
+#                               durability/purity/concurrency rules), a
+#                               SARIF 2.1.0 emitter smoke, then the lint
+#                               test suite incl. the compile-cache sentinel
+#                               gate (an algorithm matrix must compile
+#                               exactly once across 10 generations and
+#                               checkpoint resume)
 #   ./run_tests.sh --lint-fix-hints
 #                               graftlint with the suggested rewrite printed
-#                               under every finding (incl. baselined debt)
+#                               under every finding (incl. baselined debt;
+#                               GL009 prints the atomic temp+os.replace
+#                               recipe, GL010 the journal-before-ack one)
 #   ./run_tests.sh <pytest args>   passthrough
 CPU_ENV=(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
          XLA_FLAGS="--xla_force_host_platform_device_count=8"
@@ -230,6 +235,11 @@ CPU_ENV=(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 if [ "$1" = "--lint" ]; then
   shift
   python -m tools.graftlint "$@" || exit 1
+  # SARIF smoke: the emitter must produce a loadable 2.1.0 log for the
+  # full sweep (CI uploads it for annotation).
+  python -m tools.graftlint --sarif /tmp/graftlint.sarif >/dev/null || exit 1
+  python -c "import json; log = json.load(open('/tmp/graftlint.sarif')); \
+assert log['version'] == '2.1.0' and log['runs'][0]['tool']['driver']['name'] == 'graftlint'" || exit 1
   exec "${CPU_ENV[@]}" python -m pytest \
     tests/test_graftlint.py tests/test_compile_sentinel.py tests/test_tooling.py -q
 fi
@@ -276,6 +286,9 @@ if [ "$1" = "--serve" ]; then
   SERVE_TIMEOUT="${EVOX_TPU_SERVE_TIMEOUT:-1500}"
   timeout -k 30 "$SERVE_TIMEOUT" \
     "${CPU_ENV[@]}" python -m pytest tests/test_daemon.py -q "$@" || exit 1
+  # Serving-plane discipline: the host rules (GL009 durable writes, GL010
+  # journal-before-ack, GL011-GL013) must stay clean over the daemon path.
+  python -m tools.graftlint || exit 1
   exec timeout -k 30 900 "${CPU_ENV[@]}" python tools/bench_daemon.py
 fi
 if [ "$1" = "--gateway" ]; then
@@ -286,6 +299,9 @@ if [ "$1" = "--gateway" ]; then
   GATEWAY_TIMEOUT="${EVOX_TPU_GATEWAY_TIMEOUT:-1500}"
   timeout -k 30 "$GATEWAY_TIMEOUT" \
     "${CPU_ENV[@]}" python -m pytest tests/test_gateway.py -q "$@" || exit 1
+  # Endpoint-plane discipline: GL010's reply-only-after-append contract
+  # (the PR 16 defect shape) is machine-checked over the gateway path.
+  python -m tools.graftlint || exit 1
   exec timeout -k 30 900 "${CPU_ENV[@]}" python tools/bench_gateway.py
 fi
 if [ "$1" = "--router" ]; then
@@ -296,6 +312,9 @@ if [ "$1" = "--router" ]; then
   ROUTER_TIMEOUT="${EVOX_TPU_ROUTER_TIMEOUT:-1500}"
   timeout -k 30 "$ROUTER_TIMEOUT" \
     "${CPU_ENV[@]}" python -m pytest tests/test_router.py -q "$@" || exit 1
+  # Cross-host discipline: GL010 journal ordering plus GL012 identity
+  # determinism (placement digests must hash the same on every host).
+  python -m tools.graftlint || exit 1
   exec timeout -k 30 900 "${CPU_ENV[@]}" python tools/bench_router.py
 fi
 if [ "$1" = "--obs" ]; then

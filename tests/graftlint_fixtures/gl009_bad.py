@@ -1,0 +1,38 @@
+"""GL009 true positives: raw durable writes that bypass both the
+CheckpointStore seam and the atomic temp+os.replace idiom — a crash
+mid-write tears the very file a restart replays from."""
+
+import json
+import os
+import tempfile
+
+
+def checkpoint_naive(path, blob):
+    # The classic torn-write shape: truncate-then-write in place.
+    with open(path, "w") as f:  # GL009
+        f.write(blob)
+
+
+def heartbeat_raw(fd, payload):
+    # Raw descriptor write to a liveness file the supervisor reads back.
+    os.write(fd, payload)  # GL009
+
+
+def manifest_dump(path, manifest):
+    # Both halves are wrong: the write-mode open AND the in-place dump.
+    with open(path, "w") as f:  # GL009
+        json.dump(manifest, f)  # GL009
+
+
+def publish_record(path, text):
+    # pathlib sugar over the same torn write.
+    path.write_text(text)  # GL009
+
+
+def tempfile_without_publish(directory, blob):
+    # Half the idiom is no idiom: a temp file that is never os.replace-d
+    # into place leaves readers pointed at a stale (or missing) file.
+    fd, tmp = tempfile.mkstemp(dir=directory)
+    with os.fdopen(fd, "w") as f:  # GL009
+        f.write(blob)
+    return tmp

@@ -1,0 +1,69 @@
+"""GL009 negatives: the sanctioned durable-write shapes — the store seam
+owning its raw descriptors, the checkpoint plane's real atomic idiom
+(``utils/checkpoint.py``'s ``save_state`` shape), the bare
+``tempfile.mkstemp`` + ``os.replace`` variant, and plain reads."""
+
+import json
+import os
+import tempfile
+
+
+class ArtifactStore:
+    """The seam implementation: raw file ops live HERE by design, so chaos
+    tests can subclass and inject torn publishes at one point."""
+
+    def open_temp(self, directory, prefix):
+        return tempfile.mkstemp(dir=directory, prefix=prefix)
+
+    def open_append(self, path):
+        return open(path, "ab")
+
+    def fsync_file(self, f):
+        f.flush()
+        os.fsync(f.fileno())
+
+    def publish(self, tmp, final):
+        os.replace(tmp, final)
+
+
+_STORE = ArtifactStore()
+
+
+def save_blob(path, blob, durable=True):
+    # The checkpoint plane's real idiom: same-directory temp, optional
+    # fsync, atomic publish, temp cleanup on failure.
+    fd, tmp = _STORE.open_temp(path.parent, path.name + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            if durable:
+                _STORE.fsync_file(f)
+        _STORE.publish(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def write_metrics_atomic(path, text):
+    # The bare stdlib variant of the same idiom (the Prometheus textfile
+    # writer's shape): mkstemp + os.replace, fsync optional.
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+    with os.fdopen(fd, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+def read_config(path):
+    # Read-mode opens are not durable writes.
+    with open(path) as f:
+        return json.load(f)
+
+
+def read_archive(path):
+    with open(path, "rb") as f:
+        return f.read()

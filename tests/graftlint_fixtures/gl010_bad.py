@@ -1,0 +1,43 @@
+"""GL010 true positives: the two historical post-review-hardening defect
+shapes, mechanized — PR-11's "evict/forget mutated state BEFORE the journal
+append" (replay resurrects the tenant) and PR-16's "reply before the
+append" (the acked request vanishes at the next crash)."""
+
+
+class BrokenDaemon:
+    """Every handler below acks or destroys state on a path that never
+    passed ``self.journal.append``."""
+
+    def __init__(self, journal):
+        self.journal = journal
+        self._tenants = {}
+        self._pending = {}
+
+    def evict(self, tenant_id):
+        # PR-11 shape: the tenant is gone from memory before the intent is
+        # durable — a crash between the two lines resurrects it on replay.
+        self._tenants.pop(tenant_id)  # GL010
+        self.journal.append("evict", tenant_id=tenant_id)
+
+    def forget(self, tenant_id):
+        self._pending.pop(tenant_id, None)  # GL010
+        del self._tenants[tenant_id]  # GL010
+        self.journal.append("forget", tenant_id=tenant_id)
+
+    def submit(self, spec):
+        record = self._admit(spec)
+        # PR-16 shape: the caller takes this as the ack, but nothing was
+        # journaled — the admission does not survive a restart.
+        return record  # GL010
+
+    def _admit(self, spec):
+        self._tenants[spec] = object()
+        return self._tenants[spec]
+
+    def steer(self, tenant_id, knobs):
+        if tenant_id not in self._tenants:
+            self.journal.append("steer-miss", tenant_id=tenant_id)
+            return dict(knobs)
+        # Path-sensitivity: the branch above journals, but THIS path acks
+        # without ever reaching an append.
+        return dict(knobs)  # GL010

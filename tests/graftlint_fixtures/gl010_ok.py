@@ -1,0 +1,81 @@
+"""GL010 negatives: the serving stack's real (fixed) orderings — journal
+before mutate, additive-admit with ``except JournalError`` compensation,
+same-class journaling closure, idempotent-replay early acks, refusal
+tuples, and delegation to the journaling plane."""
+
+
+class JournalError(RuntimeError):
+    pass
+
+
+class Daemon:
+    """The post-PR-11 daemon shapes."""
+
+    def __init__(self, journal, service):
+        self.journal = journal
+        self.service = service
+
+    def _journal(self, kind, **data):
+        self.journal.append(kind, **data)
+
+    def evict(self, tenant_id):
+        # Destructive op: journal FIRST, mutate only once the record is
+        # durable (the PR-11 fix).
+        self._journal("evict", tenant_id=tenant_id)
+        self.service.evict(tenant_id)
+
+    def forget(self, tenant_id):
+        if not self.service.has(tenant_id):
+            return  # a no-op is not an ack
+        self._journal("forget", tenant_id=tenant_id)
+        self.service.forget(tenant_id)
+
+    def submit(self, spec):
+        # Additive admit BEFORE the append is fine — the compensation
+        # inside `except JournalError` un-admits when the record could not
+        # be made durable, so no acked-but-unjournaled tenant survives.
+        record = self.service.submit(spec)
+        try:
+            self._journal("submit", tenant_id=record)
+        except JournalError:
+            self.service.withdraw(record)
+            raise
+        return record
+
+    def park(self, tenant_id):
+        # Same-class closure: evict() journals before mutating, so this
+        # ack is downstream of the append.
+        self.evict(tenant_id)
+        return "parked"
+
+
+class Gateway:
+    """The post-PR-16 gateway shapes."""
+
+    def __init__(self, daemon, journal_extra=None):
+        self.daemon = daemon
+        self._idem = {}
+        self._journal_extra = journal_extra
+
+    def _idem_replay(self, key):
+        return self._idem.get(key)
+
+    def _submit(self, key, spec):
+        replay = self._idem_replay(key)
+        if replay is not None:
+            # Re-send of an ack that is already durable: the sanctioned
+            # early return.
+            return replay
+        if spec is None:
+            return 400, {"error": "bad-spec"}  # a refusal is not an ack
+        record = self.daemon.submit(spec)  # the daemon journals before acking
+        self._idem[key] = record
+        return 201, {"uid": record}
+
+    def _withdraw(self, key, tenant_id):
+        replay = self._idem_replay(key)
+        if replay is not None:
+            return replay
+        prior = self.daemon.park(tenant_id)
+        self._idem[key] = prior
+        return 200, {"was": prior}

@@ -1,0 +1,47 @@
+"""GL011 true positives: journaled deciders reading ambient state or
+mutating — every one of these replays differently than it decided."""
+
+import os
+import random
+import time
+import uuid
+from datetime import datetime
+
+
+def decide_restart(evidence):
+    # Wall clock: replaying the journal at a different time flips the
+    # decision the journal claims was made.
+    if time.time() - evidence["last_restart"] > 60:  # GL011
+        return "restart"
+    return ""
+
+
+def decide_cadence(evidence):
+    jitter = random.random()  # GL011
+    return int(evidence["segment_len"] * (1.0 + jitter))
+
+
+def decide_shed(evidence):
+    if os.environ.get("EVOX_SHED"):  # GL011
+        return 1
+    evidence["seen"] = True  # GL011
+    return 0
+
+
+def decide_tag(evidence):
+    return str(uuid.uuid4())  # GL011
+
+
+class Controller:
+    def decide_tenant(self, evidence):
+        # Attribute mutation inside a decider: the decision now depends on
+        # (and changes) controller state the journal never captured.
+        self.last_decision = datetime.now()  # GL011
+        return "keep"
+
+
+_DECIDERS = {
+    "restart": decide_restart,
+    "cadence": decide_cadence,
+    "noise": lambda e: random.choice(["a", "b"]),  # GL011
+}

@@ -1,0 +1,52 @@
+"""GL011 negatives: the control plane's real decider shapes
+(``control/controller.py``) — pure functions of the evidence mapping,
+registered through ``_DECIDERS``, with clocks pre-sampled by the caller
+and riding IN the evidence."""
+
+from typing import Any, Callable, Mapping
+
+
+def _num(evidence, name, default=0.0):
+    try:
+        return float(evidence.get(name, default))
+    except (TypeError, ValueError):
+        return float(default)
+
+
+def decide_trend(evidence):
+    # Every input comes from the evidence: the caller sampled the clock
+    # ONCE, journaled the sample, and replay reuses the journaled value.
+    slope = _num(evidence, "fitness_slope")
+    nonfinite = _num(evidence, "nonfinite_fraction")
+    if nonfinite > 0.5:
+        return "restart"
+    if slope >= 0.0 and _num(evidence, "window_full") >= 1.0:
+        return "reinit"
+    return ""
+
+
+def decide_cadence(evidence):
+    ratio = _num(evidence, "compile_execute_ratio", 1.0)
+    segment = int(_num(evidence, "segment_len", 16))
+    if ratio > 2.0:
+        return max(1, segment // 2)
+    return min(4 * segment, 512)
+
+
+def decide_elapsed(evidence):
+    # "Time" is fine when it is DATA: the elapsed seconds were measured by
+    # the caller and journaled with the evidence.
+    return "brownout" if _num(evidence, "elapsed_seconds") > 30.0 else ""
+
+
+_DECIDERS: dict[str, Callable[[Mapping[str, Any]], Any]] = {
+    "trend": decide_trend,
+    "cadence": decide_cadence,
+    "elapsed": decide_elapsed,
+    "degrade": lambda e: "threshold-probes",
+}
+
+
+def decide(kind, evidence):
+    decider = _DECIDERS.get(kind)
+    return "" if decider is None else decider(evidence)

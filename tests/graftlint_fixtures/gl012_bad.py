@@ -1,0 +1,36 @@
+"""GL012 true positives: dict/set iteration order flowing into identities
+— two hosts (or two runs) disagree on the digest of the SAME logical
+content, so dedup keys and manifests stop being stable."""
+
+import hashlib
+
+
+def bucket_key(spec):
+    h = hashlib.sha256()
+    for name, value in spec.items():  # GL012
+        h.update(f"{name}={value}".encode())
+    return h.hexdigest()
+
+
+def config_digest(config):
+    h = hashlib.blake2b(digest_size=8)
+    for key in config.keys():  # GL012
+        h.update(key.encode())
+    return h.hexdigest()
+
+
+class Record:
+    def __init__(self, attrs):
+        self.attrs = attrs
+
+    def to_manifest(self):
+        # The manifest is journaled: an order-sensitive list built from an
+        # unordered mapping makes replay diverge across hosts.
+        return [f"{k}:{v}" for k, v in self.attrs.items()]  # GL012
+
+
+def manifest_fingerprint(names, extras):
+    h = hashlib.sha1()
+    for name in set(names) | set(extras):  # GL012
+        h.update(name.encode())
+    return h.hexdigest()

@@ -1,0 +1,44 @@
+"""GL012 negatives: the sanctioned determinism shapes — ``sorted(...)``
+around every unordered source, ``json.dumps(..., sort_keys=True)``
+canonicalization, order-insensitive comprehension targets, and
+non-identity rendering code that is allowed to be order-free."""
+
+import hashlib
+import json
+
+
+def bucket_key(spec):
+    h = hashlib.sha256()
+    for name, value in sorted(spec.items()):
+        h.update(f"{name}={value}".encode())
+    return h.hexdigest()
+
+
+def config_digest(config):
+    # Canonicalizing through json with sort_keys=True fixes the order for
+    # the whole function.
+    blob = json.dumps(config, sort_keys=True, default=repr)
+    return hashlib.blake2b(blob.encode(), digest_size=8).hexdigest()
+
+
+class Record:
+    def __init__(self, attrs):
+        self.attrs = attrs
+
+    def to_manifest(self):
+        return {k: str(v) for k, v in self.attrs.items()}
+
+    def manifest_fingerprint(self):
+        h = hashlib.sha1()
+        for key in sorted(set(self.attrs) | {"schema"}):
+            h.update(key.encode())
+        return h.hexdigest()
+
+
+def render_table(rows):
+    # Not an identity: no hashing, no journal append — free to iterate in
+    # whatever order the mapping yields.
+    lines = []
+    for name, value in rows.items():
+        lines.append(f"{name}\t{value}")
+    return "\n".join(lines)

@@ -1,0 +1,46 @@
+"""GL013 true positives: attributes shared with a worker thread written
+both under the lock and bare (one side is racing), and a two-lock class
+that nests the locks in both orders (deadlock under contention)."""
+
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dirty = False
+        self._rows = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._dirty = True  # GL013
+
+    def ingest(self, row):
+        self._rows.append(row)
+        self._dirty = True  # GL013
+
+    def flush(self):
+        with self._lock:
+            rows, self._rows = self._rows, []
+            self._dirty = False
+        return rows
+
+
+class Pipeline:
+    def __init__(self):
+        self._head_lock = threading.Lock()
+        self._tail_lock = threading.Lock()
+        self._head = []
+        self._tail = []
+
+    def push(self, item):
+        with self._head_lock:
+            with self._tail_lock:  # GL013
+                self._tail.append(item)
+
+    def steal(self):
+        with self._tail_lock:
+            with self._head_lock:
+                return list(self._head)

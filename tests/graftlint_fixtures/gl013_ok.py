@@ -1,0 +1,67 @@
+"""GL013 negatives: the checkpoint writer's real discipline — every
+attribute the worker thread shares is written under the one condition
+variable, thread-private counters stay single-scope, and a two-lock class
+picks one global acquisition order."""
+
+import threading
+
+
+class AsyncWriter:
+    """The ``AsyncCheckpointWriter`` shape: one Condition owns the
+    handoff state on both sides."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._job = None
+        self._busy = False
+        self.writes_completed = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while self._job is None:
+                    self._cv.wait()
+                job = self._job
+                self._job = None
+            job()
+            with self._cv:
+                self._busy = False
+                self._cv.notify_all()
+            # Single-scope: only the worker ever touches this counter, so
+            # no lock is required.
+            self.writes_completed += 1
+
+    def submit_job(self, job):
+        with self._cv:
+            while self._busy:
+                self._cv.wait()
+            self._job = job
+            self._busy = True
+            self._cv.notify_all()
+
+    def drain(self):
+        with self._cv:
+            while self._busy or self._job is not None:
+                self._cv.wait()
+
+
+class Ordered:
+    """Two locks, one global order: head before tail, everywhere."""
+
+    def __init__(self):
+        self._head_lock = threading.Lock()
+        self._tail_lock = threading.Lock()
+        self._head = []
+        self._tail = []
+
+    def push(self, item):
+        with self._head_lock:
+            with self._tail_lock:
+                self._tail.append(item)
+
+    def rotate(self):
+        with self._head_lock:
+            with self._tail_lock:
+                self._head, self._tail = self._tail, []

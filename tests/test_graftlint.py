@@ -546,7 +546,7 @@ def test_new_rules_start_at_zero():
     )
     assert sorted(committed) == [
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008",
+        "GL008", "GL009", "GL010", "GL011", "GL012", "GL013",
     ]
     assert all(files == {} for files in committed.values()), (
         "GL001+ baselines must stay empty — fix or pragma new findings "
@@ -661,6 +661,238 @@ def test_counts_match_gl000_baseline_exactly():
     counts = group_counts(findings).get("GL000", {})
     committed = json.loads((REPO / "tools" / "assert_baseline.json").read_text())
     assert counts == committed
+
+
+# ---------------------------------------------------------------------------
+# host plane (GL009-GL013)
+# ---------------------------------------------------------------------------
+
+
+def test_gl009_flags_raw_manifest_write_and_fixed_shape_is_clean(tmp_path):
+    """The obs bundle-export defect shape this rule mechanizes: manifest
+    written with a bare `open(..., "w")` + `json.dump` — a crash mid-write
+    leaves a torn completeness marker.  The fixed shape (route through the
+    store seam's atomic_write_text) must be clean."""
+    bad = tmp_path / "bundle.py"
+    bad.write_text(
+        "import json\n"
+        "def export(out_dir, manifest):\n"
+        "    with open(out_dir / 'manifest.json', 'w') as f:\n"
+        "        json.dump(manifest, f)\n"
+    )
+    found = _findings(bad, ["GL009"])
+    assert len(found) == 2, [f.format() for f in found]  # open + dump
+    fixed = tmp_path / "fixed.py"
+    fixed.write_text(
+        "import json\n"
+        "from evox_tpu.utils.checkpoint import atomic_write_text\n"
+        "def export(out_dir, manifest):\n"
+        "    atomic_write_text(out_dir / 'manifest.json', json.dumps(manifest))\n"
+    )
+    assert not _findings(fixed, ["GL009"])
+    # And the real store seam + every migrated obs writer hold the rule.
+    clean = scan_paths(
+        [
+            REPO / "evox_tpu" / "utils" / "checkpoint.py",
+            REPO / "evox_tpu" / "obs",
+        ],
+        [RULES_BY_CODE["GL009"]],
+    )
+    assert not clean, "\n".join(f.format() for f in clean)
+
+
+def test_gl010_flags_pr11_evict_before_journal(tmp_path):
+    """The historical defect this rule exists for: PR 11's review found the
+    daemon evicted/forgot IN MEMORY before journaling the intent, so a
+    crash between the two resurrected the tenant on replay.  Re-introducing
+    that exact ordering must flag; the fixed journal-first shape must not."""
+    src = tmp_path / "regress.py"
+    src.write_text(
+        "class TenantDaemon:\n"
+        "    def __init__(self, journal, service):\n"
+        "        self.journal = journal\n"
+        "        self.service = service\n"
+        "        self._tenants = {}\n"
+        "    def evict(self, uid):\n"
+        "        self._tenants.pop(uid)\n"
+        "        self.journal.append('evict', tenant_id=uid)\n"
+    )
+    found = _findings(src, ["GL010"])
+    assert [f.rule for f in found] == ["GL010"], [f.format() for f in found]
+    assert "PR-11" in found[0].message
+    fixed = tmp_path / "fixed.py"
+    fixed.write_text(
+        "class TenantDaemon:\n"
+        "    def __init__(self, journal, service):\n"
+        "        self.journal = journal\n"
+        "        self._tenants = {}\n"
+        "    def evict(self, uid):\n"
+        "        self.journal.append('evict', tenant_id=uid)\n"
+        "        self._tenants.pop(uid)\n"
+    )
+    assert not _findings(fixed, ["GL010"])
+
+
+def test_gl010_serving_stack_holds_the_ordering():
+    """The current (fixed) daemon/gateway/router must hold the contract:
+    nothing unsuppressed anywhere in the serving plane, and the router's
+    two sanctioned idempotent-replay acks are visible to the raw rule but
+    pragma'd (same structure as the GL006/GL007 sanctioned-site tests)."""
+    rule = RULES_BY_CODE["GL010"]
+    mod = Module(REPO / "evox_tpu" / "service" / "router.py")
+    raw = rule.check(mod)
+    assert len(raw) == 2, [f.format() for f in raw]
+    assert all(mod.suppressed(f) for f in raw)
+    found = scan_paths([REPO / "evox_tpu" / "service"], [rule])
+    assert not found, "\n".join(f.format() for f in found)
+
+
+def test_gl011_flags_clocked_decider_and_real_deciders_are_clean(tmp_path):
+    """A decider that samples the wall clock replays differently than it
+    decided; the control plane's registered deciders must stay pure."""
+    src = tmp_path / "regress.py"
+    src.write_text(
+        "import time\n"
+        "def decide_restart(evidence):\n"
+        "    return 'restart' if time.time() > evidence['deadline'] else ''\n"
+    )
+    found = _findings(src, ["GL011"])
+    assert [f.rule for f in found] == ["GL011"], [f.format() for f in found]
+    clean = scan_paths([REPO / "evox_tpu" / "control"], [RULES_BY_CODE["GL011"]])
+    assert not clean, "\n".join(f.format() for f in clean)
+
+
+def test_gl012_flags_unsorted_bucket_key_and_real_identities_are_clean(tmp_path):
+    """The dedup bucket_key digest iterating a dict in hash order computes
+    different identities on different hosts; the real identity builders
+    (exec-cache keys, checkpoint manifests, journal payloads) must all
+    sort or canonicalize."""
+    src = tmp_path / "regress.py"
+    src.write_text(
+        "import hashlib\n"
+        "def bucket_key(spec):\n"
+        "    h = hashlib.sha256()\n"
+        "    for k, v in spec.items():\n"
+        "        h.update(f'{k}={v}'.encode())\n"
+        "    return h.hexdigest()\n"
+    )
+    found = _findings(src, ["GL012"])
+    assert [f.rule for f in found] == ["GL012"], [f.format() for f in found]
+    clean = scan_paths([REPO / "evox_tpu"], [RULES_BY_CODE["GL012"]])
+    assert not clean, "\n".join(f.format() for f in clean)
+
+
+def test_gl013_flags_bare_shared_write_and_real_writer_is_clean(tmp_path):
+    """The async-writer shape with the condition variable dropped on ONE
+    side is a data race; the real AsyncCheckpointWriter holds every shared
+    write under its Condition."""
+    src = tmp_path / "regress.py"
+    src.write_text(
+        "import threading\n"
+        "class Writer:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self._job = None\n"
+        "        self._thread = threading.Thread(target=self._loop)\n"
+        "    def _loop(self):\n"
+        "        while True:\n"
+        "            self._job = None\n"
+        "    def submit(self, job):\n"
+        "        with self._cv:\n"
+        "            self._job = job\n"
+    )
+    found = _findings(src, ["GL013"])
+    assert [f.rule for f in found] == ["GL013"], [f.format() for f in found]
+    clean = scan_paths([REPO / "evox_tpu"], [RULES_BY_CODE["GL013"]])
+    assert not clean, "\n".join(f.format() for f in clean)
+
+
+def test_host_rule_pragma_and_ratchet_semantics(tmp_path):
+    """Host-plane rules ride the same pragma and ratchet machinery as the
+    compiled-plane ones: a def-line pragma suppresses the whole handler,
+    and baselined counts only go down."""
+    src = tmp_path / "snippet.py"
+    body = (
+        "class D:\n"
+        "    def __init__(self, journal):\n"
+        "        self.journal = journal\n"
+        "        self._t = {{}}\n"
+        "    def evict(self, uid):{pragma}\n"
+        "        self._t.pop(uid)\n"
+        "        self.journal.append('evict', uid=uid)\n"
+    )
+    src.write_text(body.format(pragma=""))
+    findings = _findings(src, ["GL010"])
+    assert len(findings) == 1
+    src.write_text(
+        body.format(pragma="  # graftlint: disable=GL010 replay-safe by test")
+    )
+    assert not _findings(src, ["GL010"])
+    # ratchet: the baselined count passes, one fewer fails
+    src.write_text(body.format(pragma=""))
+    findings = _findings(src, ["GL010"])
+    rel = findings[0].path
+    ok_problems, _ = check_ratchet(findings, {"GL010": {rel: 1}})
+    assert not ok_problems
+    over_problems, over = check_ratchet(findings, {"GL010": {}})
+    assert over_problems and len(over) == 1
+
+
+def test_sarif_emitter_round_trips(tmp_path):
+    """--sarif writes a SARIF 2.1.0 log that loads back with the driver,
+    rule metadata, and one result per finding (level `error` for ratchet
+    violations)."""
+    out = tmp_path / "lint.sarif"
+    bad = FIXTURES / "gl010_bad.py"
+    rc = graftlint_main(
+        [str(bad), "--select", "GL010", "--no-baseline", "--sarif", str(out)]
+    )
+    assert rc == 1
+    log = json.loads(out.read_text())
+    assert log["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in log["$schema"]
+    run = log["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    assert [r["id"] for r in driver["rules"]] == ["GL010"]
+    assert driver["rules"][0]["shortDescription"]["text"]
+    expected = len(_findings(bad, ["GL010"]))
+    assert len(run["results"]) == expected
+    for res in run["results"]:
+        assert res["ruleId"] == "GL010"
+        assert res["level"] == "error"  # --no-baseline: every finding violates
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("gl010_bad.py")
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+    # a clean scan still writes a loadable log with zero results
+    ok_out = tmp_path / "clean.sarif"
+    rc = graftlint_main(
+        [
+            str(FIXTURES / "gl010_ok.py"),
+            "--select",
+            "GL010",
+            "--no-baseline",
+            "--sarif",
+            str(ok_out),
+        ]
+    )
+    assert rc == 0
+    assert json.loads(ok_out.read_text())["runs"][0]["results"] == []
+
+
+def test_atomic_write_text_publishes_atomically(tmp_path):
+    """Behavioral counterpart of GL009: the sanctioned helper publishes via
+    temp + os.replace (no partial file visible), survives overwrite, and
+    leaves no temp droppings on failure."""
+    from evox_tpu.utils.checkpoint import atomic_write_text
+
+    target = tmp_path / "manifest.json"
+    atomic_write_text(target, '{"complete": true}\n')
+    assert target.read_text() == '{"complete": true}\n'
+    atomic_write_text(target, "v2\n", durable=True)
+    assert target.read_text() == "v2\n"
+    assert [p.name for p in tmp_path.iterdir()] == ["manifest.json"]
 
 
 # ---------------------------------------------------------------------------

@@ -7,6 +7,7 @@
     python -m tools.graftlint path/to/file.py      # explicit files/dirs
     python -m tools.graftlint --no-baseline        # absolute mode: any finding fails
     python -m tools.graftlint --lint-fix-hints     # print the suggested rewrite per finding
+    python -m tools.graftlint --sarif out.sarif    # also emit a SARIF 2.1.0 log
     python -m tools.graftlint --update-baseline    # after REMOVING findings (refuses increases)
     python -m tools.graftlint --list-rules         # rule catalog
     python -m tools.graftlint bench-table [--check] [--rebaseline]
@@ -55,10 +56,18 @@ def main(argv: list[str] | None = None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="JAX-aware static analysis for evox_tpu (rules GL000-GL005).",
+        description=(
+            "JAX-aware static analysis for evox_tpu: compiled-plane rules "
+            "GL000-GL008 and host-plane rules GL009-GL013."
+        ),
     )
     ap.add_argument("paths", nargs="*", help="files/dirs to lint (default: evox_tpu/)")
     ap.add_argument("--select", help="comma-separated rule codes, e.g. GL001,GL005")
+    ap.add_argument(
+        "--sarif",
+        metavar="PATH",
+        help="also write the findings as a SARIF 2.1.0 log to PATH",
+    )
     ap.add_argument(
         "--update-baseline",
         action="store_true",
@@ -105,6 +114,11 @@ def main(argv: list[str] | None = None) -> int:
 
     baselines = {} if args.no_baseline else load_baselines()
     problems, violating = check_ratchet(findings, baselines)
+    if args.sarif:
+        from .sarif import write_sarif
+
+        write_sarif(Path(args.sarif), findings, rules, violating=violating)
+        print(f"wrote SARIF log: {args.sarif}")
     if problems:
         print("graftlint ratchet violations:")
         for f in sorted(violating, key=lambda f: (f.rule, f.path, f.line)):

@@ -58,6 +58,8 @@ __all__ = [
     "check_ratchet",
     "load_baselines",
     "update_baselines",
+    "class_identifiers",
+    "walk_gate_order",
     "REPO",
     "LIBRARY_ROOT",
     "BASELINE_PATH",
@@ -200,6 +202,134 @@ class Rule:
             message=message,
             hint=self.hint if hint is None else hint,
         )
+
+
+# ---------------------------------------------------------------------------
+# host-plane analyses (handler-scope resolution + call-order reachability)
+# ---------------------------------------------------------------------------
+
+def class_identifiers(cls: ast.ClassDef) -> set[str]:
+    """Every identifier-position string in a class body: names, attribute
+    tails, keyword-argument names, and parameter names.  Docstrings and
+    comments deliberately do NOT count — the host-plane rules use this for
+    handler-scope resolution ("does this class actually touch a journal?"),
+    and prose mentioning a journal must not pull an in-memory class into the
+    durability contract."""
+    idents: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Name):
+            idents.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            idents.add(node.attr)
+        elif isinstance(node, ast.keyword) and node.arg:
+            idents.add(node.arg)
+        elif isinstance(node, ast.arg):
+            idents.add(node.arg)
+    return idents
+
+
+def walk_gate_order(
+    body: list[ast.stmt],
+    *,
+    is_gate,
+    on_stmt,
+    entry_gated: bool = False,
+    handler_entry_gated=None,
+) -> tuple[bool, bool]:
+    """Path-sensitive **must-gate** walk over one function body.
+
+    ``is_gate(stmt) -> bool`` marks the statements that establish the gate
+    (for GL010: a durable journal append).  ``on_stmt(stmt, gated)`` is
+    invoked for every reachable simple statement with the *must*-gated state
+    on entry to that statement — ``gated`` is True only if EVERY path from
+    the function entry to the statement passed a gate.  Because a statement's
+    own value expression evaluates before its effect (``return journal()``
+    acks after the append), a statement that is itself a gate is reported as
+    gated.
+
+    Control flow is merged conservatively:
+
+    * ``if``/``match``: a join is gated only when every non-terminating arm
+      is gated (a missing ``else`` is an ungated fall-through);
+    * loops: the body and everything after the loop see the loop-entry state
+      (a gate inside a loop body never proves the zero-iteration path);
+    * ``try``: an exception may fire before any statement ran, so handler
+      bodies re-enter with the ``try``-entry state — unless
+      ``handler_entry_gated(handler)`` says the handler can only be reached
+      after the gate was *attempted* (GL010 passes a ``JournalError`` test:
+      compensating inside ``except JournalError`` is the sanctioned
+      post-attempt cleanup, not an ack-before-journal);
+    * ``return``/``raise``/``break``/``continue`` terminate their path and
+      are excluded from joins.
+
+    Returns ``(gated_at_exit, every_path_terminated)``.
+    """
+
+    def walk(stmts: list[ast.stmt], gated: bool) -> tuple[bool, bool]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scopes are separate analyses
+            if isinstance(stmt, ast.If):
+                arms = [walk(stmt.body, gated), walk(stmt.orelse, gated)]
+                alive = [g for g, term in arms if not term]
+                if not alive:
+                    return gated, True
+                gated = all(alive)
+                continue
+            if isinstance(stmt, ast.Match):
+                arms = [walk(case.body, gated) for case in stmt.cases]
+                # No wildcard case => an unmatched subject falls through
+                # with the entry state.
+                if not any(
+                    isinstance(c.pattern, ast.MatchAs) and c.pattern.pattern is None
+                    for c in stmt.cases
+                ):
+                    arms.append((gated, False))
+                alive = [g for g, term in arms if not term]
+                if not alive:
+                    return gated, True
+                gated = all(alive)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                walk(stmt.body, gated)
+                walk(stmt.orelse, gated)
+                continue  # after-state = entry state (zero-iteration path)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                gated, term = walk(stmt.body, gated)
+                if term:
+                    return gated, True
+                continue
+            if isinstance(stmt, ast.Try):
+                g_try, t_try = walk(stmt.body, gated)
+                alive: list[bool] = []
+                for handler in stmt.handlers:
+                    g_h = gated or bool(
+                        handler_entry_gated and handler_entry_gated(handler)
+                    )
+                    g_h, t_h = walk(handler.body, g_h)
+                    if not t_h:
+                        alive.append(g_h)
+                if not t_try:
+                    g_else, t_else = walk(stmt.orelse, g_try)
+                    if not t_else:
+                        alive.append(g_else)
+                g_after = all(alive) if alive else g_try
+                g_fin, t_fin = walk(stmt.finalbody, gated)
+                if t_fin:
+                    return g_fin, True
+                gated = g_after or g_fin
+                if not alive and t_try:
+                    return gated, True
+                continue
+            # -- simple statements ------------------------------------------
+            g_here = gated or is_gate(stmt)
+            on_stmt(stmt, g_here)
+            gated = g_here
+            if isinstance(stmt, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+                return gated, True
+        return gated, False
+
+    return walk(body, entry_gated)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,868 @@
+"""graftlint host-plane rules GL009-GL013: the serving-stack contracts.
+
+GL000-GL008 machine-check the *compiled* plane (purity, trace safety,
+compile-once).  The framework's second load-bearing claim — the host-side
+serving plane is crash-safe and replayable bit-for-bit — lived only in
+convention and chaos tests until this family.  Each rule encodes one
+invariant that was hand-repaired at least once in post-review hardening
+(CHANGES.md PRs 11, 12, 16, 17):
+
+* **GL009 — durable-write discipline.**  A raw write-mode ``open``/
+  ``os.fdopen``/``os.write``/``json.dump``/``Path.write_text`` in library
+  code bypasses both the ``CheckpointStore`` seam and the atomic
+  temp+fsync+``os.replace``+dir-fsync idiom, so a crash mid-write tears the
+  very file a restart replays from.  The idiom is recognized
+  *structurally*: a function that creates a same-directory temp file
+  (``tempfile.mkstemp``/``store.open_temp``) and publishes it
+  (``os.replace``/``store.publish``) owns its raw descriptors, and methods
+  of ``*Store`` classes ARE the seam — ``utils/checkpoint.py`` passes as
+  the ok-exemplar, not via pragma.
+* **GL010 — ack-before-journal.**  In gateway/daemon/router mutating-handler
+  scope, an ack (a non-refusal ``return``) or a destructive state mutation
+  (``pop``/``discard``/``clear``/``evict``/``forget``/``withdraw``...) must
+  not be reachable on a path that has not passed the journal append: an
+  acked-but-unjournaled request silently vanishes at the next crash, and a
+  mutated-but-unjournaled eviction resurrects the tenant on replay (the
+  PR-11 "journal BEFORE mutating" and PR-16 "reply only after the append"
+  fixes, mechanized).  Must-gate reachability comes from
+  :func:`~tools.graftlint.engine.walk_gate_order`; ``except JournalError``
+  bodies are post-attempt compensation scope, idempotent-replay acks
+  (values produced by ``*replay*``/``*idem*`` calls) are re-sends of an
+  already-durable ack, and ``(>=400, ...)`` tuples are refusals, not acks.
+* **GL011 — decider purity.**  Functions registered in
+  ``control._DECIDERS`` (or named ``decide_*``) replay bit-for-bit from the
+  journal, so they must be pure functions of their evidence mapping: no
+  clock/random/uuid/environment reads, no I/O, no attribute or
+  evidence mutation.
+* **GL012 — nondeterministic iteration into identity.**  Dict/set
+  iteration order reaching a journaled payload, a ``bucket_key`` digest, or
+  a manifest without an intervening ``sorted()`` makes "identical" runs
+  hash differently across processes.  Functions that canonicalize through
+  ``json.dumps(..., sort_keys=True)`` are order-insensitive and exempt.
+* **GL013 — lock discipline.**  Within a class that owns both a lock and a
+  ``threading.Thread`` target, an attribute written from the thread scope
+  and from public methods must be *consistently* locked — a mix of
+  ``with self._lock:`` writes and bare writes to the same attribute means
+  one side is racing.  Also: two locks of one class acquired in both
+  nesting orders is an ABBA deadlock waiting for load.
+
+Like the compiled-plane rules, everything here is an AST heuristic tuned
+for zero false positives on this codebase; the escape hatch is the same
+``# graftlint: disable=GLxxx`` pragma with a written justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .engine import Finding, Module, Rule, class_identifiers, walk_gate_order
+from .rules import _body_walk, _dotted, _iter_functions
+
+__all__ = ["HOST_RULES"]
+
+
+def _tail(chain: str | None) -> str:
+    return (chain or "").rsplit(".", 1)[-1]
+
+
+def _iter_classes(tree: ast.Module) -> Iterator[ast.ClassDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def _enclosing_map(
+    tree: ast.Module,
+) -> list[tuple[ast.FunctionDef | ast.AsyncFunctionDef, str | None]]:
+    """``(function, class_name)`` pairs, innermost functions included."""
+    return [(fn, cls) for fn, cls, _ in _iter_functions(tree)]
+
+
+# ---------------------------------------------------------------------------
+# GL009 — durable-write discipline
+# ---------------------------------------------------------------------------
+
+_WRITE_MODES = set("wax+")
+
+
+def _is_write_mode(node: ast.expr | None) -> bool:
+    return (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and any(ch in _WRITE_MODES for ch in node.value)
+    )
+
+
+def _call_mode(call: ast.Call, positional: int) -> ast.expr | None:
+    if len(call.args) > positional:
+        return call.args[positional]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            return kw.value
+    return None
+
+
+def _has_atomic_idiom(fn: ast.AST) -> bool:
+    """A temp-file creation AND a publish in the same function body: the
+    raw descriptors in between belong to the atomic idiom."""
+    has_temp = has_publish = False
+    for node in _body_walk(fn, into_nested=True):
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func) or ""
+            tail = _tail(chain)
+            if tail in ("mkstemp", "open_temp", "NamedTemporaryFile"):
+                has_temp = True
+            if chain == "os.replace" or tail == "publish":
+                has_publish = True
+    return has_temp and has_publish
+
+
+class DurableWriteRule(Rule):
+    """GL009: raw durable writes that bypass the CheckpointStore seam and
+    the atomic temp+fsync+replace idiom."""
+
+    code = "GL009"
+    title = (
+        "raw write-mode file op bypasses the CheckpointStore seam / atomic "
+        "temp+fsync+os.replace idiom"
+    )
+    hint = (
+        "route the write through a CheckpointStore (store.open_temp + "
+        "store.publish + store.fsync_dir, or store.open_append for logs), "
+        "or write a same-directory temp file and os.replace() it into place"
+    )
+
+    def check(self, mod: Module) -> list[Finding]:
+        src = mod.source
+        if not any(
+            s in src
+            for s in ("open(", "fdopen", "os.write", "json.dump", "write_text", "write_bytes")
+        ):
+            return []
+        # Map every function to whether it owns the atomic idiom, and every
+        # class to whether it IS the seam.
+        findings: list[Finding] = []
+        atomic_fns = {
+            fn: _has_atomic_idiom(fn) for fn, _, _ in _iter_functions(mod.tree)
+        }
+        # call -> innermost enclosing function / class name
+        for fn, cls, _ in _iter_functions(mod.tree):
+            if cls is not None and cls.endswith("Store"):
+                continue  # the seam implementation owns its raw descriptors
+            if atomic_fns.get(fn):
+                continue  # structurally atomic: temp + publish present
+            for node in _body_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _dotted(node.func) or ""
+                tail = _tail(chain)
+                bad = None
+                if chain in ("open", "io.open") and _is_write_mode(_call_mode(node, 1)):
+                    bad = f"write-mode open({ast.unparse(_call_mode(node, 1))})"
+                elif chain == "os.fdopen" and _is_write_mode(_call_mode(node, 1)):
+                    bad = "write-mode os.fdopen"
+                elif chain == "os.write":
+                    bad = "os.write"
+                elif chain == "json.dump":
+                    bad = "json.dump to an open file"
+                elif tail in ("write_text", "write_bytes") and "store" not in chain.lower():
+                    bad = f".{tail}()"
+                if bad is not None:
+                    findings.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"{bad} outside the CheckpointStore seam and "
+                            f"without the atomic temp+os.replace idiom: a "
+                            f"crash mid-write tears the file a restart "
+                            f"reads back",
+                        )
+                    )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# GL010 — ack-before-journal in mutating-handler scope
+# ---------------------------------------------------------------------------
+
+_HANDLER_NAMES = frozenset(
+    {"submit", "steer", "park", "withdraw", "evict", "forget", "retire", "readmit"}
+)
+_DESTRUCTIVE_TAILS = frozenset(
+    {"pop", "clear", "discard", "remove", "evict", "forget", "withdraw", "retire"}
+)
+_REPLAY_MARKERS = ("replay", "idem")
+
+
+def _journaling_methods(cls: ast.ClassDef) -> set[str]:
+    """Fixpoint: methods whose body (transitively, through same-class bare
+    ``self.x()`` calls) reaches a journal append."""
+    methods = {
+        n.name: n
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    calls: dict[str, set[str]] = {}
+    journaling: set[str] = set()
+    for name, fn in methods.items():
+        calls[name] = set()
+        for node in _body_walk(fn):
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func) or ""
+                if _is_journal_call(chain):
+                    journaling.add(name)
+                elif chain.startswith("self.") and chain.count(".") == 1:
+                    calls[name].add(chain.split(".", 1)[1])
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in calls.items():
+            if name not in journaling and callees & journaling:
+                journaling.add(name)
+                changed = True
+    return journaling
+
+
+def _is_journal_call(chain: str) -> bool:
+    tail = _tail(chain)
+    head = chain.rsplit(".", 1)[0].lower() if "." in chain else ""
+    if tail == "append" and "journal" in head:
+        return True
+    if tail == "append_record":
+        return True
+    return False
+
+
+def _is_delegated_handler(chain: str) -> bool:
+    """``self.daemon.submit(...)`` / ``member.daemon.park(...)``: the callee
+    plane owns the journal-before-ack contract (trusted by name, same
+    convention the compiled-plane rules use for key-like names)."""
+    parts = chain.split(".")
+    return (
+        len(parts) >= 3
+        and parts[-1] in _HANDLER_NAMES
+        and any(p in ("daemon", "router") for p in parts[:-1])
+    )
+
+
+class AckBeforeJournalRule(Rule):
+    """GL010: in mutating-handler scope, no ack-return or destructive state
+    mutation on a path that has not passed the journal append."""
+
+    code = "GL010"
+    title = (
+        "handler can ack or destroy state on a path that never passed the "
+        "journal append"
+    )
+    hint = (
+        "journal first: call self.journal.append(...)/self._journal(...) "
+        "(or delegate to the journaling plane) on every path BEFORE "
+        "returning the ack or mutating state destructively; compensate "
+        "inside `except JournalError` if the append fails"
+    )
+
+    def check(self, mod: Module) -> list[Finding]:
+        if "journal" not in mod.source:
+            return []
+        findings: list[Finding] = []
+        for cls in _iter_classes(mod.tree):
+            idents = class_identifiers(cls)
+            if not any("journal" in s for s in idents):
+                continue
+            journaling = _journaling_methods(cls)
+            for stmt in cls.body:
+                if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if stmt.name.lstrip("_") not in _HANDLER_NAMES:
+                    continue
+                findings.extend(self._check_handler(mod, stmt, journaling))
+        return findings
+
+    def _check_handler(
+        self,
+        mod: Module,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+        journaling: set[str],
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+
+        # Names bound from idempotent-replay lookups: returning one re-sends
+        # an ack that is already durable — the sanctioned early return.
+        replay_names: set[str] = set()
+        for node in _body_walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                chain = (_dotted(node.value.func) or "").lower()
+                if any(m in chain for m in _REPLAY_MARKERS):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            replay_names.add(tgt.id)
+
+        def is_gate(stmt: ast.stmt) -> bool:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    chain = _dotted(node.func) or ""
+                    if _is_journal_call(chain) or _is_delegated_handler(chain):
+                        return True
+                    if chain.startswith("self.") and chain.count(".") == 1:
+                        if chain.split(".", 1)[1] in journaling:
+                            return True
+            return False
+
+        def is_refusal(value: ast.expr) -> bool:
+            # A (status, ...) tuple with status >= 400 is a refusal reply.
+            return (
+                isinstance(value, ast.Tuple)
+                and len(value.elts) >= 1
+                and isinstance(value.elts[0], ast.Constant)
+                and isinstance(value.elts[0].value, int)
+                and value.elts[0].value >= 400
+            )
+
+        def on_stmt(stmt: ast.stmt, gated: bool) -> None:
+            if gated:
+                return
+            if isinstance(stmt, ast.Return):
+                v = stmt.value
+                if v is None or (isinstance(v, ast.Constant) and v.value is None):
+                    return  # a bare return is a no-op, not an ack
+                if isinstance(v, ast.Name) and v.id in replay_names:
+                    return  # idempotent replay of an already-durable ack
+                if is_refusal(v):
+                    return
+                findings.append(
+                    self.finding(
+                        mod,
+                        stmt,
+                        f"handler {fn.name!r} can return an ack on a path "
+                        f"that never passed the journal append — the acked "
+                        f"request vanishes at the next crash",
+                    )
+                )
+                return
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    chain = _dotted(node.func) or ""
+                    tail = _tail(chain)
+                    if (
+                        tail in _DESTRUCTIVE_TAILS
+                        and chain.startswith("self.")
+                        and "journal" not in chain.lower()
+                        and not _is_delegated_handler(chain)
+                        and not (
+                            chain.count(".") == 1
+                            and chain.split(".", 1)[1] in journaling
+                        )
+                    ):
+                        findings.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"handler {fn.name!r} destroys state "
+                                f"({chain}) before the journal append — on "
+                                f"replay the un-journaled mutation is "
+                                f"resurrected (the PR-11 evict/forget "
+                                f"defect shape)",
+                            )
+                        )
+                elif isinstance(node, ast.Delete):
+                    for tgt in node.targets:
+                        root = tgt
+                        while isinstance(root, (ast.Attribute, ast.Subscript)):
+                            root = root.value
+                        if isinstance(root, ast.Name) and root.id == "self":
+                            findings.append(
+                                self.finding(
+                                    mod,
+                                    node,
+                                    f"handler {fn.name!r} deletes state "
+                                    f"before the journal append",
+                                )
+                            )
+
+        def handler_entry_gated(handler: ast.excepthandler) -> bool:
+            # `except JournalError:` runs strictly after the append was
+            # ATTEMPTED — compensation there is the sanctioned pattern.
+            types = []
+            t = handler.type
+            if isinstance(t, ast.Tuple):
+                types = list(t.elts)
+            elif t is not None:
+                types = [t]
+            return any("Journal" in (_dotted(x) or "") for x in types)
+
+        walk_gate_order(
+            fn.body,
+            is_gate=is_gate,
+            on_stmt=on_stmt,
+            handler_entry_gated=handler_entry_gated,
+        )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# GL011 — decider purity
+# ---------------------------------------------------------------------------
+
+_IMPURE_PREFIXES = ("time.", "uuid.", "random.", "np.random.", "numpy.random.")
+_IMPURE_CALLS = frozenset(
+    {
+        "open",
+        "input",
+        "print",
+        "os.getenv",
+        "os.urandom",
+        "os.environ.get",
+        "datetime.now",
+        "datetime.utcnow",
+        "datetime.today",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+        "datetime.datetime.today",
+        "time",
+    }
+)
+
+
+class DeciderPurityRule(Rule):
+    """GL011: journaled deciders must be pure functions of their evidence."""
+
+    code = "GL011"
+    title = (
+        "journaled decider reads ambient state or mutates — replay will not "
+        "be bit-for-bit"
+    )
+    hint = (
+        "deciders replay from the journal: take every input from the "
+        "evidence mapping (the caller samples clocks/environment ONCE and "
+        "journals the sample), return a value, and mutate nothing"
+    )
+
+    def check(self, mod: Module) -> list[Finding]:
+        if "decide" not in mod.source and "_DECIDERS" not in mod.source:
+            return []
+        deciders = self._decider_functions(mod.tree)
+        findings: list[Finding] = []
+        for fn in deciders:
+            evidence = self._first_param(fn)
+            flagged: set[int] = set()
+            for node in _body_walk(fn, into_nested=True):
+                bad = self._impurity(node, evidence)
+                if bad is not None:
+                    # One finding per line: `os.environ.get(...)` is both an
+                    # impure call and an `os.environ` read, and an attribute
+                    # assign of `datetime.now()` trips two checks too.
+                    lineno = getattr(node, "lineno", None)
+                    if lineno in flagged:
+                        continue
+                    if lineno is not None:
+                        flagged.add(lineno)
+                    findings.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"decider {getattr(fn, 'name', '<lambda>')!r} "
+                            f"{bad}: decisions replay bit-for-bit from the "
+                            f"journal, so every input must come from the "
+                            f"evidence mapping",
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _first_param(fn: ast.AST) -> str | None:
+        args = getattr(fn, "args", None)
+        if args is None:
+            return None
+        pos = list(args.posonlyargs) + list(args.args)
+        pos = [a for a in pos if a.arg not in ("self", "cls")]
+        return pos[0].arg if pos else None
+
+    def _decider_functions(self, tree: ast.Module) -> list[ast.AST]:
+        out: list[ast.AST] = []
+        by_name: dict[str, ast.AST] = {}
+        for fn, _, _ in _iter_functions(tree):
+            by_name[fn.name] = fn
+            if fn.name.startswith("decide_"):
+                out.append(fn)
+        registered: set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and "_DECIDERS" in t.id for t in node.targets
+            ):
+                continue
+            if isinstance(node.value, ast.Dict):
+                for value in node.value.values:
+                    if isinstance(value, ast.Lambda):
+                        out.append(value)
+                    elif isinstance(value, ast.Name):
+                        registered.add(value.id)
+        for name in registered:
+            fn = by_name.get(name)
+            if fn is not None and fn not in out:
+                out.append(fn)
+        return out
+
+    @staticmethod
+    def _impurity(node: ast.AST, evidence: str | None) -> str | None:
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func) or ""
+            if chain in _IMPURE_CALLS or any(
+                chain.startswith(p) for p in _IMPURE_PREFIXES
+            ):
+                return f"calls {chain}()"
+            tail = _tail(chain)
+            if (
+                evidence is not None
+                and chain.startswith(evidence + ".")
+                and tail in ("update", "pop", "setdefault", "clear", "popitem")
+            ):
+                return f"mutates its evidence via .{tail}()"
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute):
+                    return f"assigns attribute {ast.unparse(tgt)}"
+                if isinstance(tgt, ast.Subscript):
+                    root = tgt.value
+                    while isinstance(root, (ast.Attribute, ast.Subscript)):
+                        root = root.value
+                    if isinstance(root, ast.Name) and root.id == evidence:
+                        return "writes into its evidence mapping"
+        elif isinstance(node, ast.Attribute):
+            chain = _dotted(node) or ""
+            if chain.startswith("os.environ"):
+                return "reads os.environ"
+        elif isinstance(node, ast.Global):
+            return "declares globals"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# GL012 — nondeterministic iteration into identity
+# ---------------------------------------------------------------------------
+
+_IDENTITY_NAME_PARTS = ("digest", "fingerprint", "canonical")
+_IDENTITY_NAMES = frozenset({"bucket_key", "to_manifest"})
+
+
+def _is_identity_fn(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    name = fn.name.lower()
+    if name in _IDENTITY_NAMES or any(p in name for p in _IDENTITY_NAME_PARTS):
+        return True
+    for node in _body_walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func) or ""
+            if chain.startswith("hashlib."):
+                return True
+            if _is_journal_call(chain):
+                return True
+    return False
+
+
+def _canonicalizes_via_json(fn: ast.AST) -> bool:
+    """``json.dumps(..., sort_keys=True)`` anywhere in the body: the
+    function delegates ordering to the canonical serializer."""
+    for node in _body_walk(fn):
+        if isinstance(node, ast.Call) and (_dotted(node.func) or "").endswith(
+            "json.dumps"
+        ):
+            for kw in node.keywords:
+                if (
+                    kw.arg == "sort_keys"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                ):
+                    return True
+    return False
+
+
+class UnsortedIterIdentityRule(Rule):
+    """GL012: unsorted dict/set iteration inside identity-building scope."""
+
+    code = "GL012"
+    title = (
+        "dict/set iteration order flows into an identity (digest/journal "
+        "payload/manifest) without sorted()"
+    )
+    hint = (
+        "wrap the iterable in sorted(...) (sorted(d.items()) for dicts), or "
+        "canonicalize the whole payload with json.dumps(..., sort_keys=True)"
+    )
+
+    def check(self, mod: Module) -> list[Finding]:
+        findings: list[Finding] = []
+        for fn, _, _ in _iter_functions(mod.tree):
+            if not _is_identity_fn(fn):
+                continue
+            if _canonicalizes_via_json(fn):
+                continue
+            # Every node inside a sorted(...) subtree is order-laundered —
+            # covers both sorted(d.items()) and sorted(g for g in set(...)).
+            sorted_nodes: set[int] = set()
+            for node in _body_walk(fn):
+                if isinstance(node, ast.Call) and (_dotted(node.func) or "") == "sorted":
+                    sorted_nodes.update(id(n) for n in ast.walk(node))
+            iters: list[ast.expr] = []
+            for node in _body_walk(fn):
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    iters.append(node.iter)
+                elif isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+                    # Dict/set comprehensions build order-INSENSITIVE
+                    # containers; only sequenced results carry the order.
+                    iters.extend(gen.iter for gen in node.generators)
+            for it in iters:
+                culprit = self._unordered_source(it)
+                if culprit is not None and id(culprit) not in sorted_nodes:
+                    findings.append(
+                        self.finding(
+                            mod,
+                            culprit,
+                            "iteration over an unordered view inside "
+                            "identity-building scope: hash/journal/manifest "
+                            "bytes now depend on insertion/hash order",
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _unordered_source(expr: ast.expr) -> ast.AST | None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func) or ""
+                if _tail(chain) in ("keys", "values", "items") and chain != "":
+                    return node
+                if chain in ("set", "frozenset"):
+                    return node
+            elif isinstance(node, ast.Set):
+                return node
+        return None
+
+
+# ---------------------------------------------------------------------------
+# GL013 — lock discipline
+# ---------------------------------------------------------------------------
+
+_LOCK_FACTORIES = frozenset(
+    {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+)
+
+
+class LockDisciplineRule(Rule):
+    """GL013: inconsistent locking of attributes shared with a worker
+    thread, and inverse two-lock acquisition orders."""
+
+    code = "GL013"
+    title = (
+        "attribute shared with a worker thread has both locked and bare "
+        "writes (or two locks are taken in both orders)"
+    )
+    hint = (
+        "hold the owning lock (`with self._lock:`) around EVERY write to "
+        "state the worker thread shares, and pick one global acquisition "
+        "order for nested locks"
+    )
+
+    def check(self, mod: Module) -> list[Finding]:
+        if "threading" not in mod.source:
+            return []
+        findings: list[Finding] = []
+        for cls in _iter_classes(mod.tree):
+            findings.extend(self._check_class(mod, cls))
+        return findings
+
+    def _check_class(self, mod: Module, cls: ast.ClassDef) -> list[Finding]:
+        methods = {
+            n.name: n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        lock_attrs: set[str] = set()
+        thread_targets: set[str] = set()
+        for fn in methods.values():
+            for node in _body_walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    chain = _dotted(node.value.func) or ""
+                    if (
+                        chain.startswith("threading.")
+                        and _tail(chain) in _LOCK_FACTORIES
+                    ):
+                        for tgt in node.targets:
+                            if (
+                                isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"
+                            ):
+                                lock_attrs.add(tgt.attr)
+                if isinstance(node, ast.Call):
+                    chain = _dotted(node.func) or ""
+                    if _tail(chain) == "Thread":
+                        for kw in node.keywords:
+                            if kw.arg == "target":
+                                t = _dotted(kw.value) or ""
+                                if t.startswith("self."):
+                                    thread_targets.add(t.split(".", 1)[1])
+
+        findings: list[Finding] = []
+        findings.extend(self._lock_order(mod, cls, methods, lock_attrs))
+        if not lock_attrs or not thread_targets:
+            return findings
+
+        # Thread scope = targets plus their same-class call closure.
+        thread_scope = set(thread_targets)
+        changed = True
+        while changed:
+            changed = False
+            for name in list(thread_scope):
+                fn = methods.get(name)
+                if fn is None:
+                    continue
+                for node in _body_walk(fn):
+                    if isinstance(node, ast.Call):
+                        chain = _dotted(node.func) or ""
+                        if chain.startswith("self.") and chain.count(".") == 1:
+                            callee = chain.split(".", 1)[1]
+                            if callee in methods and callee not in thread_scope:
+                                thread_scope.add(callee)
+                                changed = True
+
+        # (attr -> [(node, locked, in_thread_scope)]) over attribute writes.
+        writes: dict[str, list[tuple[ast.AST, bool, bool]]] = {}
+        for name, fn in methods.items():
+            if name == "__init__":
+                continue
+            in_thread = name in thread_scope
+            for node, held in self._walk_with_locks(fn, lock_attrs):
+                targets: list[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    targets = list(node.targets)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and tgt.attr not in lock_attrs
+                    ):
+                        writes.setdefault(tgt.attr, []).append(
+                            (node, held, in_thread)
+                        )
+
+        for attr, events in sorted(writes.items()):
+            scopes = {in_thread for _, _, in_thread in events}
+            locked = [e for e in events if e[1]]
+            bare = [e for e in events if not e[1]]
+            if len(scopes) == 2 and locked and bare:
+                for node, _, _ in bare:
+                    findings.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"self.{attr} is written from both the worker "
+                            f"thread and public methods of {cls.name!r}, "
+                            f"locked elsewhere but bare here — one side is "
+                            f"racing",
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _walk_with_locks(
+        fn: ast.AST, lock_attrs: set[str]
+    ) -> Iterator[tuple[ast.AST, bool]]:
+        """Yield ``(stmt, lock_held)`` for every statement in the body,
+        tracking lexical ``with self.<lock>:`` nesting."""
+
+        def locks_in(items: list[ast.withitem]) -> bool:
+            for item in items:
+                chain = _dotted(item.context_expr) or ""
+                if chain.startswith("self.") and chain.split(".", 1)[1] in lock_attrs:
+                    return True
+            return False
+
+        def walk(stmts: list[ast.stmt], held: bool):
+            for stmt in stmts:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue
+                yield stmt, held
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    yield from walk(stmt.body, held or locks_in(stmt.items))
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    yield from walk(getattr(stmt, field, []) or [], held)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    yield from walk(handler.body, held)
+
+        yield from walk(getattr(fn, "body", []), False)
+
+    def _lock_order(
+        self,
+        mod: Module,
+        cls: ast.ClassDef,
+        methods: dict[str, ast.FunctionDef | ast.AsyncFunctionDef],
+        lock_attrs: set[str],
+    ) -> list[Finding]:
+        if len(lock_attrs) < 2:
+            return []
+        orders: dict[tuple[str, str], ast.AST] = {}
+        findings: list[Finding] = []
+
+        def lock_names(items: list[ast.withitem]) -> list[str]:
+            out = []
+            for item in items:
+                chain = _dotted(item.context_expr) or ""
+                if chain.startswith("self.") and chain.split(".", 1)[1] in lock_attrs:
+                    out.append(chain.split(".", 1)[1])
+            return out
+
+        def walk(stmts: list[ast.stmt], held: list[str]):
+            for stmt in stmts:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    acquired = lock_names(stmt.items)
+                    for outer in held:
+                        for inner in acquired:
+                            if outer != inner:
+                                orders.setdefault((outer, inner), stmt)
+                    walk(stmt.body, held + acquired)
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    walk(getattr(stmt, field, []) or [], held)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    walk(handler.body, held)
+
+        for fn in methods.values():
+            walk(fn.body, [])
+        for (a, b), node in sorted(orders.items()):
+            if (b, a) in orders and a < b:
+                other = orders[(b, a)]
+                findings.append(
+                    self.finding(
+                        mod,
+                        node,
+                        f"{cls.name!r} nests self.{a} -> self.{b} here but "
+                        f"self.{b} -> self.{a} at line "
+                        f"{getattr(other, 'lineno', '?')} — inverse orders "
+                        f"deadlock under contention",
+                    )
+                )
+        return findings
+
+
+HOST_RULES: list[Rule] = [
+    DurableWriteRule(),
+    AckBeforeJournalRule(),
+    DeciderPurityRule(),
+    UnsortedIterIdentityRule(),
+    LockDisciplineRule(),
+]

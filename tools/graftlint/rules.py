@@ -1816,6 +1816,11 @@ class DtypeDisciplineRule(Rule):
         return findings
 
 
+# Imported at module bottom: host_rules needs the helpers above, and the
+# registry below needs HOST_RULES — the late import keeps one rule catalog
+# without a cycle at import time.
+from .host_rules import HOST_RULES  # noqa: E402
+
 RULES: list[Rule] = [
     BareAssertRule(),
     KeyReuseRule(),
@@ -1826,5 +1831,6 @@ RULES: list[Rule] = [
     AxisIndexFoldRule(),
     ProcessBranchRule(),
     DtypeDisciplineRule(),
+    *HOST_RULES,
 ]
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -1,0 +1,93 @@
+"""SARIF 2.1.0 emitter.
+
+One run, one driver (``graftlint``), one rule entry per selected rule, one
+result per finding.  Findings that violate the ratchet carry level
+``error``; baselined legacy debt is ``note`` so CI annotation surfaces the
+regression set without re-litigating the budget.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable
+
+from .engine import Finding
+from .rules import Rule
+
+__all__ = ["to_sarif", "write_sarif"]
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(
+    findings: Iterable[Finding],
+    rules: Iterable[Rule],
+    *,
+    violating: Iterable[Finding] = (),
+) -> dict:
+    """Build the SARIF log object for ``findings`` under ``rules``."""
+    rules = list(rules)
+    rule_index = {rule.code: i for i, rule in enumerate(rules)}
+    violating_ids = {id(f) for f in violating}
+    results = []
+    for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line, f.col)):
+        results.append(
+            {
+                "ruleId": f.rule,
+                "ruleIndex": rule_index.get(f.rule, -1),
+                "level": "error" if id(f) in violating_ids else "note",
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": str(f.path).replace("\\", "/"),
+                            },
+                            "region": {
+                                "startLine": f.line,
+                                "startColumn": f.col + 1,
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri": "tools/graftlint",
+                        "rules": [
+                            {
+                                "id": rule.code,
+                                "shortDescription": {"text": rule.title},
+                                "help": {"text": rule.hint},
+                            }
+                            for rule in rules
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
+def write_sarif(
+    path: Path,
+    findings: Iterable[Finding],
+    rules: Iterable[Rule],
+    *,
+    violating: Iterable[Finding] = (),
+) -> None:
+    log = to_sarif(findings, rules, violating=violating)
+    path.write_text(json.dumps(log, indent=2) + "\n")
