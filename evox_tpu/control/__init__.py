@@ -36,6 +36,7 @@ from .controller import (
     decide_autoscale,
     decide_brownout,
     decide_cadence,
+    decide_compact,
     decide_hpo_grow,
     decide_shed,
     decide_tenant,
@@ -51,6 +52,7 @@ __all__ = [
     "decide_autoscale",
     "decide_brownout",
     "decide_cadence",
+    "decide_compact",
     "decide_hpo_grow",
     "decide_shed",
     "decide_tenant",

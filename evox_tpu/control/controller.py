@@ -57,6 +57,7 @@ __all__ = [
     "decide_autoscale",
     "decide_brownout",
     "decide_cadence",
+    "decide_compact",
     "decide_hpo_grow",
     "decide_shed",
     "decide_tenant",
@@ -309,8 +310,55 @@ def decide_autoscale(evidence: Mapping[str, Any]) -> str:
     return "hold"
 
 
+def decide_compact(evidence: Mapping[str, Any]) -> str:
+    """Journal-compaction policy for a daemon or router journal:
+    ``"compact"`` / ``"hold"``.
+
+    Compaction pays a boundary-time stall (full replay + snapshot +
+    atomic swap), so it fires only when the journal has provably
+    outgrown the live state.  The suffix since the last snapshot
+    (``journal_records``) must exceed the live-tenant count
+    (``live_tenants`` — folding fewer records than live entries cannot
+    shrink the journal), and then any armed bound may trip: the record
+    threshold (``compact_records``), the byte threshold
+    (``compact_bytes`` against ``journal_bytes``), or the recovery-time
+    SLO (last measured ``replay_seconds`` at/over
+    ``max_replay_seconds``).  Missing or unarmed signals hold —
+    compaction is advisory, the append-only journal is always a correct
+    fallback."""
+    records = _num(evidence, "journal_records")
+    if records is None or records <= 0:
+        return "hold"
+    live = _num(evidence, "live_tenants") or 0.0
+    if records <= live:
+        return "hold"
+    max_replay = _num(evidence, "max_replay_seconds")
+    replay = _num(evidence, "replay_seconds")
+    if (
+        max_replay is not None
+        and max_replay > 0
+        and replay is not None
+        and replay >= max_replay
+    ):
+        return "compact"
+    rec_cap = _num(evidence, "compact_records")
+    if rec_cap is not None and rec_cap > 0 and records >= rec_cap:
+        return "compact"
+    byte_cap = _num(evidence, "compact_bytes")
+    jbytes = _num(evidence, "journal_bytes")
+    if (
+        byte_cap is not None
+        and byte_cap > 0
+        and jbytes is not None
+        and jbytes >= byte_cap
+    ):
+        return "compact"
+    return "hold"
+
+
 _DECIDERS: dict[str, Callable[[Mapping[str, Any]], Any]] = {
     "autoscale": decide_autoscale,
+    "compact": decide_compact,
     "trend": lambda e: decide_trend(e) or "",
     "cadence": lambda e: str(decide_cadence(e)),
     "brownout": decide_brownout,
@@ -933,6 +981,46 @@ class Controller:
 
         return self._guard(
             "autoscale", act, generation=generation, default="hold"
+        )
+
+    def compact(
+        self,
+        *,
+        evidence: Mapping[str, Any],
+        generation: int = 0,
+    ) -> str:
+        """Consult the journal-compaction policy with one evidence dict
+        (journal bytes, records since snapshot, live-tenant count, last
+        measured replay seconds, armed thresholds).  Returns
+        :func:`decide_compact`'s action — ``"compact"`` / ``"hold"`` —
+        with every non-hold action journaled as a ``compact``
+        :class:`~evox_tpu.control.Decision` (replayable bit-for-bit)
+        under the shared per-key quiet window, so a freshly-compacted
+        journal gets ``grace`` boundaries to accumulate before the next
+        verdict.  Never raises — failures degrade the ``compact`` plane
+        to ``"hold"`` and serving continues on the uncompacted
+        journal."""
+
+        def act() -> str:
+            key = "compact"
+            if generation <= self._quiet_until.get(key, -1):
+                return "hold"
+            action = decide_compact(evidence)
+            if action == "hold":
+                return "hold"
+            self._quiet_until[key] = int(generation) + self.grace
+            self._emit(
+                "compact",
+                action,
+                generation=generation,
+                evidence=evidence,
+                policy="compact",
+                warn=False,
+            )
+            return action
+
+        return self._guard(
+            "compact", act, generation=generation, default="hold"
         )
 
     def brownout(

@@ -58,6 +58,11 @@ SIGNAL_ADMISSION = "admission"
 # was served without a 5xx; 4xx client mistakes are good events — the
 # service answered correctly).  Fed by evox_tpu.service.Gateway.
 SIGNAL_GATEWAY = "gateway_availability"
+# Cold-start recovery time: wall seconds of journal replay + fold +
+# tenant resubmission, observed once per daemon/router start.  Fed by
+# evox_tpu.service.ServiceDaemon / TenantRouter; journal compaction is
+# the mechanism that keeps this bounded (O(live state), not O(lifetime)).
+SIGNAL_RECOVERY = "recovery_replay_seconds"
 
 
 @dataclass(frozen=True)
@@ -153,11 +158,16 @@ def default_slos(
     gens_per_sec: float = 1.0,
     availability: float = 0.99,
     window_seconds: float = 300.0,
+    recovery_seconds: float | None = None,
 ) -> list[SLO]:
     """The conventional serving-objective triple for one tenant class:
     segment latency under a bound, per-tenant throughput over a floor,
-    and admission availability (rejections are the bad events)."""
-    return [
+    and admission availability (rejections are the bad events).  Set
+    ``recovery_seconds`` to also bound cold-start recovery time (the
+    class-agnostic ``recovery-time`` objective over
+    :data:`SIGNAL_RECOVERY` — journal compaction is what keeps it
+    honest)."""
+    slos = [
         SLO(
             "segment-latency",
             SIGNAL_SEGMENT_SECONDS,
@@ -184,6 +194,19 @@ def default_slos(
             tenant_class=tenant_class,
         ),
     ]
+    if recovery_seconds is not None:
+        slos.append(
+            SLO(
+                "recovery-time",
+                SIGNAL_RECOVERY,
+                target=availability,
+                threshold=float(recovery_seconds),
+                comparison="le",
+                window_seconds=window_seconds,
+                tenant_class="*",
+            )
+        )
+    return slos
 
 
 class SLOTracker:

@@ -61,6 +61,7 @@ from ..obs.endpoint import IntrospectionEndpoint
 from ..obs.metrics import MetricsRegistry
 from ..obs.slo import (
     SIGNAL_ADMISSION,
+    SIGNAL_RECOVERY,
     SIGNAL_SEGMENT_SECONDS,
     SIGNAL_TENANT_GENS,
     SLOTracker,
@@ -77,7 +78,13 @@ from .service import (
 )
 from .tenant import TenantRecord, TenantSpec, TenantStatus
 
-__all__ = ["ServiceDaemon", "TenantClass", "DaemonStats", "STEER_KNOBS"]
+__all__ = [
+    "ServiceDaemon",
+    "TenantClass",
+    "DaemonStats",
+    "STEER_KNOBS",
+    "fold_daemon_records",
+]
 
 #: The journaled ``steer`` record's adjustable scheduling knobs: the
 #: tenant's generation budget, checkpoint cadence, and restart budget.
@@ -118,6 +125,11 @@ class DaemonStats:
     replayed_tenants: int = 0
     journal_damage: list[str] = field(default_factory=list)
     journal_append_failures: int = 0
+    # Wall seconds of the last cold-start recovery (journal replay +
+    # tenant resubmission) — the recovery-time SLO's signal.
+    replay_seconds: float | None = None
+    compactions: int = 0
+    compaction_failures: int = 0
     sheds: int = 0
     brownout_entries: int = 0
     brownout_exits: int = 0
@@ -137,6 +149,113 @@ def _bucket_label(key: tuple) -> str:
     # algorithm[popxdim] + the two static-config digest prefixes: stable
     # across processes, short enough for an exec-cache entry label.
     return f"{key[0]}[{key[1]}x{key[2]}]{key[4][:8]}{key[5][:8]}"
+
+
+def fold_daemon_records(
+    records: Sequence[Any], base: dict[str, Any] | None = None
+) -> tuple[dict[str, Any], list[str]]:
+    """Pure fold of a daemon journal record stream onto an optional
+    snapshot base state; returns ``(state, anomalies)``.
+
+    This single function is both replay's fold (:meth:`ServiceDaemon.start`
+    seeds from ``journal.snapshot_state`` and folds the suffix) and
+    compaction's (:meth:`RequestJournal.compact` folds the whole history
+    into the next snapshot), which makes the replay-equivalence invariant
+    hold *by construction*: a snapshot-anchored cold start computes
+    exactly the state a full replay would.
+
+    ``state`` is canonical-JSON-serializable (uid keys are strings; set
+    members are sorted lists): ``live`` maps uid → the newest submit
+    record's data verbatim (spec blob, class, idempotency fields — the
+    gateway's exactly-once map survives compaction through it), plus
+    ``parked`` / ``completed`` uid lists, ``steers`` (folded knob values,
+    last-wins), and ``idem`` (the gateway dedup entries for *all* record
+    kinds, so a retried steer or park straddling a compaction still
+    replays its ack instead of re-acting).  At-least-once semantics are
+    the journal's: duplicates collapse, last state wins.  ``anomalies``
+    are human-readable fold warnings (orphan steers) for the caller's
+    event stream — never part of the state."""
+    base = base or {}
+    live: dict[str, dict[str, Any]] = {
+        str(k): dict(v) for k, v in (base.get("live") or {}).items()
+    }
+    parked: set[str] = {str(u) for u in (base.get("parked") or [])}
+    completed: set[str] = {str(u) for u in (base.get("completed") or [])}
+    steers: dict[str, dict[str, int]] = {
+        str(k): dict(v) for k, v in (base.get("steers") or {}).items()
+    }
+    idem: dict[str, dict[str, Any]] = {
+        str(k): dict(v) for k, v in (base.get("idem") or {}).items()
+    }
+    anomalies: list[str] = []
+    for rec in records:
+        data = rec.data
+        key = data.get("idem")
+        principal = data.get("principal")
+        if key and principal:
+            # Mirrors Gateway._rebuild_idem exactly — the snapshot must
+            # preserve the dedup map a full-journal replay would build.
+            idem[f"{principal}:{key}"] = {
+                "route": rec.kind,
+                "tenant_id": data.get("tenant_id"),
+                "uid": data.get("uid"),
+                "knobs": {
+                    k: data[k]
+                    for k in STEER_KNOBS
+                    if rec.kind == "steer" and k in data
+                },
+            }
+        uid = data.get("uid")
+        if uid is None:
+            continue
+        uid = str(int(uid))
+        if rec.kind == "submit":
+            live[uid] = dict(data)
+            parked.discard(uid)
+            # A re-submit after a journaled completion (readmission with
+            # a refreshed budget) re-arms the completion record, exactly
+            # like the live submit() path.  It also supersedes any
+            # earlier steering — the fresh spec carries the caller's
+            # current intent.
+            completed.discard(uid)
+            steers.pop(uid, None)
+        elif rec.kind == "evict":
+            parked.add(uid)
+        elif rec.kind == "retire":
+            live.pop(uid, None)
+            parked.discard(uid)
+            completed.discard(uid)
+            steers.pop(uid, None)
+        elif rec.kind == "complete":
+            # Stays live: resubmission materializes the final result
+            # from the namespace without occupying a lane.
+            completed.add(uid)
+        elif rec.kind == "steer":
+            if uid in live:
+                # At-least-once: duplicate steer records collapse (last
+                # value per knob wins, same as replaying in sequence).
+                steers.setdefault(uid, {}).update(
+                    {
+                        k: int(data[k])
+                        for k in STEER_KNOBS
+                        if data.get(k) is not None
+                    }
+                )
+            else:
+                # A steer can only follow the submit that admitted its
+                # tenant — anything else in the stream is journal damage
+                # or a spliced tail; skip it loudly.
+                anomalies.append(
+                    f"steer record #{rec.seq} targets uid {uid} with no "
+                    f"live submit before it; skipped"
+                )
+    return {
+        "live": live,
+        "parked": sorted(parked, key=int),
+        "completed": sorted(completed, key=int),
+        "steers": steers,
+        "idem": idem,
+    }, anomalies
 
 
 class ServiceDaemon:
@@ -264,6 +383,9 @@ class ServiceDaemon:
         endpoint: Union[int, bool, None] = None,
         endpoint_host: str = "127.0.0.1",
         fleet_dead_after: float = 5.0,
+        compact_records: int | None = None,
+        compact_bytes: int | None = None,
+        max_replay_seconds: float | None = None,
         **service_kwargs: Any,
     ):
         if brownout_factor < 1:
@@ -307,6 +429,34 @@ class ServiceDaemon:
         if len(self.classes) != len(class_list):
             raise ValueError("duplicate TenantClass names")
         self.prewarm_specs = list(prewarm)
+        for name, value in (
+            ("compact_records", compact_records),
+            ("compact_bytes", compact_bytes),
+            ("max_replay_seconds", max_replay_seconds),
+        ):
+            if value is not None and value <= 0:
+                raise ValueError(f"{name} must be > 0, got {value}")
+        self.compact_records = (
+            None if compact_records is None else int(compact_records)
+        )
+        self.compact_bytes = (
+            None if compact_bytes is None else int(compact_bytes)
+        )
+        self.max_replay_seconds = (
+            None if max_replay_seconds is None else float(max_replay_seconds)
+        )
+        if controller is None and (
+            self.compact_records is not None
+            or self.compact_bytes is not None
+            or self.max_replay_seconds is not None
+        ):
+            # Compaction decisions must be journaled + replayable like
+            # every other control-plane action: arming a threshold
+            # without a controller attaches a default one (the router's
+            # precedent), inert for every unarmed plane.
+            from ..control import Controller
+
+            controller = Controller()
         self.controller = controller
         self.service = OptimizationService(
             self.root,
@@ -525,6 +675,7 @@ class ServiceDaemon:
                 "steers_pending": len(self._pending_steer),
             },
         }
+        out["journal"] = self._journal_statusz()
         if self.gateway is not None:
             try:
                 out["gateway"] = self.gateway.statusz_payload()
@@ -553,6 +704,38 @@ class ServiceDaemon:
         if self.slo is not None:
             out["slo"] = self.slo.describe()
         return out
+
+    def _journal_statusz(self) -> dict[str, Any]:
+        """The journal/recovery strip: growth, snapshot anchoring, last
+        measured recovery time, and the compaction decision tail —
+        everything ``evoxtop`` renders and the ``--max-snapshot-age``
+        probe bounds."""
+        snapshot_at = self.journal.snapshot_at
+        strip: dict[str, Any] = {
+            "bytes": self.journal.size_bytes,
+            "records_since_snapshot": self.journal.records_since_snapshot,
+            "snapshot_seq": self.journal.snapshot_seq,
+            "snapshot_age_seconds": (
+                None
+                if snapshot_at is None
+                else max(0.0, time.time() - snapshot_at)
+            ),
+            "replay_seconds": self.stats.replay_seconds,
+            "compactions": self.stats.compactions,
+            "compaction_failures": self.stats.compaction_failures,
+            "fallbacks": self.journal.snapshot_fallbacks,
+            "armed": self._compaction_armed(),
+        }
+        if self.controller is not None:
+            strip["decisions"] = [
+                m
+                for m in (
+                    d.to_manifest()
+                    for d in list(self.controller.decisions)[-40:]
+                )
+                if m.get("kind") == "compact"
+            ][-4:]
+        return strip
 
     def _flight_window(self, tenant_id: str) -> list[dict[str, float]] | None:
         record = self.service._tenants.get(tenant_id)
@@ -619,7 +802,17 @@ class ServiceDaemon:
                 f"introspection endpoint serving at {self.endpoint.url} "
                 f"(/metrics /healthz /statusz /flightz/<tenant_id>)"
             )
+        t_replay = time.perf_counter()
         records, damage = self.journal.replay(quarantine=self.primary)
+        for note in self.journal.replay_notes:
+            # Snapshot-fallback recovery anomalies: the loudness
+            # contract — an operator must see every degraded path taken.
+            self._inc(
+                "evox_daemon_snapshot_fallbacks_total",
+                "Degraded recovery paths taken at replay (snapshot "
+                "fallback, restored swap, gap warnings).",
+            )
+            self._event(f"journal recovery: {note}", warn=True)
         if damage is not None:
             self.stats.journal_damage.append(damage.reason)
             self._inc(
@@ -638,60 +831,28 @@ class ServiceDaemon:
                 warn=True,
             )
         self.stats.replayed_records = len(records)
-        # Fold the record stream into per-uid final lifecycle state
-        # (at-least-once: duplicates collapse, last state wins).
-        live: dict[int, dict[str, Any]] = {}
-        parked: set[int] = set()
-        steers: dict[int, dict[str, int]] = {}
-        for rec in records:
-            uid = rec.data.get("uid")
-            if uid is None:
-                continue
-            uid = int(uid)
-            if rec.kind == "submit":
-                live[uid] = rec.data
-                parked.discard(uid)
-                # A re-submit after a journaled completion (readmission
-                # with a refreshed budget) re-arms the completion record,
-                # exactly like the live submit() path.  It also supersedes
-                # any earlier steering — the fresh spec carries the
-                # caller's current intent (same contract as the live
-                # submit path clearing pending steers).
-                self._journaled_complete.discard(uid)
-                steers.pop(uid, None)
-            elif rec.kind == "evict":
-                parked.add(uid)
-            elif rec.kind == "retire":
-                live.pop(uid, None)
-                parked.discard(uid)
-                self._journaled_complete.discard(uid)
-                steers.pop(uid, None)
-            elif rec.kind == "complete":
-                # Stays live: resubmission materializes the final result
-                # from the namespace without occupying a lane.
-                self._journaled_complete.add(uid)
-            elif rec.kind == "steer":
-                if uid in live:
-                    # At-least-once: duplicate steer records collapse
-                    # (last value per knob wins, same as replaying them
-                    # in sequence).
-                    steers.setdefault(uid, {}).update(
-                        {
-                            k: int(rec.data[k])
-                            for k in STEER_KNOBS
-                            if rec.data.get(k) is not None
-                        }
-                    )
-                else:
-                    # A steer can only follow the submit that admitted
-                    # its tenant — anything else in the stream is journal
-                    # damage or a spliced tail; skip it loudly.
-                    self._event(
-                        f"journal replay: steer record #{rec.seq} targets "
-                        f"uid {uid} with no live submit before it; "
-                        f"skipped",
-                        warn=True,
-                    )
+        base = self.journal.snapshot_state
+        if base is not None:
+            self._event(
+                f"journal replay anchored at snapshot seq "
+                f"{self.journal.snapshot_seq} "
+                f"({len(records)} suffix records to fold)"
+            )
+        # Fold the snapshot base + record suffix into per-uid final
+        # lifecycle state (at-least-once: duplicates collapse, last
+        # state wins) — the same pure fold compaction snapshots through,
+        # so both cold-start paths compute identical state.
+        state, anomalies = fold_daemon_records(records, base=base)
+        for msg in anomalies:
+            self._event(f"journal replay: {msg}", warn=True)
+        live: dict[int, dict[str, Any]] = {
+            int(u): d for u, d in state["live"].items()
+        }
+        parked: set[int] = {int(u) for u in state["parked"]}
+        steers: dict[int, dict[str, int]] = {
+            int(u): dict(k) for u, k in state["steers"].items()
+        }
+        self._journaled_complete.update(int(u) for u in state["completed"])
         restored = 0
         if live:
             # Replay must never bounce off the queue bound the journal
@@ -756,6 +917,20 @@ class ServiceDaemon:
             finally:
                 self.service.max_queue = original_bound
         self.stats.replayed_tenants = restored
+        # The recovery-time SLO signal: replay + fold + resubmission
+        # (pre-warm is excluded — compile cost is the exec cache's
+        # budget, not the journal's).
+        self.stats.replay_seconds = time.perf_counter() - t_replay
+        self._gauge(
+            "evox_recovery_replay_seconds",
+            self.stats.replay_seconds,
+            "Wall seconds of the last cold-start recovery (journal "
+            "replay + fold + tenant resubmission).",
+        )
+        if self.slo is not None:
+            self.slo.observe(SIGNAL_RECOVERY, self.stats.replay_seconds)
+            self.slo.publish()
+        self._journal_gauges()
         if restored:
             self._inc(
                 "evox_daemon_replayed_tenants_total",
@@ -763,7 +938,8 @@ class ServiceDaemon:
             )
             self._event(
                 f"replayed {len(records)} journal records; restored "
-                f"{restored} tenants"
+                f"{restored} tenants "
+                f"({self.stats.replay_seconds:.3f}s recovery)"
             )
         # Pre-warm: the declared grid, then every bucket the replay
         # queued (restored tenants must not pay a compile either).
@@ -1076,7 +1252,106 @@ class ServiceDaemon:
             )
             self._observe_slos(self._last_segment_seconds)
         self._journal_completions()
+        self._maybe_compact()
         return progressed
+
+    # -- compaction ---------------------------------------------------------
+    def _journal_gauges(self) -> None:
+        """Publish the journal-growth gauges the compaction SLO watches."""
+        self._gauge(
+            "evox_journal_bytes",
+            self.journal.size_bytes,
+            "Journal file size in bytes.",
+        )
+        self._gauge(
+            "evox_journal_records",
+            self.journal.records_since_snapshot,
+            "Journal records since the last snapshot anchor (the whole "
+            "history when never compacted) — cold-start replay folds "
+            "exactly this many.",
+        )
+        if self.journal.snapshot_at is not None:
+            self._gauge(
+                "evox_journal_snapshot_age_seconds",
+                max(0.0, time.time() - self.journal.snapshot_at),
+                "Seconds since the journal's last snapshot was taken.",
+            )
+
+    def _compaction_armed(self) -> bool:
+        return (
+            self.compact_records is not None
+            or self.compact_bytes is not None
+            or self.max_replay_seconds is not None
+        )
+
+    def _maybe_compact(self) -> None:  # graftlint: disable=GL005
+        """Boundary-time journal compaction: journal-growth evidence →
+        the pure journaled ``compact`` decider (quiet-windowed,
+        replayable bit-for-bit) → the crash-safe snapshot/swap protocol.
+        Never raises — a refused or failed compaction warns and serving
+        continues on the (always-correct) uncompacted journal."""
+        self._journal_gauges()
+        if (
+            not self.primary
+            or self.controller is None
+            or not self._compaction_armed()
+        ):
+            return
+        evidence = {
+            "journal_bytes": self.journal.size_bytes,
+            "journal_records": self.journal.records_since_snapshot,
+            "live_tenants": len(self.service._tenants),
+            "replay_seconds": self.stats.replay_seconds,
+            "compact_records": self.compact_records,
+            "compact_bytes": self.compact_bytes,
+            "max_replay_seconds": self.max_replay_seconds,
+        }
+        action = self.controller.compact(
+            evidence=evidence, generation=self.service.stats.segments_run
+        )
+        if action == "compact":
+            self._compact_journal()
+
+    def _compact_journal(self) -> None:
+        """One crash-safe compaction through the journal's protocol,
+        folding with the same pure fold replay uses."""
+
+        def fold(
+            base: dict[str, Any] | None, records: list[Any]
+        ) -> dict[str, Any]:
+            state, _anomalies = fold_daemon_records(records, base=base)
+            return state
+
+        t0 = time.perf_counter()
+        try:
+            result = self.journal.compact(fold)
+        except JournalError as e:
+            self.stats.compaction_failures += 1
+            self._inc(
+                "evox_daemon_compaction_failures_total",
+                "Journal compactions that failed (serving continued on "
+                "the uncompacted journal).",
+            )
+            self._event(f"journal compaction failed ({e})", warn=True)
+            return
+        self.stats.compactions += 1
+        self._inc(
+            "evox_daemon_compactions_total",
+            "Successful journal compactions.",
+        )
+        self._journal_gauges()
+        self._event(
+            f"journal compacted at seq {result.seq}: "
+            f"{result.folded_records} records ({result.bytes_before} "
+            f"bytes) folded into {result.snapshot_path.name}; journal "
+            f"now {result.bytes_after} bytes"
+            + (
+                f"; GC'd {len(result.removed)} superseded artifacts"
+                if result.removed
+                else ""
+            )
+            + f" ({time.perf_counter() - t0:.3f}s)"
+        )
 
     def _observe_slos(self, round_seconds: float) -> None:
         """Score one scheduling round against the declared objectives:
@@ -1301,9 +1576,14 @@ class ServiceDaemon:
         self.service.evict(tenant_id)
 
     def forget(self, tenant_id: str) -> None:
-        """Retire a tenant's record durably (its namespace stays on
-        disk).  Journaled BEFORE the service drops the record — an acked
-        retirement must not resurrect on restart."""
+        """Retire a tenant's record durably AND reclaim its disk: the
+        ``retire`` record is journaled BEFORE anything mutates (an acked
+        retirement must not resurrect on restart), and only once that
+        successor is durable does the service GC the tenant's checkpoint
+        namespace and flight dir (the durable-successor rule — a crash
+        between the record and the GC leaves orphan files a later forget
+        or restart re-reaps, never a journaled tenant without its
+        data)."""
         self.start()
         record = self.service._tenants.get(tenant_id)
         if record is None:
@@ -1318,7 +1598,7 @@ class ServiceDaemon:
         self._journal(
             "retire", required=True, tenant_id=tenant_id, uid=record.uid
         )
-        self.service.forget(tenant_id)
+        self.service.forget(tenant_id, purge=self.primary)
         self._journaled_complete.discard(record.uid)
         self._class_by_uid.pop(record.uid, None)
 

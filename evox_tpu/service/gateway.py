@@ -211,11 +211,19 @@ class Gateway:
         carries its idempotency key (``journal_extra``), so a second,
         read-only replay rebuilds the dedup map the in-memory half lost
         with the killed process.  Later records win (a resubmit after a
-        retire is a fresh admission under a fresh key)."""
+        retire is a fresh admission under a fresh key).
+
+        Compaction-safe: a snapshot-anchored journal folds pre-anchor
+        dedup entries into the snapshot's ``idem`` map (the daemon fold
+        mirrors this exact entry shape), so a client retry straddling a
+        compaction still replays its ack instead of double-admitting."""
         try:
             records, _damage = self.daemon.journal.replay()
         except Exception:  # pragma: no cover - replay already warned
             return
+        snapshot = self.daemon.journal.snapshot_state or {}
+        for token, entry in (snapshot.get("idem") or {}).items():
+            self._idem[str(token)] = dict(entry)
         for rec in records:
             key = rec.data.get("idem")
             principal = rec.data.get("principal")

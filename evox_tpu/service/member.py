@@ -250,6 +250,13 @@ class ServiceMember:
                 "error": type(e).__name__,
                 "detail": str(e),
             }
+        # Serving a forward IS a proof of life: refresh the beat (and the
+        # capacity payload it carries) so a member busy compiling a burst
+        # of submissions is not declared dead between scheduling rounds.
+        try:
+            self.beat()
+        except Exception:  # noqa: BLE001 - liveness is advisory here
+            pass
         return status, dict(_JSON_HEADERS), json.dumps(payload).encode("utf-8")
 
     def _dispatch(

@@ -66,16 +66,99 @@ from ..obs.aggregate import FleetAggregator
 from ..obs.endpoint import IntrospectionEndpoint
 from ..obs.metrics import MetricsRegistry
 from ..obs.version import OBS_SCHEMA_VERSION
-from .daemon import _bucket_label, _encode_spec
+from .daemon import STEER_KNOBS, _bucket_label, _encode_spec
 from .journal import JournalError, RequestJournal
 from .member import MEMBER_API_PREFIX, ServiceMember
 from .service import AdmissionError, retry_after_seconds
 from .tenant import TenantSpec, bucket_key
 
-__all__ = ["TenantRouter"]
+__all__ = ["TenantRouter", "fold_router_records"]
 
 #: How many migration / autoscale events the statusz tail keeps.
 _EVENT_TAIL = 50
+
+
+def fold_router_records(
+    records: Sequence[Any], base: dict[str, Any] | None = None
+) -> tuple[dict[str, Any], list[str]]:
+    """Pure fold of a router journal record stream onto an optional
+    snapshot base state; returns ``(state, anomalies)``.
+
+    The same function is both replay's fold (:meth:`TenantRouter.start`
+    seeds from ``journal.snapshot_state`` and folds the suffix) and
+    compaction's (:meth:`~evox_tpu.service.RequestJournal.compact` folds
+    the whole history into the next snapshot), so a snapshot-anchored
+    cold start computes exactly the placement map a full replay would.
+
+    ``state`` is canonical-JSON-serializable: ``placements`` maps
+    tenant_id → the folded placement record (uid, member, class, bucket,
+    encoded spec, ``auto`` for migration-minted moves — ``confirmed`` is
+    runtime-only and always False on restore), plus sorted
+    ``drained`` / ``retired`` member-index lists and the next free
+    ``uid_next``.  At-least-once semantics are the journal's: duplicates
+    collapse, last placement wins."""
+    base = base or {}
+    placements: dict[str, dict[str, Any]] = {
+        str(t): dict(p) for t, p in (base.get("placements") or {}).items()
+    }
+    drained = {int(i) for i in base.get("drained") or []}
+    retired = {int(i) for i in base.get("retired") or []}
+    uid_next = int(base.get("uid_next") or 0)
+    idem: dict[str, dict[str, Any]] = {
+        str(k): dict(v) for k, v in (base.get("idem") or {}).items()
+    }
+    anomalies: list[str] = []
+    for rec in records:
+        data = rec.data
+        key = data.get("idem")
+        principal = data.get("principal")
+        if key and principal:
+            # Mirrors Gateway._rebuild_idem exactly — the snapshot must
+            # preserve the dedup map a full-journal replay would build.
+            idem[f"{principal}:{key}"] = {
+                "route": rec.kind,
+                "tenant_id": data.get("tenant_id"),
+                "uid": data.get("uid"),
+                "knobs": {
+                    k: data[k]
+                    for k in STEER_KNOBS
+                    if rec.kind == "steer" and k in data
+                },
+            }
+        if rec.kind in ("placement", "migration"):
+            tid = str(data.get("tenant_id"))
+            placements[tid] = {
+                "tenant_id": tid,
+                "uid": int(data.get("uid", 0)),
+                "member": int(data.get("member", 0)),
+                "class": str(data.get("class", "standard")),
+                "bucket": str(data.get("bucket", "")),
+                "spec": str(data.get("spec", "")),
+                "auto": rec.kind == "migration",
+            }
+            if rec.kind == "migration":
+                # Keep the move's provenance so the statusz migration
+                # tail survives compaction.
+                placements[tid]["from"] = data.get("from")
+                if data.get("reason"):
+                    placements[tid]["reason"] = str(data["reason"])
+            uid_next = max(uid_next, int(data.get("uid", 0)) + 1)
+        elif rec.kind == "drain-member":
+            drained.add(int(data.get("member", -1)))
+        elif rec.kind == "retire-member":
+            index = int(data.get("member", -1))
+            retired.add(index)
+            drained.discard(index)
+    return (
+        {
+            "placements": placements,
+            "drained": sorted(drained),
+            "retired": sorted(retired),
+            "uid_next": uid_next,
+            "idem": idem,
+        },
+        anomalies,
+    )
 
 
 class _FleetTenants(Mapping):
@@ -204,9 +287,19 @@ class TenantRouter:
         endpoint: Union[int, bool, None] = None,
         endpoint_host: str = "127.0.0.1",
         on_event: Callable[[str], None] | None = None,
+        compact_records: int | None = None,
+        compact_bytes: int | None = None,
+        max_replay_seconds: float | None = None,
     ):
         if not members:
             raise ValueError("a router needs at least one member")
+        for name, value in (
+            ("compact_records", compact_records),
+            ("compact_bytes", compact_bytes),
+            ("max_replay_seconds", max_replay_seconds),
+        ):
+            if value is not None and value <= 0:
+                raise ValueError(f"{name} must be > 0, got {value}")
         if min_members < 1:
             raise ValueError(f"min_members must be >= 1, got {min_members}")
         if max_members is not None and max_members < min_members:
@@ -282,6 +375,18 @@ class TenantRouter:
         self.spawn_member = spawn_member
         self.fleet_dead_after = float(fleet_dead_after)
         self.fleet_start_grace = float(fleet_start_grace)
+        self.compact_records = (
+            None if compact_records is None else int(compact_records)
+        )
+        self.compact_bytes = (
+            None if compact_bytes is None else int(compact_bytes)
+        )
+        self.max_replay_seconds = (
+            None if max_replay_seconds is None else float(max_replay_seconds)
+        )
+        self.replay_seconds: float | None = None
+        self.compactions = 0
+        self.compaction_failures = 0
         self.started = False
         self.service = _FleetService(self)
         # tenant_id -> {"uid", "member", "class", "bucket", "spec",
@@ -350,6 +455,12 @@ class TenantRouter:
         except Exception:  # pragma: no cover - broken registry
             pass
 
+    def _gauge(self, name: str, value: float, help: str = "") -> None:
+        try:
+            self._registry.gauge(name, help).set(value)
+        except Exception:  # pragma: no cover - broken registry
+            pass
+
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> int:  # graftlint: disable=GL005
         """Start every live member (each replays its own journal), then
@@ -364,7 +475,17 @@ class TenantRouter:
         self.started = True
         if self.endpoint is not None and not self.endpoint.started:
             self.endpoint.start()
+        t_replay = time.perf_counter()
         records, damage = self.journal.replay(quarantine=True)
+        for note in self.journal.replay_notes:
+            # Snapshot-fallback recovery anomalies: the loudness
+            # contract — an operator must see every degraded path taken.
+            self._inc(
+                "evox_router_snapshot_fallbacks_total",
+                "Degraded recovery paths taken at router replay "
+                "(snapshot fallback, restored swap, gap warnings).",
+            )
+            self._event(f"router journal recovery: {note}", warn=True)
         if damage is not None:
             self._inc(
                 "evox_router_journal_tail_quarantines_total",
@@ -375,43 +496,58 @@ class TenantRouter:
                 f"{damage.bytes_quarantined} bytes quarantined",
                 warn=True,
             )
-        for rec in records:
-            data = rec.data
-            if rec.kind in ("placement", "migration"):
-                tid = str(data.get("tenant_id"))
-                self._placements[tid] = {
-                    "tenant_id": tid,
-                    "uid": int(data.get("uid", 0)),
-                    "member": int(data.get("member", 0)),
-                    "class": str(data.get("class", "standard")),
-                    "bucket": str(data.get("bucket", "")),
-                    "spec": str(data.get("spec", "")),
-                    "confirmed": False,
-                    "auto": rec.kind == "migration",
-                }
-                self._uid_next = max(
-                    self._uid_next, int(data.get("uid", 0)) + 1
-                )
-                if rec.kind == "migration":
-                    self._note_migration(data, replayed=True)
-            elif rec.kind == "drain-member":
-                member = self.members.get(int(data.get("member", -1)))
-                if member is not None:
-                    member.draining = True
-            elif rec.kind == "retire-member":
-                member = self.members.get(int(data.get("member", -1)))
-                if member is not None:
-                    member.retired = True
-                    member.draining = False
+        base = self.journal.snapshot_state
+        if base is not None:
+            self._event(
+                f"router journal replay anchored at snapshot seq "
+                f"{self.journal.snapshot_seq} "
+                f"({len(records)} suffix records to fold)"
+            )
+        # Fold snapshot base + record suffix with the same pure fold
+        # compaction snapshots through — both cold-start paths compute
+        # identical placement maps.
+        state, anomalies = fold_router_records(records, base=base)
+        for msg in anomalies:
+            self._event(f"router journal replay: {msg}", warn=True)
+        for tid, placement in state["placements"].items():
+            self._placements[tid] = {
+                **placement,
+                "confirmed": False,
+                "auto": bool(placement.get("auto")),
+            }
+            if placement.get("auto"):
+                self._note_migration(placement, replayed=True)
+        self._uid_next = max(self._uid_next, int(state["uid_next"]))
+        for index in state["drained"]:
+            member = self.members.get(int(index))
+            if member is not None:
+                member.draining = True
+        for index in state["retired"]:
+            member = self.members.get(int(index))
+            if member is not None:
+                member.retired = True
+                member.draining = False
         restored = len(self._placements)
         for member in self.members.values():
             if not member.retired:
                 member.start()
         self._reconcile(auto_only=False)
+        # The recovery-time signal: router replay + fold + member
+        # replays + reconcile (everything between cold start and
+        # serving again).
+        self.replay_seconds = time.perf_counter() - t_replay
+        self._gauge(
+            "evox_recovery_replay_seconds",
+            self.replay_seconds,
+            "Wall seconds of the last cold-start router recovery "
+            "(journal replay + fold + member starts + reconcile).",
+        )
+        self._journal_gauges()
         if restored:
             self._event(
                 f"router replay: {len(records)} records -> {restored} "
-                f"placements across {len(self.members)} members"
+                f"placements across {len(self.members)} members "
+                f"({self.replay_seconds:.3f}s recovery)"
             )
         return restored
 
@@ -436,8 +572,12 @@ class TenantRouter:
             if index in self._dead or member.retired:
                 continue
             busy = member.step() or busy
-        self._reconcile(auto_only=True)
+        # A reconcile forward lands AFTER its member's step this round —
+        # the round is not idle, or `run()` would drain out with the
+        # freshly re-delivered tenant still queued.
+        busy = self._reconcile(auto_only=True) > 0 or busy
         self._consult_autoscale()
+        self._maybe_compact()
         return busy
 
     def run(self, max_rounds: int | None = None) -> None:
@@ -864,7 +1004,7 @@ class TenantRouter:
         return record
 
     # -- reconciliation / migration -------------------------------------------
-    def _reconcile(self, *, auto_only: bool) -> None:
+    def _reconcile(self, *, auto_only: bool) -> int:
         """Complete journaled-but-unconfirmed placements.  At start
         (``auto_only=False``) every unconfirmed placement is checked
         against its member — present under the pinned uid means the
@@ -872,7 +1012,10 @@ class TenantRouter:
         now (exactly-once: the journal decided, this delivers).  In
         steady state only migration placements auto-retry; a client-
         facing placement whose forward failed waits for the client's
-        retry (the ack path stays client-driven)."""
+        retry (the ack path stays client-driven).  Returns how many
+        forwards were (re)delivered — work queued on a member whose
+        round already ran, so the caller's round is not idle."""
+        forwarded = 0
         for tenant_id, placement in list(self._placements.items()):
             if placement["confirmed"]:
                 continue
@@ -887,12 +1030,14 @@ class TenantRouter:
                 continue
             try:
                 self._forward_submit(placement, allow_collision=True)
+                forwarded += 1
             except (AdmissionError, KeyError, ValueError, RuntimeError) as e:
                 self._event(
                     f"reconcile of {tenant_id!r} on member "
                     f"{placement['member']} deferred: {e}",
                     warn=True,
                 )
+        return forwarded
 
     def poll_fleet(self, now: float | None = None) -> Any:  # graftlint: disable=GL005
         """Read the heartbeat plane and act on the verdicts: newly-dead
@@ -1182,6 +1327,130 @@ class TenantRouter:
                 f"(read-only; completed results stay fetchable)"
             )
 
+    # -- journal compaction ----------------------------------------------------
+    def _journal_gauges(self) -> None:
+        """Publish the journal-growth gauges the compaction SLO watches."""
+        self._gauge(
+            "evox_journal_bytes",
+            self.journal.size_bytes,
+            "Router journal file size in bytes.",
+        )
+        self._gauge(
+            "evox_journal_records",
+            self.journal.records_since_snapshot,
+            "Router journal records since the last snapshot anchor "
+            "(the whole history when never compacted).",
+        )
+        if self.journal.snapshot_at is not None:
+            self._gauge(
+                "evox_journal_snapshot_age_seconds",
+                max(0.0, time.time() - self.journal.snapshot_at),
+                "Seconds since the router journal's last snapshot.",
+            )
+
+    def _compaction_armed(self) -> bool:
+        return (
+            self.compact_records is not None
+            or self.compact_bytes is not None
+            or self.max_replay_seconds is not None
+        )
+
+    def _maybe_compact(self) -> None:  # graftlint: disable=GL005
+        """Boundary-time router-journal compaction: journal-growth
+        evidence → the same pure journaled ``compact`` decider the
+        daemon consults → the crash-safe snapshot/swap protocol,
+        snapshotting the placement map.  Never raises — a refused or
+        failed compaction warns and routing continues on the
+        (always-correct) uncompacted journal."""
+        self._journal_gauges()
+        if not self._compaction_armed():
+            return
+        evidence = {
+            "journal_bytes": self.journal.size_bytes,
+            "journal_records": self.journal.records_since_snapshot,
+            "live_tenants": len(self._placements),
+            "replay_seconds": self.replay_seconds,
+            "compact_records": self.compact_records,
+            "compact_bytes": self.compact_bytes,
+            "max_replay_seconds": self.max_replay_seconds,
+        }
+        action = self.controller.compact(
+            evidence=evidence, generation=self._rounds
+        )
+        if action == "compact":
+            self._compact_journal()
+
+    def _compact_journal(self) -> None:  # graftlint: disable=GL005 host-plane counters; never traced
+        """One crash-safe compaction through the journal's protocol,
+        folding the placement map with the same pure fold replay uses."""
+
+        def fold(
+            base: dict[str, Any] | None, records: list[Any]
+        ) -> dict[str, Any]:
+            state, _anomalies = fold_router_records(records, base=base)
+            return state
+
+        t0 = time.perf_counter()
+        try:
+            result = self.journal.compact(fold)
+        except JournalError as e:
+            self.compaction_failures += 1
+            self._inc(
+                "evox_router_compaction_failures_total",
+                "Router-journal compactions that failed (routing "
+                "continued on the uncompacted journal).",
+            )
+            self._event(f"router journal compaction failed ({e})", warn=True)
+            return
+        self.compactions += 1
+        self._inc(
+            "evox_router_compactions_total",
+            "Successful router-journal compactions.",
+        )
+        self._journal_gauges()
+        self._event(
+            f"router journal compacted at seq {result.seq}: "
+            f"{result.folded_records} records ({result.bytes_before} "
+            f"bytes) folded into {result.snapshot_path.name}; journal "
+            f"now {result.bytes_after} bytes"
+            + (
+                f"; GC'd {len(result.removed)} superseded artifacts"
+                if result.removed
+                else ""
+            )
+            + f" ({time.perf_counter() - t0:.3f}s)"
+        )
+
+    def _journal_statusz(self) -> dict[str, Any]:
+        """The journal/recovery strip ``evoxtop`` renders — same shape
+        as the daemon's."""
+        snapshot_at = self.journal.snapshot_at
+        strip: dict[str, Any] = {
+            "bytes": self.journal.size_bytes,
+            "records_since_snapshot": self.journal.records_since_snapshot,
+            "snapshot_seq": self.journal.snapshot_seq,
+            "snapshot_age_seconds": (
+                None
+                if snapshot_at is None
+                else max(0.0, time.time() - snapshot_at)
+            ),
+            "replay_seconds": self.replay_seconds,
+            "compactions": self.compactions,
+            "compaction_failures": self.compaction_failures,
+            "fallbacks": self.journal.snapshot_fallbacks,
+            "armed": self._compaction_armed(),
+        }
+        if self.controller is not None:
+            strip["decisions"] = [
+                m
+                for m in (
+                    d.to_manifest()
+                    for d in list(self.controller.decisions)[-40:]
+                )
+                if m.get("kind") == "compact"
+            ][-4:]
+        return strip
+
     # -- gateway-compat surface ----------------------------------------------
     @property
     def _last_segment_seconds(self) -> float | None:
@@ -1312,6 +1581,7 @@ class TenantRouter:
                 "migrations": list(self._migrations[-20:]),
                 "autoscale": list(self._autoscale_events[-20:]),
             },
+            "journal": self._journal_statusz(),
         }
         if self.controller is not None:
             out["decisions"] = [

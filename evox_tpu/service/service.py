@@ -46,6 +46,7 @@ That is the bulkhead contract ``tests/test_service.py`` pins bit-exactly.
 from __future__ import annotations
 
 import dataclasses
+import os
 import warnings
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -574,11 +575,20 @@ class OptimizationService:
             )
         return self._buckets[record.bucket].pack.lane_state(record.lane)
 
-    def forget(self, tenant_id: str) -> None:
-        """Retire a COMPLETED/EVICTED/QUARANTINED tenant's record (its
-        checkpoint namespace stays on disk).  A quarantined tenant still
-        holds its frozen lane — it is released here, so retiring the
-        record returns the capacity to the pack."""
+    def forget(self, tenant_id: str, *, purge: bool = False) -> None:
+        """Retire a COMPLETED/EVICTED/QUARANTINED tenant's record.  A
+        quarantined tenant still holds its frozen lane — it is released
+        here, so retiring the record returns the capacity to the pack.
+
+        With ``purge=False`` (default) the checkpoint namespace stays on
+        disk (resumable by a later submit of the same id).  With
+        ``purge=True`` the tenant's checkpoint namespace and flight dir
+        are GC'd through the store — the daemon passes it once the
+        ``retire`` journal record is durable (the durable-successor
+        rule), closing the retired-tenants-leak: without it, disk grows
+        with *lifetime* churn instead of *live* tenants.  GC is advisory
+        and store-routed: a read-only store's refusal (non-primary
+        process) leaves the files for the primary to reap."""
         record = self._tenants.get(tenant_id)
         if record is None:
             return
@@ -603,6 +613,38 @@ class OptimizationService:
             # churn must not grow the registry (and every snapshot /
             # heartbeat payload) without bound.
             self.obs.registry.remove_labeled("tenant_id", tenant_id)
+        if purge:
+            self._purge_tenant_dirs(tenant_id, record)
+
+    def _purge_tenant_dirs(self, tenant_id: str, record: TenantRecord) -> None:
+        """Reclaim a retired tenant's disk: the checkpoint namespace and
+        the labeled flight dir, bottom-up through the store seam (every
+        unlink chaos-injectable and refused cleanly by a read-only
+        store).  Advisory — a failed unlink leaves orphans a later purge
+        re-reaps, never an error on the retire path."""
+        targets = [self.namespace(tenant_id)]
+        if record.flight is not None:
+            targets.append(record.flight.dir)
+        elif self.obs is not None and self.obs.flight is not None:
+            targets.append(self.obs.flight.dir / tenant_id)
+        for root in targets:
+            if not root.is_dir():
+                continue
+            for dirpath, dirnames, filenames in os.walk(root, topdown=False):
+                for name in filenames:
+                    try:
+                        self.store.unlink(Path(dirpath) / name)
+                    except OSError:
+                        pass
+                for name in dirnames:
+                    try:
+                        os.rmdir(Path(dirpath) / name)
+                    except OSError:
+                        pass
+            try:
+                os.rmdir(root)
+            except OSError:
+                pass
 
     def withdraw(
         self, tenant_id: str, *, to_status: TenantStatus | None = None

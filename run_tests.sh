@@ -61,7 +61,9 @@
 #                               entries quarantined), SLO admission
 #                               (shed with retry-after, brown-out), and
 #                               the 64-tenant kill-restart acceptance —
-#                               then tools/bench_daemon.py: the
+#                               then tools/bench_recovery.py (snapshot-
+#                               anchored cold start >= 5x full-history
+#                               replay) and tools/bench_daemon.py: the
 #                               CompileSentinel-verified zero-compile
 #                               warm-restart gate and the 90% overload
 #                               retention gate (artifacts under
@@ -289,6 +291,9 @@ if [ "$1" = "--serve" ]; then
   # Serving-plane discipline: the host rules (GL009 durable writes, GL010
   # journal-before-ack, GL011-GL013) must stay clean over the daemon path.
   python -m tools.graftlint || exit 1
+  # Bounded-recovery gate: snapshot-anchored cold start must beat full
+  # long-history replay by >= 5x (report-only on starved 1-core CPU).
+  timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_recovery.py || exit 1
   exec timeout -k 30 900 "${CPU_ENV[@]}" python tools/bench_daemon.py
 fi
 if [ "$1" = "--gateway" ]; then

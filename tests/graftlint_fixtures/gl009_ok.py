@@ -58,6 +58,40 @@ def write_metrics_atomic(path, text):
     return path
 
 
+def compact_journal(journal_path, snapshot_blob, anchor_line):
+    # The compaction snapshot-swap idiom (PR 19's `RequestJournal.compact`
+    # shape): publish the snapshot, then atomically swap the journal to a
+    # one-anchor-record successor — every durable byte goes temp-first and
+    # lands via os.replace, so a kill at any boundary leaves either the
+    # old journal or the new one, never a torn hybrid.
+    snap = journal_path.with_suffix(".snapshot")
+    fd, tmp = _STORE.open_temp(snap.parent, snap.name + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(snapshot_blob)
+            _STORE.fsync_file(f)
+        _STORE.publish(tmp, snap)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fd, tmp = _STORE.open_temp(journal_path.parent, journal_path.name + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(anchor_line)
+            _STORE.fsync_file(f)
+        _STORE.publish(tmp, journal_path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return snap
+
+
 def read_config(path):
     # Read-mode opens are not durable writes.
     with open(path) as f:

@@ -48,6 +48,23 @@ class Daemon:
         self.evict(tenant_id)
         return "parked"
 
+    def retire(self, tenant_id):
+        # The PR-19 compaction-boundary shape: the retire record lands in
+        # the journal FIRST, then the in-memory map shrinks, then the
+        # (non-acking) boundary compaction folds the journal onto a fresh
+        # snapshot anchor.  Both the destructive pop and the ack are
+        # downstream of the append.
+        self._journal("retire", tenant_id=tenant_id)
+        self.service.forget(tenant_id)
+        self._compact()
+        return "retired"
+
+    def _compact(self):
+        # Fold-and-swap is internal maintenance, not a handler: it never
+        # acks a request and every byte it moves is already journaled.
+        snapshot = self.journal.fold()
+        self.journal.swap(snapshot)
+
 
 class Gateway:
     """The post-PR-16 gateway shapes."""

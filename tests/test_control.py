@@ -36,6 +36,7 @@ from evox_tpu.control import (
     decide,
     decide_brownout,
     decide_cadence,
+    decide_compact,
     decide_shed,
     decide_tenant,
     decide_trend,
@@ -281,6 +282,70 @@ def test_decide_tenant_ladder():
     assert decide_tenant(
         {"verdict": "storm", "restarts_used": 0, "max_restarts": 1}
     ) == "restart"
+
+
+def test_decide_compact_matrix():
+    base = {
+        "journal_records": 100,
+        "live_tenants": 10,
+        "journal_bytes": 10_000,
+        "replay_seconds": 0.5,
+        "compact_records": None,
+        "compact_bytes": None,
+        "max_replay_seconds": None,
+    }
+    # Nothing armed: compaction is advisory, hold.
+    assert decide_compact(base) == "hold"
+    # Each armed bound trips independently.
+    assert decide_compact({**base, "compact_records": 100}) == "compact"
+    assert decide_compact({**base, "compact_records": 101}) == "hold"
+    assert decide_compact({**base, "compact_bytes": 10_000}) == "compact"
+    assert decide_compact({**base, "compact_bytes": 10_001}) == "hold"
+    assert decide_compact({**base, "max_replay_seconds": 0.5}) == "compact"
+    assert decide_compact({**base, "max_replay_seconds": 0.6}) == "hold"
+    # Folding fewer records than live entries cannot shrink the journal.
+    assert decide_compact(
+        {**base, "journal_records": 10, "compact_records": 1}
+    ) == "hold"
+    assert decide_compact(
+        {**base, "journal_records": 0, "compact_records": 1}
+    ) == "hold"
+    # Missing signals hold, never crash (no replay measured yet).
+    assert decide_compact(
+        {**base, "replay_seconds": None, "max_replay_seconds": 0.1}
+    ) == "hold"
+    assert decide_compact({}) == "hold"
+    # The dispatch table knows the kind.
+    assert decide("compact", {**base, "compact_records": 10}) == "compact"
+
+
+def test_controller_compact_journaled_quiet_window_and_replay(tmp_path):
+    journal = RequestJournal(tmp_path / "decisions.jsonl")
+    ctl = Controller(grace=8, journal=journal)
+    evidence = {
+        "journal_records": 64,
+        "live_tenants": 3,
+        "journal_bytes": 9_999,
+        "replay_seconds": 0.25,
+        "compact_records": 32,
+        "compact_bytes": None,
+        "max_replay_seconds": None,
+    }
+    assert ctl.compact(evidence=evidence, generation=10) == "compact"
+    # Quiet window: a freshly-compacted journal gets ``grace``
+    # boundaries to accumulate before the next verdict.
+    assert ctl.compact(evidence=evidence, generation=11) == "hold"
+    assert ctl.compact(evidence=evidence, generation=18) == "hold"
+    assert ctl.compact(evidence=evidence, generation=19) == "compact"
+    # Holds are silent; both compact decisions journaled with evidence.
+    assert [d.kind for d in ctl.decisions] == ["compact", "compact"]
+    assert ctl.decisions[0].evidence["journal_records"] == 64.0
+    records, damage = journal.replay()
+    assert damage is None
+    replayed = Controller.replay_decisions(records)
+    assert [d.to_manifest() for d in replayed] == [
+        d.to_manifest() for d in ctl.decisions
+    ]
 
 
 def test_decide_rejects_unknown_kind():

@@ -17,7 +17,10 @@ entries quarantined ``*.corrupt``, never trusted), SLO admission
 stretch), and the ``AdmissionError.retry_after_segments`` satellite.
 """
 
+import errno
+import json
 import os
+import random
 import warnings
 
 import jax
@@ -39,6 +42,7 @@ from evox_tpu.service import (
     TenantStatus,
     retry_after_seconds,
 )
+from evox_tpu.service.daemon import fold_daemon_records
 from evox_tpu.utils import ExecutableCache, abstract_signature
 from evox_tpu.utils.checkpoint import ReadOnlyCheckpointStore, read_manifest
 
@@ -1254,3 +1258,506 @@ def test_runner_shared_exec_cache_isolates_programs(tmp_path):
     run(Sphere(), "b")  # same shapes, different program
     # The Sphere run must NOT have been served Ackley's executables.
     assert cache.stats.hits == hits_before
+
+
+# -- journal compaction: crash-safe snapshot/swap protocol -------------------
+
+
+def _count_fold(base, records):
+    """A tiny pure fold for journal-level compaction tests: counts
+    records and accumulates uids (canonically JSON-serializable)."""
+    base = base or {"n": 0, "uids": []}
+    return {
+        "n": base["n"] + len(records),
+        "uids": sorted(set(base["uids"]) | {r.data["uid"] for r in records}),
+    }
+
+
+def _journal_with(tmp_path, n):
+    j = RequestJournal(tmp_path / "j.jsonl")
+    for i in range(n):
+        j.append("submit", uid=i)
+    return j
+
+
+def test_journal_compact_roundtrip_and_sequence_continuation(tmp_path):
+    j = _journal_with(tmp_path, 5)
+    result = j.compact(_count_fold)
+    assert result.seq == 5 and result.folded_records == 5
+    assert result.bytes_after < result.bytes_before
+    assert j.records_since_snapshot == 0
+    # The anchor consumed seq 5: the suffix continues from 6.
+    assert j.append("submit", uid=99) == 6
+    j.close()
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    records, damage = j2.replay()
+    assert damage is None and j2.replay_notes == []
+    assert j2.snapshot_seq == 5
+    assert j2.snapshot_state == {"n": 5, "uids": [0, 1, 2, 3, 4]}
+    assert [r.data["uid"] for r in records] == [99]
+    assert j2.records_since_snapshot == 1
+
+
+def test_journal_second_compaction_folds_base_and_gcs_superseded(tmp_path):
+    j = _journal_with(tmp_path, 3)
+    first = j.compact(_count_fold)
+    for i in range(3, 6):
+        j.append("submit", uid=i)
+    second = j.compact(_count_fold)
+    assert j.snapshot_state == {"n": 6, "uids": [0, 1, 2, 3, 4, 5]}
+    names = {p.name for p in tmp_path.iterdir()} - {"j.jsonl"}
+    # Keep-set: the new snapshot + copy, plus the PRIOR snapshot (the
+    # fresh copy's own record 0 still anchors to it).
+    assert second.snapshot_path.name in names
+    assert second.fallback_path.name in names
+    assert first.snapshot_path.name in names
+    # The first compaction's full-journal copy is superseded and GC'd.
+    assert first.fallback_path.name not in names
+    assert first.fallback_path.name in second.removed
+    # A third compaction retires the first snapshot too.
+    j.append("submit", uid=6)
+    j.compact(_count_fold)
+    names = {p.name for p in tmp_path.iterdir()} - {"j.jsonl"}
+    assert first.snapshot_path.name not in names
+    assert second.snapshot_path.name in names  # now the prior anchor's
+    j.close()
+    # Replay through the chained anchors folds base-of-base correctly.
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    _records, damage = j2.replay()
+    assert damage is None and j2.replay_notes == []
+    assert j2.snapshot_state == {"n": 7, "uids": [0, 1, 2, 3, 4, 5, 6]}
+
+
+def test_journal_compact_refuses_empty_and_unhealed_damage(tmp_path):
+    j = RequestJournal(tmp_path / "j.jsonl")
+    with pytest.raises(JournalError, match="empty"):
+        j.compact(_count_fold)
+    j.append("submit", uid=0)
+    j.close()
+    # A read-only store cannot truncate the damaged tail away: replay
+    # leaves the journal dirty and compaction must refuse rather than
+    # snapshot around unhealed damage.
+    with open(tmp_path / "j.jsonl", "ab") as f:
+        f.write(b'{"body":{"seq":1,"kind":"subm')
+    ro = RequestJournal(
+        tmp_path / "j.jsonl", store=ReadOnlyCheckpointStore()
+    )
+    with pytest.raises(JournalError, match="damaged tail"):
+        silent(ro.compact, _count_fold)
+
+
+@pytest.mark.parametrize("damage_kind", ["torn", "flip", "missing"])
+def test_journal_unusable_snapshot_falls_back_loudly(tmp_path, damage_kind):
+    j = _journal_with(tmp_path, 4)
+    result = j.compact(_count_fold)
+    j.append("submit", uid=9)
+    j.close()
+    sp = result.snapshot_path
+    if damage_kind == "torn":
+        sp.write_bytes(sp.read_bytes()[: sp.stat().st_size // 2])
+    elif damage_kind == "flip":
+        raw = bytearray(sp.read_bytes())
+        raw[len(raw) // 2] ^= 0x01
+        sp.write_bytes(bytes(raw))
+    else:
+        sp.unlink()
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        records, damage = j2.replay()
+    # Loud: a replay note + RuntimeWarning, and the counter ticks.
+    assert j2.snapshot_fallbacks == 1
+    assert any("falling back" in n for n in j2.replay_notes)
+    assert any("falling back" in str(w.message) for w in caught)
+    # No acked record lost: the full pre-compaction history folds back.
+    assert damage is None
+    assert [r.data["uid"] for r in records] == [0, 1, 2, 3, 9]
+    assert j2.snapshot is None
+
+
+def test_journal_torn_swap_restores_from_quarantined_copy(tmp_path):
+    j = _journal_with(tmp_path, 4)
+    j.compact(_count_fold)
+    j.close()
+    # Tear the swapped-in anchor journal itself: record 0 damaged — the
+    # kill-mid-truncate / torn-swap signature.
+    path = tmp_path / "j.jsonl"
+    path.write_bytes(path.read_bytes()[: path.stat().st_size // 2])
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    records, damage = silent(j2.replay)
+    assert [r.data["uid"] for r in records] == [0, 1, 2, 3]
+    assert damage is not None and "recovered from" in damage.reason
+    assert j2.snapshot_fallbacks == 1
+    # The restore is durable: a second replay is clean, no fallback.
+    j3 = RequestJournal(tmp_path / "j.jsonl")
+    records, damage = j3.replay()
+    assert damage is None and j3.snapshot_fallbacks == 0
+    assert [r.data["uid"] for r in records] == [0, 1, 2, 3]
+    # And the restored journal keeps accepting appends in sequence.
+    assert j3.append("submit", uid=4) == 4
+
+
+def test_journal_snapshot_and_fallback_both_lost_refuses_loudly(tmp_path):
+    j = _journal_with(tmp_path, 4)
+    result = j.compact(_count_fold)
+    j.close()
+    result.snapshot_path.unlink()
+    result.fallback_path.unlink()
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    with pytest.raises(JournalError, match="refusing to silently drop"):
+        silent(j2.replay)
+
+
+@pytest.mark.parametrize("step", [0, 1, 2], ids=["snapshot", "copy", "swap"])
+@pytest.mark.parametrize("fault", ["enospc", "crash"])
+def test_journal_compaction_fault_at_each_step_is_harmless(
+    tmp_path, fault, step
+):
+    """ENOSPC or a crash at each of the three publish points (snapshot,
+    full-journal copy, swap): compaction fails loudly, the journal is
+    byte-identical, and a later retry through a healthy store lands."""
+    j = _journal_with(tmp_path, 4)
+    j.close()
+    before = (tmp_path / "j.jsonl").read_bytes()
+    store = FaultyStore(**{f"{fault}_saves": [step]})
+    jf = RequestJournal(tmp_path / "j.jsonl", store=store)
+    with pytest.raises(JournalError, match="compaction at seq 4 failed"):
+        silent(jf.compact, _count_fold)
+    jf.close()
+    assert (tmp_path / "j.jsonl").read_bytes() == before
+    # Cold replay still folds the full history — the swap's rename is
+    # the only commit point and it never ran.
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    records, damage = j2.replay()
+    assert damage is None and j2.snapshot is None
+    assert [r.data["uid"] for r in records] == [0, 1, 2, 3]
+    # The retry (healthy store) compacts at the same seq.
+    result = j2.compact(_count_fold)
+    assert result.seq == 4
+    assert j2.snapshot_state == {"n": 4, "uids": [0, 1, 2, 3]}
+
+
+def test_journal_torn_swap_chaos_cold_replay_recovers(tmp_path):
+    """FaultyStore tears the swap itself (save index 2): compaction
+    believes it committed, but the anchor on disk is torn — a cold
+    replay must restore every acked record from the step-2 copy."""
+    j = _journal_with(tmp_path, 4)
+    j.close()
+    jf = RequestJournal(tmp_path / "j.jsonl", store=FaultyStore(torn_saves=[2]))
+    jf.compact(_count_fold)  # the lying disk publishes a torn anchor
+    jf.close()
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    records, damage = silent(j2.replay)
+    assert [r.data["uid"] for r in records] == [0, 1, 2, 3]
+    assert damage is not None and "recovered from" in damage.reason
+    assert j2.snapshot_fallbacks == 1
+
+
+def test_journal_snapshot_flip_after_publish_falls_back(tmp_path):
+    """FaultyStore flips a bit in the published snapshot (save index 0):
+    the anchor's sha binding catches it and replay falls back loudly to
+    the quarantined copy — acked records survive silent corruption."""
+    j = _journal_with(tmp_path, 4)
+    j.close()
+    jf = RequestJournal(tmp_path / "j.jsonl", store=FaultyStore(flip_saves=[0]))
+    jf.compact(_count_fold)
+    jf.close()
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    records, damage = silent(j2.replay)
+    assert damage is None
+    assert j2.snapshot is None and j2.snapshot_fallbacks == 1
+    assert [r.data["uid"] for r in records] == [0, 1, 2, 3]
+
+
+def test_journal_kill_between_swap_and_gc_leaves_recoverable_artifacts(
+    tmp_path,
+):
+    """A kill after the swap commits but before GC runs leaves stale
+    snapshot/copy artifacts.  They are harmless — replay ignores them —
+    and the next compaction through a healthy store reaps them."""
+
+    class _NoGC(FaultyStore):
+        def unlink(self, path):
+            raise OSError(errno.EPERM, "killed before GC (injected)")
+
+    j = RequestJournal(tmp_path / "j.jsonl", store=_NoGC())
+    for i in range(3):
+        j.append("submit", uid=i)
+    first = j.compact(_count_fold)
+    j.append("submit", uid=3)
+    second = j.compact(_count_fold)  # GC refused: nothing removed
+    assert second.removed == []
+    assert first.fallback_path.exists()  # superseded but still on disk
+    j.close()
+    # Replay is correct despite the stale artifacts ...
+    j2 = RequestJournal(tmp_path / "j.jsonl")
+    _records, damage = j2.replay()
+    assert damage is None
+    assert j2.snapshot_state == {"n": 4, "uids": [0, 1, 2, 3]}
+    # ... and the next compaction finally reaps the superseded copy.
+    j2.append("submit", uid=4)
+    third = j2.compact(_count_fold)
+    assert first.fallback_path.name in third.removed
+    assert not first.fallback_path.exists()
+
+
+_FUZZ_FAULTS = [
+    "none",
+    "crash0",
+    "crash1",
+    "crash2",
+    "enospc0",
+    "enospc1",
+    "enospc2",
+    "torn2",
+    "flip0",
+]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_journal_compaction_killpoint_fuzz_replay_equivalence(
+    tmp_path, seed
+):
+    """Seeded randomized kill-point fuzz (satellite): random operation
+    schedules with compactions attempted at random points, each under a
+    randomly drawn FaultyStore fault (crash/ENOSPC at every protocol
+    step, torn swap, post-publish snapshot flip), every attempt followed
+    by a modelled SIGKILL (abandon + fresh replay).  After every round
+    the folded state must equal a never-compacted twin journal's —
+    replay equivalence under composed faults, deterministically."""
+    rng = random.Random(seed)
+    chaos_path = tmp_path / "chaos.jsonl"
+    ref_path = tmp_path / "ref.jsonl"
+    chaos = RequestJournal(chaos_path)
+    ref = RequestJournal(ref_path)
+    live: set[int] = set()
+    uid_next = 0
+
+    def append_both(kind, **data):
+        chaos.append(kind, **data)
+        ref.append(kind, **data)
+
+    def fold(base, records):
+        state, _anomalies = fold_daemon_records(records, base=base)
+        return state
+
+    fault_kwargs = {
+        "crash": "crash_saves",
+        "enospc": "enospc_saves",
+        "torn": "torn_saves",
+        "flip": "flip_saves",
+    }
+    for round_no in range(6):
+        for _ in range(rng.randrange(1, 6)):
+            op = rng.random()
+            if op < 0.5 or not live:
+                uid = uid_next
+                uid_next += 1
+                append_both(
+                    "submit",
+                    tenant_id=f"t{uid}",
+                    uid=uid,
+                    n_steps=16,
+                    spec="x" * 64,
+                    **{"class": "standard"},
+                )
+                live.add(uid)
+            elif op < 0.65:
+                uid = rng.choice(sorted(live))
+                append_both(
+                    "steer",
+                    tenant_id=f"t{uid}",
+                    uid=uid,
+                    n_steps=rng.randrange(4, 64),
+                )
+            elif op < 0.8:
+                uid = rng.choice(sorted(live))
+                append_both(
+                    "complete", tenant_id=f"t{uid}", uid=uid, generations=8
+                )
+            elif op < 0.9:
+                uid = rng.choice(sorted(live))
+                append_both("evict", tenant_id=f"t{uid}", uid=uid)
+            else:
+                uid = rng.choice(sorted(live))
+                live.discard(uid)
+                append_both("retire", tenant_id=f"t{uid}", uid=uid)
+        # A compaction attempt under a randomly drawn fault, then
+        # SIGKILL (abandon the journal object mid-protocol).
+        fault = rng.choice(_FUZZ_FAULTS)
+        chaos.close()
+        if fault == "none":
+            jc = RequestJournal(chaos_path)
+        else:
+            key = fault_kwargs[fault[:-1]]
+            jc = RequestJournal(
+                chaos_path, store=FaultyStore(**{key: [int(fault[-1])]})
+            )
+        try:
+            silent(jc.compact, fold)
+        except JournalError:
+            pass  # failed compaction: serving continues uncompacted
+        jc.close()  # nothing else runs — the kill
+        # Cold replay over whatever the crash left on disk must fold to
+        # exactly the state of the never-compacted twin.
+        j2 = RequestJournal(chaos_path)
+        records, _damage = silent(j2.replay)
+        state_chaos = fold(j2.snapshot_state, records)
+        ref_records, ref_damage = RequestJournal(ref_path).replay()
+        assert ref_damage is None
+        state_ref = fold(None, ref_records)
+        assert json.dumps(state_chaos, sort_keys=True) == json.dumps(
+            state_ref, sort_keys=True
+        ), f"seed {seed} round {round_no} fault {fault}: states diverge"
+        j2.close()
+        # Continue the workload over the recovered journal.
+        chaos = RequestJournal(chaos_path)
+        silent(chaos.replay)
+    chaos.close()
+    ref.close()
+
+
+# -- daemon: boundary-time compaction + bounded recovery ---------------------
+
+
+def test_daemon_compaction_decider_fires_and_restart_bit_identical(tmp_path):
+    """The full loop: journal growth -> journaled ``compact`` decision ->
+    snapshot/swap at a scheduling boundary -> SIGKILL -> snapshot-anchored
+    recovery bit-identical to the uninterrupted reference daemon."""
+    expected, expected_digests = _reference_results(tmp_path)
+    root = tmp_path / "compacted"
+    daemon = make_daemon(root, compact_records=4)
+    daemon.start()
+    for i in range(N_TENANTS):
+        daemon.submit(pso_spec(f"t{i}", i))
+    for i in range(N_TENANTS):
+        # Steer to the budget the tenants already have: pure journal
+        # growth, identical scheduling to the reference run.
+        daemon.steer(f"t{i}", n_steps=12)
+    run_silently(daemon)
+    assert daemon.stats.compactions >= 1
+    assert daemon.stats.compaction_failures == 0
+    assert daemon.journal.snapshot_seq is not None
+    strip = daemon._journal_statusz()
+    assert strip["armed"] is True
+    assert strip["compactions"] == daemon.stats.compactions
+    assert strip["snapshot_seq"] == daemon.journal.snapshot_seq
+    assert strip["snapshot_age_seconds"] is not None
+    assert strip["decisions"], "compact decisions missing from statusz"
+    assert all(m["kind"] == "compact" for m in strip["decisions"])
+    del daemon  # SIGKILL after the compaction committed
+
+    restarted = make_daemon(root, compact_records=4)
+    assert silent(restarted.start) == N_TENANTS
+    # Snapshot-anchored recovery, measured and exported.
+    assert restarted.journal.snapshot_seq is not None
+    assert restarted.journal.snapshot_fallbacks == 0
+    assert restarted.stats.replay_seconds is not None
+    run_silently(restarted)
+    for i in range(N_TENANTS):
+        tid = f"t{i}"
+        assert restarted.tenant(tid).status is TenantStatus.COMPLETED
+        assert_states_equal(expected[tid], restarted.result(tid), tid)
+        assert last_checkpoint_digests(root, tid) == expected_digests[tid]
+
+
+@pytest.mark.parametrize(
+    "boundary",
+    [
+        "mid-snapshot-publish",
+        "post-snapshot-pre-copy",
+        "post-copy-pre-swap",
+        "post-swap-pre-gc",
+    ],
+)
+def test_daemon_kill_at_every_compaction_boundary_bit_identical(
+    tmp_path, boundary
+):
+    """SIGKILL at every boundary of the compaction protocol itself, with
+    tenants mid-run: the injected crash aborts ``compact()`` exactly
+    between protocol steps, the daemon is abandoned, and the restart
+    finishes every tenant bit-identical to the uninterrupted reference
+    (final states AND checkpoint leaf digests)."""
+    expected, expected_digests = _reference_results(tmp_path)
+    root = tmp_path / "killed"
+    daemon = make_daemon(root)
+    daemon.start()
+    for i in range(N_TENANTS):
+        daemon.submit(pso_spec(f"t{i}", i))
+    run_silently(daemon, max_rounds=1)  # mid-run: checkpoints exist
+    if boundary == "post-swap-pre-gc":
+        # The swap committed; the kill lands before GC ran.  (The GC
+        # step is advisory — a first compaction has nothing to reap, so
+        # the crash window is just "after commit, before anything
+        # else".)
+        silent(daemon._compact_journal)
+        assert daemon.stats.compactions == 1
+        assert daemon.stats.compaction_failures == 0
+    else:
+        step = {
+            "mid-snapshot-publish": 0,
+            "post-snapshot-pre-copy": 1,
+            "post-copy-pre-swap": 2,
+        }[boundary]
+        daemon.journal.store = FaultyStore(crash_saves=[step])
+        silent(daemon._compact_journal)
+        assert daemon.stats.compactions == 0
+        assert daemon.stats.compaction_failures == 1
+    del daemon  # SIGKILL: no shutdown path runs
+
+    restarted = make_daemon(root)
+    assert silent(restarted.start) == N_TENANTS
+    if boundary == "post-swap-pre-gc":
+        assert restarted.journal.snapshot_seq is not None
+    else:
+        # The swap never committed: recovery is the plain full replay.
+        assert restarted.journal.snapshot_seq is None
+    run_silently(restarted)
+    for i in range(N_TENANTS):
+        tid = f"t{i}"
+        assert restarted.tenant(tid).status is TenantStatus.COMPLETED
+        assert_states_equal(
+            expected[tid], restarted.result(tid), f"{boundary}: {tid}"
+        )
+        assert last_checkpoint_digests(root, tid) == expected_digests[tid], (
+            f"{boundary}: {tid} final checkpoint digests differ"
+        )
+
+
+def test_forget_purges_disk_and_100_tenant_churn_stays_o_live(tmp_path):
+    """The retention regression (satellite): 100 churned tenants
+    (submit -> run -> forget) must leave disk and journal proportional
+    to LIVE tenants, not lifetime admissions — ``forget`` reaps the
+    checkpoint namespace once the retire record is durable, and armed
+    compaction folds the churn out of the journal."""
+    root = tmp_path / "svc"
+    daemon = make_daemon(root, compact_records=24)
+    daemon.start()
+    for batch in range(10):
+        for k in range(10):
+            uid = batch * 10 + k
+            daemon.submit(pso_spec(f"churn-{uid}", uid, n_steps=4))
+        run_silently(daemon)
+        for k in range(10):
+            uid = batch * 10 + k
+            assert (
+                daemon.tenant(f"churn-{uid}").status
+                is TenantStatus.COMPLETED
+            )
+            daemon.forget(f"churn-{uid}")
+    for i in range(2):
+        daemon.submit(pso_spec(f"live-{i}", 1000 + i, n_steps=4))
+    run_silently(daemon)
+    # Disk is O(live): every churned namespace was reaped.
+    assert sorted(os.listdir(root / "tenants")) == ["live-0", "live-1"]
+    # The journal is bounded: compaction folded the churn away.
+    assert daemon.stats.compactions >= 1
+    assert daemon.journal.records_since_snapshot < 100
+    # And the folded state itself is O(live): no churned uid survives.
+    records, damage = silent(daemon.journal.replay)
+    assert damage is None
+    state, _ = fold_daemon_records(
+        records, base=daemon.journal.snapshot_state
+    )
+    assert set(state["live"]) == {"1000", "1001"}
+    daemon.close()

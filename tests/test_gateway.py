@@ -218,6 +218,49 @@ def test_idempotent_submit_in_process_and_across_restart(tmp_path):
         daemon.close()
 
 
+def test_idempotent_resubmit_straddles_journal_compaction(tmp_path):
+    """A gateway-retried submit whose first attempt predates a journal
+    compaction must still replay the ack: the idempotency map is folded
+    into the snapshot and ``_rebuild_idem`` recovers it from there."""
+    from evox_tpu.service import RequestJournal, ServiceDaemon
+
+    root = tmp_path / "svc"
+    daemon, gateway = gw_daemon(root)
+    gateway.start()
+    client = client_for(daemon)
+    key = client.new_idem_key()
+    spec = pso_spec("t0", 0, n_steps=8)
+    first = client.submit(spec, idem_key=key)
+    assert first["uid"] == 0
+    run_silently(daemon)
+    # Boundary-time compaction folds the submit record — and its
+    # idempotency key — into the snapshot.
+    silent(daemon._compact_journal)
+    assert daemon.stats.compactions == 1
+    kill(daemon)
+    del gateway, daemon  # SIGKILL straddling the retry
+
+    daemon, gateway = gw_daemon(root)
+    silent(gateway.start)
+    try:
+        assert daemon.journal.snapshot_seq is not None
+        replay = client_for(daemon).submit(spec, idem_key=key)
+        assert replay["idempotent_replay"] is True and replay["uid"] == 0
+        assert len(daemon.service._tenants) == 1
+        assert gateway.statusz_payload()["idem_replays"] == 1
+        # Exactly one admission across the whole history: the submit
+        # lives in the snapshot, the suffix journal holds no second one.
+        journal = RequestJournal(root / ServiceDaemon.JOURNAL_NAME)
+        records, damage = silent(journal.replay)
+        assert damage is None
+        assert [r for r in records if r.kind == "submit"] == []
+        assert (journal.snapshot_state or {}).get("idem"), (
+            "idempotency map missing from the snapshot"
+        )
+    finally:
+        daemon.close()
+
+
 # -- overload → HTTP ---------------------------------------------------------
 
 

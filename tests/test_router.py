@@ -701,3 +701,183 @@ def test_evoxtop_renders_router_view_and_probes_dead_members(tmp_path):
         assert "1:dead" in evoxtop.render(status, 200, {"healthy": False})
     finally:
         router.close()
+
+
+# -- journal compaction: snapshot-anchored router recovery -------------------
+
+
+def test_fold_router_records_placements_members_and_idem():
+    from evox_tpu.service.journal import JournalRecord
+    from evox_tpu.service.router import fold_router_records
+
+    def rec(seq, kind, **data):
+        return JournalRecord(seq=seq, kind=kind, at=0.0, data=data)
+
+    records = [
+        rec(
+            0, "placement", tenant_id="t0", uid=0, member=0,
+            bucket="b", spec="s0", idem="k0", principal="alice",
+            **{"class": "standard"},
+        ),
+        rec(
+            1, "placement", tenant_id="t1", uid=1, member=1,
+            bucket="b", spec="s1", **{"class": "standard"},
+        ),
+        rec(
+            2, "migration", tenant_id="t1", uid=1, member=0,
+            bucket="b", spec="s1", reason="member-dead",
+            **{"from": 1, "class": "standard"},
+        ),
+        rec(3, "drain-member", member=1),
+        rec(4, "retire-member", member=1),
+        # Last placement wins (a re-placement after the retire).
+        rec(
+            5, "placement", tenant_id="t0", uid=4, member=0,
+            bucket="b", spec="s0v2", **{"class": "standard"},
+        ),
+        rec(6, "steer", tenant_id="t0", uid=4, member=0, n_steps=24,
+            idem="k1", principal="alice"),
+    ]
+    state, anomalies = fold_router_records(records)
+    assert anomalies == []
+    assert set(state["placements"]) == {"t0", "t1"}
+    assert state["placements"]["t0"]["uid"] == 4
+    assert state["placements"]["t0"]["spec"] == "s0v2"
+    assert state["placements"]["t0"]["auto"] is False
+    # Migration provenance survives the fold (statusz migration tail).
+    t1 = state["placements"]["t1"]
+    assert t1["auto"] is True and t1["from"] == 1
+    assert t1["reason"] == "member-dead" and t1["member"] == 0
+    # retire-member discards the drain mark.
+    assert state["drained"] == [] and state["retired"] == [1]
+    assert state["uid_next"] == 5
+    # The gateway dedup map survives compaction through the fold.
+    assert state["idem"]["alice:k0"]["route"] == "placement"
+    assert state["idem"]["alice:k1"]["knobs"] == {"n_steps": 24}
+    # Folding the fold's own output as a base is a fixed point.
+    again, _ = fold_router_records([], base=state)
+    assert again == state
+
+
+def test_router_compaction_fires_and_snapshot_anchored_restart(tmp_path):
+    """Journal growth -> the shared ``compact`` decider -> placement-map
+    snapshot; a SIGKILLed router restarts anchored on the snapshot with
+    the identical placement map and exactly-once dedup intact."""
+    router, members = make_fleet(tmp_path, compact_records=4)
+    router.start()
+    for i in range(N_TENANTS):
+        router.submit(pso_spec(f"t{i}", i))
+    for i in range(N_TENANTS):
+        # Steer to the budget the tenants already have: journal growth
+        # with unchanged scheduling.
+        router.steer(f"t{i}", n_steps=12)
+    silent(router.step)  # the boundary where the decider fires
+    assert router.compactions >= 1 and router.compaction_failures == 0
+    assert router.journal.snapshot_seq is not None
+    before = {
+        tid: (p["member"], p["uid"]) for tid, p in router._placements.items()
+    }
+    # SIGKILL model: abandon the router, rebuild over the same root.
+    router2 = TenantRouter(
+        tmp_path / "router",
+        members,
+        fleet_dead_after=300.0,
+        fleet_start_grace=0.0,
+        compact_records=4,
+    )
+    try:
+        assert silent(router2.start) == N_TENANTS
+        assert router2.journal.snapshot_seq is not None  # anchored
+        assert router2.journal.snapshot_fallbacks == 0
+        assert router2.replay_seconds is not None
+        after = {
+            tid: (p["member"], p["uid"])
+            for tid, p in router2._placements.items()
+        }
+        assert after == before
+        # The placement records live only in the snapshot now — and a
+        # duplicate submit still dedups to the journaled ack.
+        kinds = journal_kinds(router2.root / TenantRouter.JOURNAL_NAME)
+        assert kinds.get("placement", 0) == 0
+        ack = router2.submit(pso_spec("t0", 0))
+        assert int(ack.uid) == before["t0"][1]
+        assert member_submit_count(
+            tmp_path / f"m{before['t0'][0]}", "t0"
+        ) == 1
+        run_silently(router2)
+        for i in range(N_TENANTS):
+            assert router2.result(f"t{i}") is not None
+        strip = router2._statusz()["journal"]
+        assert strip["armed"] is True
+        assert strip["snapshot_seq"] == router2.journal.snapshot_seq
+        assert strip["decisions"] == []  # fired pre-kill, not replayed
+    finally:
+        router2.close()
+
+
+@pytest.mark.parametrize(
+    "boundary",
+    [
+        "mid-snapshot-publish",
+        "post-snapshot-pre-copy",
+        "post-copy-pre-swap",
+        "post-swap-pre-gc",
+    ],
+)
+def test_router_kill_at_compaction_boundary_exactly_once(tmp_path, boundary):
+    """SIGKILL at every boundary of the router's compaction protocol:
+    the restarted router rebuilds the identical placement map and a
+    client retry stays exactly-once on both planes."""
+    router, members = make_fleet(tmp_path)
+    router.start()
+    for i in range(N_TENANTS):
+        router.submit(pso_spec(f"t{i}", i))
+    silent(router.step)  # mid-run: members hold live lanes
+    before = {
+        tid: (p["member"], p["uid"]) for tid, p in router._placements.items()
+    }
+    if boundary == "post-swap-pre-gc":
+        silent(router._compact_journal)
+        assert router.compactions == 1 and router.compaction_failures == 0
+    else:
+        step = {
+            "mid-snapshot-publish": 0,
+            "post-snapshot-pre-copy": 1,
+            "post-copy-pre-swap": 2,
+        }[boundary]
+        router.journal.store = FaultyStore(crash_saves=[step])
+        silent(router._compact_journal)
+        assert router.compactions == 0 and router.compaction_failures == 1
+    # SIGKILL: abandoned mid-protocol, no shutdown path runs.
+    router2 = TenantRouter(
+        tmp_path / "router",
+        members,
+        fleet_dead_after=300.0,
+        fleet_start_grace=0.0,
+    )
+    try:
+        assert silent(router2.start) == N_TENANTS
+        after = {
+            tid: (p["member"], p["uid"])
+            for tid, p in router2._placements.items()
+        }
+        assert after == before
+        if boundary == "post-swap-pre-gc":
+            assert router2.journal.snapshot_seq is not None
+        else:
+            # The swap never committed: plain full replay, all records.
+            assert router2.journal.snapshot_seq is None
+            kinds = journal_kinds(router2.root / TenantRouter.JOURNAL_NAME)
+            assert kinds.get("placement", 0) == N_TENANTS
+        # The client's retry of an already-placed tenant is an
+        # idempotent ack: one member admission, no new placement.
+        ack = router2.submit(pso_spec("t0", 0))
+        assert int(ack.uid) == before["t0"][1]
+        assert member_submit_count(
+            tmp_path / f"m{before['t0'][0]}", "t0"
+        ) == 1
+        run_silently(router2)
+        for i in range(N_TENANTS):
+            assert router2.result(f"t{i}") is not None
+    finally:
+        router2.close()

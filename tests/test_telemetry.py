@@ -646,6 +646,79 @@ def test_evoxtop_renders_and_probes(tmp_path):
         ep.stop()
 
 
+def test_evoxtop_journal_strip_and_snapshot_age_probe():
+    import sys
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import evoxtop
+    finally:
+        sys.path.pop(0)
+    status = {
+        "stats": {"segments_run": 1, "admitted": 1, "completed": 0,
+                  "restarts": 0, "sheds": 0, "rejections": 0},
+        "tenants": {},
+        "tenant_counts": {},
+        "journal": {
+            "bytes": 4096,
+            "records_since_snapshot": 7,
+            "snapshot_seq": 12,
+            "snapshot_age_seconds": 3.5,
+            "replay_seconds": 0.021,
+            "compactions": 2,
+            "compaction_failures": 1,
+            "fallbacks": 1,
+            "armed": True,
+            "decisions": [
+                {"seq": 9, "kind": "compact", "action": "compact",
+                 "evidence": {"records": 40}},
+            ],
+        },
+    }
+    health = {"hosts": {}}
+    screen = evoxtop.render(status, 200, health)
+    assert "journal: 4096 bytes" in screen
+    assert "records-since-snapshot 7" in screen
+    assert "snapshot #12 (3.5s old)" in screen
+    assert "replay 0.021s" in screen
+    assert "compactions 2" in screen
+    assert "FAILURES 1" in screen and "FALLBACKS 1" in screen
+    assert "compact decisions:" in screen
+    # A plane that never compacted renders "never" and flags disarmament.
+    never = dict(status)
+    never["journal"] = {"bytes": 512, "records_since_snapshot": 3,
+                        "snapshot_seq": None, "snapshot_age_seconds": None,
+                        "replay_seconds": None, "compactions": 0,
+                        "compaction_failures": 0, "fallbacks": 0,
+                        "armed": False, "decisions": []}
+    screen = evoxtop.render(never, 200, health)
+    assert "snapshot never" in screen
+    assert "(compaction unarmed)" in screen
+    # The staleness probe, pure-function form.
+    assert evoxtop.journal_snapshot_stale(status, 60.0) is None
+    assert "3.5s old" in evoxtop.journal_snapshot_stale(status, 1.0)
+    assert "never" in evoxtop.journal_snapshot_stale(never, 60.0)
+    assert evoxtop.journal_snapshot_stale({"journal": {}}, 1.0) is None
+    # One-shot probe semantics over a live endpoint: fresh snapshot passes,
+    # stale (or never-taken-with-records) trips rc 2.
+    ep = IntrospectionEndpoint(
+        statusz=lambda: status, healthz=lambda: (True, {})
+    ).start()
+    try:
+        assert evoxtop.main([ep.url]) == 0
+        assert evoxtop.main([ep.url, "--max-snapshot-age", "60"]) == 0
+        assert evoxtop.main([ep.url, "--max-snapshot-age", "1"]) == 2
+    finally:
+        ep.stop()
+    ep = IntrospectionEndpoint(
+        statusz=lambda: never, healthz=lambda: (True, {})
+    ).start()
+    try:
+        assert evoxtop.main([ep.url, "--max-snapshot-age", "60"]) == 2
+    finally:
+        ep.stop()
+
+
 # ---------------------------------------------------------------------------
 # daemon wiring (fast: single process, no fleet)
 # ---------------------------------------------------------------------------

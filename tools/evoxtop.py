@@ -18,9 +18,16 @@ counts, the migration event tail, and autoscale actions — and
 ``--member <i>`` drills into one member (its lanes per bucket, queue
 depths, exec-cache warmth, link faults, and resident tenants).
 
+The journal/recovery strip (daemon and router alike) shows how far the
+plane has grown past its last snapshot anchor — journal bytes, records
+since snapshot, snapshot age, the last measured cold-start replay time,
+and the tail of ``compact`` decisions — and ``--max-snapshot-age N``
+turns the one-shot mode into a bounded-recovery probe.
+
 jax-free and stdlib-only: runs anywhere the endpoint is reachable.
 Exit code 0 on a healthy scrape, 2 when ``/healthz`` reports unhealthy
-OR any router member is dead (so the one-shot mode doubles as a probe),
+OR any router member is dead OR the journal's snapshot is older than
+``--max-snapshot-age`` (so the one-shot mode doubles as a probe),
 1 when the endpoint is unreachable, and 3 when the daemon is healthy but
 its network gateway reports an auth-reject storm
 (``--max-auth-rejects``) — a scanner or a fleet with a rotated-out token
@@ -36,7 +43,7 @@ import time
 import urllib.error
 import urllib.request
 
-__all__ = ["fetch", "render", "main"]
+__all__ = ["fetch", "render", "main", "journal_snapshot_stale"]
 
 _STATUS_ORDER = ["running", "queued", "evicted", "quarantined", "completed"]
 
@@ -160,6 +167,69 @@ def _render_router(
         )
 
 
+def _render_journal(lines: list, status: dict) -> None:
+    """The journal/recovery strip: growth since the last snapshot
+    anchor, measured cold-start replay time, and the compaction
+    decision tail."""
+    journal = status.get("journal") or {}
+    if not journal:
+        return
+    age = journal.get("snapshot_age_seconds")
+    lines.append(
+        f"journal: {_fmt(journal.get('bytes'))} bytes"
+        f"  records-since-snapshot "
+        f"{_fmt(journal.get('records_since_snapshot'))}"
+        f"  snapshot "
+        + (
+            f"#{journal['snapshot_seq']} ({_fmt(age, 1)}s old)"
+            if journal.get("snapshot_seq") is not None
+            else "never"
+        )
+        + f"  replay {_fmt(journal.get('replay_seconds'), 3)}s"
+        + f"  compactions {_fmt(journal.get('compactions'))}"
+        + (
+            f"  FAILURES {journal['compaction_failures']}"
+            if journal.get("compaction_failures")
+            else ""
+        )
+        + (
+            f"  FALLBACKS {journal['fallbacks']}"
+            if journal.get("fallbacks")
+            else ""
+        )
+        + ("" if journal.get("armed") else "  (compaction unarmed)")
+    )
+    tail = journal.get("decisions") or []
+    if tail:
+        lines.append(
+            "  compact decisions: "
+            + "  ".join(
+                f"#{d.get('seq')} {d.get('action')}" for d in tail[-4:]
+            )
+        )
+
+
+def journal_snapshot_stale(status: dict, max_age: float) -> "str | None":
+    """Probe signal: a human-readable reason when the journal's snapshot
+    anchor is older than ``max_age`` seconds (or was never taken while
+    the journal holds records), else None."""
+    journal = status.get("journal") or {}
+    if not journal:
+        return None
+    age = journal.get("snapshot_age_seconds")
+    if age is None:
+        records = journal.get("records_since_snapshot") or 0
+        if records > 0:
+            return (
+                f"journal holds {records} records but was never "
+                f"snapshotted (> {max_age}s bound)"
+            )
+        return None
+    if age > max_age:
+        return f"journal snapshot is {age:.1f}s old (> {max_age}s bound)"
+    return None
+
+
 def router_dead_members(status: dict) -> list:
     """Indexes of members the router view reports dead (probe signal)."""
     members = (status.get("router") or {}).get("members") or {}
@@ -240,6 +310,7 @@ def render(
             f"  budget {_fmt(slo.get('budget_remaining'))}"
             f"  ({_fmt(slo.get('good'))} good / {_fmt(slo.get('bad'))} bad)"
         )
+    _render_journal(lines, status)
     gateway = status.get("gateway") or {}
     if gateway:
         requests = gateway.get("requests") or {}
@@ -351,6 +422,15 @@ def main(argv: list | None = None) -> int:
         help="probe mode: exit 3 when the gateway's cumulative 401 count "
         "exceeds this (auth-reject storm detector; default: off)",
     )
+    parser.add_argument(
+        "--max-snapshot-age",
+        type=float,
+        default=None,
+        help="probe mode: exit 2 when the journal's snapshot anchor is "
+        "older than this many seconds (or was never taken while the "
+        "journal holds records) — the bounded-recovery SLO guard "
+        "(default: off)",
+    )
     args = parser.parse_args(argv)
     base = args.url.rstrip("/")
     while True:
@@ -378,6 +458,13 @@ def main(argv: list | None = None) -> int:
                     file=sys.stderr,
                 )
                 return 2
+            if args.max_snapshot_age is not None:
+                stale = journal_snapshot_stale(
+                    status, args.max_snapshot_age
+                )
+                if stale is not None:
+                    print(f"evoxtop: {stale}", file=sys.stderr)
+                    return 2
             rejects = (status.get("gateway") or {}).get("auth_rejects")
             if (
                 args.max_auth_rejects is not None
