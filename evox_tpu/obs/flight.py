@@ -54,7 +54,7 @@ __all__ = [
 # Bus categories that can trip a postmortem dump.  "health" and "tenant"
 # additionally require warning severity (routine tenant lifecycle lines —
 # admission, completion — are info and must not dump).
-TRIGGER_CATEGORIES = ("restart", "preemption", "health", "tenant")
+TRIGGER_CATEGORIES = ("restart", "preemption", "health", "tenant", "invariant")
 
 # The 2-D signals (pop_diversity, velocity_norm) leave the compiled
 # program as RAW whole-tensor moment sums (``_pop_sum``/``_pop_sumsq``/

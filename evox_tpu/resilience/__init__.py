@@ -56,6 +56,21 @@ This subsystem adds the missing layer:
   front door's exactly-once admission contract is testable
   deterministically (the dropped-*reply* case is the post-journal-append
   crash window seen from the wire).
+* Chaos conduction (``chaos.py`` / ``invariants.py`` / ``testing.py``) —
+  a seeded, JSON-serializable :class:`ChaosPlan` composes every fault
+  plane above (process SIGKILL to members/router, disk, wire, and lane
+  faults, partition/straggle windows) into one deterministic timeline;
+  :class:`ChaosConductor` drives a routed multi-member fleet through it,
+  journaling every injected event (bit-for-bit reproducible from
+  ``(seed, plan digest)``) while continuously auditing the global
+  invariant registry (:data:`INVARIANTS` — exactly-once admission,
+  reply-after-journal, single-writer-per-namespace,
+  no-acked-record-lost, bounded disk, monotone counters, SLO
+  accounting); each :class:`InvariantViolation` is dumped as a
+  structured postmortem evidence bundle through the
+  :class:`~evox_tpu.obs.FlightRecorder` path.  ``testing.py`` is the
+  public kill-at-every-boundary scaffolding the acceptance suites (and
+  downstream users) drive.
 * Elastic topology (``elastic.py``) — checkpoint manifests record the mesh
   topology they were written under (:class:`MeshTopology`), and the runner's
   resume **re-meshes**: a run checkpointed on an N-device ``pop`` mesh
@@ -101,6 +116,13 @@ from .faults import (
     InjectedFatalError,
     InjectedStorageError,
 )
+from .invariants import (
+    INVARIANTS,
+    AuditContext,
+    InvariantViolation,
+    audit_invariants,
+)
+from .schedule import validate_schedule
 from .fleet import (
     EX_PREEMPTED,
     FleetError,
@@ -178,4 +200,34 @@ __all__ = [
     "WorkerSpec",
     "EX_PREEMPTED",
     "free_coordinator_port",
+    "validate_schedule",
+    "AuditContext",
+    "InvariantViolation",
+    "INVARIANTS",
+    "audit_invariants",
+    "ChaosPlan",
+    "ChaosConductor",
+    "ChaosReport",
+    "build_audit_context",
 ]
+
+# The chaos conductor drives the routed serving fleet, so ``chaos.py``
+# imports ``evox_tpu.service`` — which itself imports this package.  The
+# names resolve lazily to break the cycle (and to keep ``import
+# evox_tpu.resilience`` from dragging the whole serving stack in).
+_CHAOS_EXPORTS = (
+    "ChaosPlan",
+    "ChaosConductor",
+    "ChaosReport",
+    "build_audit_context",
+)
+
+
+def __getattr__(name: str):
+    if name in _CHAOS_EXPORTS:
+        from . import chaos
+
+        return getattr(chaos, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -119,6 +119,7 @@ from jax.sharding import SingleDeviceSharding
 
 from ..core import Problem, State
 from ..utils.checkpoint import CheckpointStore
+from .schedule import validate_schedule
 
 __all__ = [
     "FaultyProblem",
@@ -126,6 +127,7 @@ __all__ = [
     "InjectedBackendError",
     "InjectedFatalError",
     "InjectedStorageError",
+    "validate_schedule",
 ]
 
 
@@ -1078,14 +1080,45 @@ class FaultyStore(CheckpointStore):
         slow_saves: Sequence[int] = (),
         slow_seconds: float = 1.0,
     ):
-        self.crash_saves = frozenset(int(i) for i in crash_saves)
-        self.torn_saves = frozenset(int(i) for i in torn_saves)
+        # Construction-time audit, the FaultyProblem discipline: negative
+        # save indices and one save scheduled for two incompatible fates
+        # (an aborted write — crash/ENOSPC/EIO — never publishes, so it
+        # cannot also tear or bit-flip the published file) fail loudly
+        # here, never lazily mid-run.
+        schedules = validate_schedule(
+            "FaultyStore",
+            indices={
+                "crash_saves": crash_saves,
+                "torn_saves": torn_saves,
+                "flip_saves": flip_saves,
+                "enospc_saves": enospc_saves,
+                "eio_saves": eio_saves,
+                "slow_saves": slow_saves,
+            },
+            nonneg={
+                "torn_fraction": float(torn_fraction),
+                "slow_seconds": float(slow_seconds),
+            },
+            exclusive=[
+                ("crash_saves", "enospc_saves"),
+                ("crash_saves", "eio_saves"),
+                ("enospc_saves", "eio_saves"),
+                ("crash_saves", "torn_saves"),
+                ("crash_saves", "flip_saves"),
+                ("enospc_saves", "torn_saves"),
+                ("enospc_saves", "flip_saves"),
+                ("eio_saves", "torn_saves"),
+                ("eio_saves", "flip_saves"),
+            ],
+        )
+        self.crash_saves = schedules["crash_saves"]
+        self.torn_saves = schedules["torn_saves"]
         self.torn_fraction = float(torn_fraction)
-        self.flip_saves = frozenset(int(i) for i in flip_saves)
+        self.flip_saves = schedules["flip_saves"]
         self.flip_offset = None if flip_offset is None else int(flip_offset)
-        self.enospc_saves = frozenset(int(i) for i in enospc_saves)
-        self.eio_saves = frozenset(int(i) for i in eio_saves)
-        self.slow_saves = frozenset(int(i) for i in slow_saves)
+        self.enospc_saves = schedules["enospc_saves"]
+        self.eio_saves = schedules["eio_saves"]
+        self.slow_saves = schedules["slow_saves"]
         self.slow_seconds = float(slow_seconds)
         self._lock = threading.Lock()
         self.saves = 0  # completed open_temp calls == save attempts

@@ -44,6 +44,8 @@ import threading
 import time
 from typing import Any, Sequence
 
+from .schedule import validate_schedule
+
 __all__ = ["FaultyTransport", "TransportError"]
 
 
@@ -86,15 +88,38 @@ class FaultyTransport:
         delay_requests: Sequence[int] = (),
         delay_seconds: float = 0.05,
     ):
-        self.inner = inner
-        self.drop_requests = frozenset(int(i) for i in drop_requests)
-        self.drop_replies = frozenset(int(i) for i in drop_replies)
-        self.torn_replies = frozenset(int(i) for i in torn_replies)
-        self.torn_fraction = float(torn_fraction)
-        self.duplicate_requests = frozenset(
-            int(i) for i in duplicate_requests
+        # Construction-time audit, the FaultyProblem discipline: negative
+        # request indices and one request scheduled for two incompatible
+        # fates (a never-delivered request has no reply to drop, tear, or
+        # duplicate; a dropped reply is never observed torn) fail loudly
+        # here, never lazily mid-run.
+        schedules = validate_schedule(
+            "FaultyTransport",
+            indices={
+                "drop_requests": drop_requests,
+                "drop_replies": drop_replies,
+                "torn_replies": torn_replies,
+                "duplicate_requests": duplicate_requests,
+                "delay_requests": delay_requests,
+            },
+            nonneg={
+                "torn_fraction": float(torn_fraction),
+                "delay_seconds": float(delay_seconds),
+            },
+            exclusive=[
+                ("drop_requests", "drop_replies"),
+                ("drop_requests", "torn_replies"),
+                ("drop_requests", "duplicate_requests"),
+                ("drop_replies", "torn_replies"),
+            ],
         )
-        self.delay_requests = frozenset(int(i) for i in delay_requests)
+        self.inner = inner
+        self.drop_requests = schedules["drop_requests"]
+        self.drop_replies = schedules["drop_replies"]
+        self.torn_replies = schedules["torn_replies"]
+        self.torn_fraction = float(torn_fraction)
+        self.duplicate_requests = schedules["duplicate_requests"]
+        self.delay_requests = schedules["delay_requests"]
         self.delay_seconds = float(delay_seconds)
         self._lock = threading.Lock()
         self.requests = 0  # attempts routed through this wrapper

@@ -552,6 +552,10 @@ class ServiceDaemon:
         # itself here so /statusz grows a "gateway" section (request /
         # error / retry-after counters, per-principal tenant counts).
         self.gateway: Any | None = None
+        # An attached ChaosConductor registers itself the same way:
+        # /statusz grows a "chaos" section (plan digest, injected-event
+        # and violation counts for the live run).
+        self.chaos: Any | None = None
 
     # -- events / metrics ---------------------------------------------------
     def _event(self, msg: str, *, warn: bool = False, **payload: Any) -> None:
@@ -681,6 +685,11 @@ class ServiceDaemon:
                 out["gateway"] = self.gateway.statusz_payload()
             except Exception as e:  # noqa: BLE001 - read-only, fail-safe
                 out["gateway"] = {"error": f"{type(e).__name__}: {e}"}
+        if self.chaos is not None:
+            try:
+                out["chaos"] = self.chaos.statusz_payload()
+            except Exception as e:  # noqa: BLE001 - read-only, fail-safe
+                out["chaos"] = {"error": f"{type(e).__name__}: {e}"}
         if self.exec_cache is not None:
             cache = self.exec_cache.stats
             hits = int(getattr(cache, "hits", 0))

@@ -177,6 +177,9 @@ class Gateway:
         self._idem_replays = 0
         self._retry_after_sent = 0
         self._started = False
+        # An attached ChaosConductor registers itself here; the gateway
+        # statusz section then carries the live run's chaos strip too.
+        self.chaos: Any | None = None
         if daemon.endpoint is None:
             daemon.endpoint = IntrospectionEndpoint(
                 metrics=daemon._metrics_text,
@@ -882,7 +885,7 @@ class Gateway:
             head, sep, _tail = tid.partition(PRINCIPAL_SEP)
             if sep:
                 principals[head] = principals.get(head, 0) + 1
-        return {
+        payload = {
             "requests": {
                 f"{route}:{code}": n
                 for (route, code), n in sorted(self._requests.items())
@@ -896,3 +899,9 @@ class Gateway:
             "idem_keys": len(self._idem),
             "principals": principals,
         }
+        if self.chaos is not None:
+            try:
+                payload["chaos"] = self.chaos.statusz_payload()
+            except Exception as e:  # noqa: BLE001 - read-only, fail-safe
+                payload["chaos"] = {"error": f"{type(e).__name__}: {e}"}
+        return payload

@@ -204,6 +204,14 @@ class ServiceMember:
                 payload["slo"] = {"error": f"{type(e).__name__}: {e}"}
         return payload
 
+    def fault_events(self) -> list[tuple[int, str]]:
+        """Injected disk-fault events observed by this member's store
+        (``(save_index, kind)`` pairs when the store is a
+        :class:`~evox_tpu.resilience.FaultyStore`; empty otherwise).
+        The chaos conductor drains these into its canonical injected-
+        event journal."""
+        return list(getattr(self.daemon.store, "events", ()))
+
     def load(self) -> int:
         """Scalar placement load: live work on this member (running +
         queued).  The router breaks ties toward the lowest index."""

@@ -420,6 +420,10 @@ class TenantRouter:
         # An attached Gateway registers itself here (same seam as the
         # daemon's): /statusz then grows its "gateway" section.
         self.gateway: Any | None = None
+        # An attached ChaosConductor registers itself here the same way:
+        # /statusz grows a "chaos" section with the live run's plan
+        # digest, injected-event and violation counts.
+        self.chaos: Any | None = None
 
     # -- wiring ---------------------------------------------------------------
     # The router is pure host-side orchestration (placement, forwarding,
@@ -1593,6 +1597,11 @@ class TenantRouter:
                 out["gateway"] = self.gateway.statusz_payload()
             except Exception as e:  # noqa: BLE001 - read-only, fail-safe
                 out["gateway"] = {"error": f"{type(e).__name__}: {e}"}
+        if self.chaos is not None:
+            try:
+                out["chaos"] = self.chaos.statusz_payload()
+            except Exception as e:  # noqa: BLE001 - read-only, fail-safe
+                out["chaos"] = {"error": f"{type(e).__name__}: {e}"}
         return out
 
     def _flight_window(self, tenant_id: str) -> Any:

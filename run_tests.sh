@@ -178,6 +178,27 @@
 #                               (artifact under bench_artifacts/).  Runs
 #                               under a HARD wall-clock timeout like
 #                               --multihost.
+#   ./run_tests.sh --chaos      chaos-conduction lane: the whole-stack fault
+#                               orchestration suite (seeded ChaosPlan DSL,
+#                               the 3-member conductor acceptance run with
+#                               kills+wire+disk+partition faults and ZERO
+#                               invariant violations, bit-for-bit injected-
+#                               event replay from (seed, plan digest), the
+#                               invariant-liveness mutation matrix — every
+#                               registered checker proven to fire, incl.
+#                               against the live fleet with the postmortem
+#                               bundle asserted), then a full graftlint
+#                               sweep (injected faults must not have bent
+#                               the host-plane durability rules), then
+#                               tools/soak.py at the scaled rung: 2000
+#                               tenants churned through a 3-member fleet
+#                               in waves with mid-run member kills — zero
+#                               violations, O(wave) disk, and the fleet
+#                               SLO burn-rate report in the joinable
+#                               artifact (bench_artifacts/soak.*.json;
+#                               the 100k proof run of ROADMAP item 4 is
+#                               the slow-marked variant).  Runs under a
+#                               HARD wall-clock timeout like --multihost.
 #   ./run_tests.sh --multihost  multi-host fleet lane: the fast multihost
 #                               suite (FleetTopology/bootstrap/heartbeat/
 #                               verdict plumbing, single-writer checkpoint
@@ -374,6 +395,24 @@ if [ "$1" = "--hpo" ]; then
   # Fused nested evaluate must keep >=90% of a hand-rolled
   # vmap-of-fori_loop inner loop on the fixed ladder config.
   exec timeout -k 30 600 "${CPU_ENV[@]}" python tools/bench_hpo_overhead.py
+fi
+if [ "$1" = "--chaos" ]; then
+  shift
+  # Hard timeout (SIGKILL escalation), same pattern as --serve: a wedged
+  # drain (a fault mix the fleet cannot finish under) must fail the lane
+  # loudly, never hang it.
+  CHAOS_TIMEOUT="${EVOX_TPU_CHAOS_TIMEOUT:-1200}"
+  timeout -k 30 "$CHAOS_TIMEOUT" \
+    "${CPU_ENV[@]}" python -m pytest tests/test_chaos.py -q -m 'not slow' "$@" || exit 1
+  # Fault-orchestration discipline: injecting chaos must not have bent
+  # the host-plane rules (GL009 durable artifact writes, GL010 journal-
+  # before-ack, GL011-GL013) anywhere in the conductor/soak path.
+  python -m tools.graftlint || exit 1
+  # The scaled soak rung: churn waves with chaos on, exits nonzero on any
+  # invariant violation or incomplete wave; artifact + CPU-provisional
+  # BENCH_HISTORY row under bench_artifacts/soak.<platform>.json.
+  exec timeout -k 30 900 "${CPU_ENV[@]}" python tools/soak.py \
+    --tenants 2000 --members 3 --wave 250 --chaos
 fi
 if [ "$1" = "--multihost" ]; then
   shift

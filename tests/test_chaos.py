@@ -1,0 +1,524 @@
+"""Chaos-conduction tests: the plan DSL, the conductor acceptance run,
+invariant-liveness mutations, and the soak ladder's tier-1 rung.
+
+The headline suites:
+
+* **Conductor acceptance** — a seeded :class:`ChaosPlan` mixing process
+  kills, wire faults, disk faults, a partition window, and a lane
+  plateau over a routed 3-member fleet completes every tenant with ZERO
+  invariant violations, a ``json.load``-clean report carrying the SLO
+  burn-rate section — and a second run of the same ``(seed, plan)``
+  over a fresh root replays the injected-event journal **bit-for-bit**
+  (equal SHA-256), the determinism contract that makes any chaos
+  failure a reproducible artifact instead of a flake.
+* **Invariant liveness** — for EVERY checker registered in
+  :data:`~evox_tpu.resilience.INVARIANTS` there is a seeded tampering
+  of the audit snapshot (a double-minted placement, a torn ack, a rogue
+  namespace writer, a vanished acked tenant, an unpurged retirement, a
+  decreasing lifetime counter, corrupted SLO arithmetic) that MUST
+  produce that checker's violation; a completeness assertion fails the
+  suite if a new invariant lands without its mutation.  A live-fleet
+  variant tampers the real fleet (orphan namespace on disk, forged
+  ack) and shows the conductor's audit catches it and dumps the
+  FlightRecorder postmortem bundle.
+* **Soak rung** — ``tools/soak.py`` churns 1000 tenants through a
+  3-member fleet in waves (with a mid-run member kill), proving
+  O(wave) disk residency, zero violations, and the joinable burn-rate
+  artifact shape; the 100k proof run is the slow-marked variant
+  (ROADMAP item 4).
+
+Plus plan-DSL validation units (the :func:`validate_schedule`
+discipline one level up) and the injector schedule audits themselves.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from evox_tpu.resilience import (
+    INVARIANTS,
+    AuditContext,
+    FaultyStore,
+    FaultyTransport,
+    audit_invariants,
+)
+from evox_tpu.resilience.chaos import (
+    ChaosConductor,
+    ChaosPlan,
+    build_audit_context,
+)
+from evox_tpu.resilience.testing import flip_bit, kill_points
+from test_daemon import shared_cache
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import soak  # noqa: E402
+
+
+# -- injector schedule audits (the validate_schedule seam) --------------------
+
+
+def test_faulty_store_schedule_rejects_negative_index():
+    with pytest.raises(ValueError, match="negative"):
+        FaultyStore(enospc_saves=[-1])
+
+
+def test_faulty_store_schedule_rejects_conflicting_fates():
+    """One save index scheduled for two incompatible outcomes is a plan
+    contradiction, caught at construction — never a silent precedence."""
+    with pytest.raises(ValueError, match="conflicting"):
+        FaultyStore(crash_saves=[2], enospc_saves=[2])
+    with pytest.raises(ValueError, match="conflicting"):
+        FaultyStore(enospc_saves=[1], eio_saves=[1])
+
+
+def test_faulty_transport_schedule_rejects_conflicts_and_negatives():
+    with pytest.raises(ValueError, match="conflicting"):
+        FaultyTransport(None, drop_requests=[0], drop_replies=[0])
+    with pytest.raises(ValueError, match="negative"):
+        FaultyTransport(None, delay_requests=[-2])
+    with pytest.raises(ValueError, match="delay_seconds"):
+        FaultyTransport(None, delay_requests=[0], delay_seconds=-1.0)
+
+
+# -- plan DSL -----------------------------------------------------------------
+
+
+def test_plan_from_seed_is_deterministic_and_json_round_trips():
+    a = ChaosPlan.from_seed(42)
+    b = ChaosPlan.from_seed(42)
+    assert a.digest() == b.digest()
+    assert a.digest() != ChaosPlan.from_seed(43).digest()
+    # The wire format IS the identity: a JSON round trip (including
+    # through a string, as a journal or a config file would hold it)
+    # reconstructs the same digest.
+    restored = ChaosPlan.from_json(json.loads(json.dumps(a.to_json())))
+    assert restored.digest() == a.digest()
+
+
+def test_plan_validation_rejects_malformed_scenarios():
+    def plan(**overrides):
+        base = dict(
+            name="p", seed=0, rounds=4, members=2, tenants=1,
+            submit_rounds=[0],
+        )
+        base.update(overrides)
+        return ChaosPlan(**base)
+
+    plan()  # the base scenario is valid
+    with pytest.raises(ValueError, match="unknown op"):
+        plan(events=[{"round": 0, "op": "melt-member", "member": 0}])
+    with pytest.raises(ValueError, match="missing field"):
+        plan(events=[{"round": 0, "op": "kill-member"}])
+    with pytest.raises(ValueError, match="outside"):
+        plan(events=[{"round": 9, "op": "kill-router"}])
+    with pytest.raises(ValueError, match="outside"):
+        plan(events=[{"round": 0, "op": "kill-member", "member": 5}])
+    with pytest.raises(ValueError, match="empty or runs past"):
+        plan(events=[
+            {"round": 2, "op": "partition-member", "member": 0, "until": 2},
+        ])
+    with pytest.raises(ValueError, match="delay_seconds"):
+        plan(events=[
+            {"round": 0, "op": "straggle-member", "member": 0,
+             "until": 2, "delay_seconds": -0.5},
+        ])
+    with pytest.raises(ValueError, match="every tenant"):
+        plan(submit_rounds=[])
+    with pytest.raises(ValueError, match="outside"):
+        plan(submit_rounds=[7])
+    with pytest.raises(ValueError, match="store_faults scope"):
+        plan(store_faults={"member:9": {"eio_saves": [0]}})
+    # A plan's store/wire kwargs are audited by constructing the
+    # injector: the contradiction surfaces with the injector's message.
+    with pytest.raises(ValueError, match="conflicting"):
+        plan(store_faults={"router": {"crash_saves": [0],
+                                      "eio_saves": [0]}})
+    with pytest.raises(ValueError, match="wire_faults key"):
+        plan(wire_faults={"7": {"drop_replies": [0]}})
+    with pytest.raises(ValueError, match="lane_faults"):
+        plan(lane_faults={"0": {"nan_everything": True}})
+
+
+def test_plan_rejects_contradictory_member_fates():
+    """A SIGKILL landing inside a partition window (nothing reaches the
+    process) is the cross-event contradiction ``validate_schedule``'s
+    exclusivity rule catches one level up."""
+    with pytest.raises(ValueError, match="conflicting ChaosPlan member 0"):
+        ChaosPlan(
+            name="p", seed=0, rounds=6, members=2, tenants=0,
+            events=[
+                {"round": 1, "op": "partition-member", "member": 0,
+                 "until": 4},
+                {"round": 2, "op": "kill-member", "member": 0},
+            ],
+        )
+
+
+# -- the acceptance run -------------------------------------------------------
+
+PLAN_SEED = 11
+
+
+def _acceptance_plan():
+    return ChaosPlan.from_seed(
+        PLAN_SEED, members=3, tenants=8, rounds=7,
+        kills=2, wire=3, disk=2, lanes=1, partitions=1,
+    )
+
+
+@pytest.fixture(scope="module")
+def chaos_runs(tmp_path_factory):
+    """Run the SAME seeded plan twice over fresh roots; yields
+    ``(conductor_a, report_a, report_b)`` with conductor A left open for
+    the statusz / live-mutation suites."""
+    plan_a = _acceptance_plan()
+    plan_b = _acceptance_plan()
+    root_a = tmp_path_factory.mktemp("chaos_a")
+    root_b = tmp_path_factory.mktemp("chaos_b")
+    conductor_a = ChaosConductor(
+        root_a, plan_a, exec_cache=shared_cache()
+    )
+    report_a = conductor_a.run()
+    conductor_b = ChaosConductor(
+        root_b, plan_b, exec_cache=shared_cache()
+    )
+    try:
+        report_b = conductor_b.run()
+    finally:
+        conductor_b.close()
+    yield conductor_a, report_a, report_b
+    conductor_a.close()
+
+
+def test_chaos_acceptance_zero_violations(chaos_runs):
+    """The seeded kills+wire+disk+partition+lane scenario completes every
+    tenant exactly once with ZERO invariant violations."""
+    conductor, report, _ = chaos_runs
+    assert report.violations == []
+    assert report.completed == report.tenants == 8
+    assert report.pending == 0
+    assert report.acks >= report.tenants
+    assert report.injected_events > 0
+    # The plan really mixed planes: process + wire + disk faults all fired.
+    sources = {e["source"].split(":")[0] for e in conductor.injected}
+    assert "plan" in sources
+    assert sources & {"wire", "store"}
+    kinds = {e["kind"] for e in conductor.injected}
+    assert kinds & {"kill-member", "kill-router"}
+
+
+def test_chaos_event_journal_replays_bit_for_bit(chaos_runs):
+    """Same ``(seed, plan digest)`` → byte-identical injected-event
+    journal: any chaos failure reproduces exactly."""
+    _, report_a, report_b = chaos_runs
+    assert report_a.plan_digest == report_b.plan_digest
+    assert report_a.event_log_sha256 == report_b.event_log_sha256
+    assert (
+        Path(report_a.event_log).read_bytes()
+        != b""
+    )
+
+
+def test_chaos_report_is_json_clean_with_burn_rates(chaos_runs):
+    """The persisted report parses clean and carries the SLO burn-rate
+    section per member scope."""
+    conductor, report, _ = chaos_runs
+    on_disk = json.loads(
+        (conductor.root / ChaosConductor.REPORT).read_text()
+    )
+    assert on_disk["plan_digest"] == report.plan_digest
+    assert on_disk["violations"] == []
+    scopes = on_disk["slo_burn_report"]["scopes"]
+    assert scopes, "burn report must cover at least one member scope"
+    for rows in scopes.values():
+        for row in rows:
+            assert {"slo", "good", "bad", "target"} <= set(row)
+
+
+def test_chaos_statusz_strip_on_router_and_daemon(chaos_runs):
+    """The conductor registers itself on the planes it drives: the
+    router's and each member daemon's ``/statusz`` carry the chaos
+    section the ``evoxtop`` strip renders."""
+    conductor, _, _ = chaos_runs
+    for payload in (
+        conductor.router._statusz()["chaos"],
+        conductor.members[0].daemon._statusz()["chaos"],
+        conductor.statusz_payload(),
+    ):
+        assert payload["plan"] == conductor.plan.name
+        assert {"round", "injected_events", "violations", "completed",
+                "live_tenants", "worst_burn_rate"} <= set(payload)
+
+
+def test_chaos_statusz_strip_on_gateway(chaos_runs):
+    from evox_tpu.service import Gateway
+
+    conductor, _, _ = chaos_runs
+    gw = Gateway(conductor.members[1].daemon, tokens={"tok": "alice"})
+    assert "chaos" not in gw.statusz_payload()
+    gw.chaos = conductor
+    assert gw.statusz_payload()["chaos"]["plan"] == conductor.plan.name
+
+
+# -- invariant liveness: every checker has a mutation that trips it ----------
+
+
+def _clean_ctx() -> AuditContext:
+    """A minimal healthy snapshot: one acked, placed, journaled tenant."""
+    return AuditContext(
+        round=1,
+        acks=[{"tenant_id": "t0", "uid": 0, "kind": "submit", "round": 1}],
+        router_records=[
+            {"kind": "placement", "data": {"tenant_id": "t0", "member": 0}},
+        ],
+        member_records={0: [
+            {"kind": "submit", "data": {"tenant_id": "t0"}},
+        ]},
+        placements={"t0": {"member": 0, "uid": 0}},
+        live_members={0},
+        resident={0: {"t0"}},
+        counters={"c": 2.0},
+        previous_counters={"c": 1.0},
+        records_since_snapshot={"router": 3},
+        compact_records={"router": 100},
+        slo_reports={"member:0": [{
+            "slo": "s", "tenant_class": "standard", "signal": "x",
+            "target": 0.9, "threshold": 1.0, "window": 100,
+            "good": 9, "bad": 1, "burn_rate": 1.0, "budget_remaining": 0.0,
+        }]},
+    )
+
+
+def _mutate_double_mint(ctx):
+    ctx.router_records.append(
+        {"kind": "placement", "data": {"tenant_id": "t0", "member": 1}}
+    )
+
+
+def _mutate_torn_ack(ctx):
+    ctx.acks.append(
+        {"tenant_id": "ghost", "uid": 9, "kind": "submit", "round": 1}
+    )
+    # Keep the torn ack isolated to its own checker: the ghost is
+    # "accounted for" downstream, and a compacted router journal keeps
+    # exactly-once from also firing on the missing placement record.
+    ctx.completed.add("ghost")
+    ctx.compacted_scopes.add("router")
+
+
+def _mutate_rogue_writer(ctx):
+    ctx.live_members.add(1)
+    ctx.resident[1] = {"t0"}
+
+
+def _mutate_lost_record(ctx):
+    # The acked tenant vanishes: neither placed, completed, nor forgotten
+    # (its journal evidence survives, so exactly-once stays quiet).
+    ctx.placements.pop("t0")
+    ctx.resident[0].discard("t0")
+
+
+def _mutate_unbounded_disk(ctx):
+    ctx.forgotten.add("gone")
+    ctx.resident[0].add("gone")
+
+
+def _mutate_counter_regression(ctx):
+    ctx.counters["c"] = 0.0
+
+
+def _mutate_slo_arithmetic(ctx):
+    ctx.slo_reports["member:0"][0]["burn_rate"] = 0.123
+
+
+MUTATIONS = {
+    "exactly-once-admission": _mutate_double_mint,
+    "reply-after-journal": _mutate_torn_ack,
+    "single-writer-per-namespace": _mutate_rogue_writer,
+    "no-acked-record-lost": _mutate_lost_record,
+    "bounded-disk": _mutate_unbounded_disk,
+    "monotone-counters": _mutate_counter_regression,
+    "slo-accounting": _mutate_slo_arithmetic,
+}
+
+
+def test_every_registered_invariant_has_a_mutation():
+    """The liveness proof is COMPLETE: a new invariant registered
+    without a mutation that trips it fails here."""
+    assert set(MUTATIONS) == set(INVARIANTS)
+
+
+def test_clean_snapshot_passes_every_checker():
+    assert audit_invariants(_clean_ctx()) == []
+
+
+@pytest.mark.parametrize("name", sorted(INVARIANTS))
+def test_invariant_is_live(name):
+    """Each checker actually fires on its seeded tampering — and ONLY
+    the tampered promise breaks (the mutations are surgical)."""
+    ctx = _clean_ctx()
+    MUTATIONS[name](ctx)
+    found = INVARIANTS[name](ctx)
+    assert found, f"mutation for {name!r} did not trip its checker"
+    assert all(v.invariant == name for v in found)
+    assert all(v.round == ctx.round for v in found)
+    # Violations are JSON-ready postmortem evidence.
+    for v in found:
+        payload = json.loads(json.dumps(v.to_json()))
+        assert payload["invariant"] == name
+        assert payload["summary"]
+    fired = {v.invariant for v in audit_invariants(ctx)}
+    assert name in fired
+
+
+def test_some_mutation_extras():
+    """Edge variants the single-mutation matrix doesn't cover: the
+    orphan namespace, journal growth past an armed threshold, and an
+    SLO window claiming events but publishing no burn rate."""
+    ctx = _clean_ctx()
+    ctx.resident[0].add("orphan")
+    assert any(
+        "orphan" in v.summary
+        for v in INVARIANTS["bounded-disk"](ctx)
+    )
+    ctx = _clean_ctx()
+    ctx.records_since_snapshot["router"] = 999
+    assert INVARIANTS["bounded-disk"](ctx)
+    ctx = _clean_ctx()
+    ctx.slo_reports["member:0"][0]["burn_rate"] = None
+    assert any(
+        "unpublished" in v.summary
+        for v in INVARIANTS["slo-accounting"](ctx)
+    )
+    # An EMPTY window publishing None is fine — no evidence, no verdict.
+    ctx = _clean_ctx()
+    row = ctx.slo_reports["member:0"][0]
+    row.update(good=0, bad=0, burn_rate=None, budget_remaining=None)
+    assert INVARIANTS["slo-accounting"](ctx) == []
+
+
+def test_live_fleet_mutation_trips_audit_and_dumps_postmortem(chaos_runs):
+    """Tampering the REAL fleet — an orphaned namespace forged onto a
+    member's disk — is caught by the conductor's next audit, and the
+    violation lands as a FlightRecorder postmortem bundle."""
+    conductor, report, _ = chaos_runs
+    assert report.violations == []  # healthy before the tampering
+    member = conductor.members[0]
+    orphan = Path(member.root) / "tenants" / "forged"
+    orphan.mkdir(parents=True)
+    try:
+        found = conductor._audit()
+    finally:
+        orphan.rmdir()
+    assert any(
+        v.invariant == "bounded-disk" and "forged" in v.summary
+        for v in found
+    )
+    bundles = [
+        b for b in conductor.recorder.bundles if "invariant" in b.name
+    ]
+    assert bundles, "an invariant violation must dump a postmortem bundle"
+    manifest = json.loads((bundles[-1] / "manifest.json").read_text())
+    assert manifest["kind"] == "invariant"
+    assert manifest["detail"]["invariant"] == "bounded-disk"
+
+
+def test_live_fleet_audit_context_matches_reality(chaos_runs):
+    """``build_audit_context`` snapshots the fleet faithfully: every
+    completed tenant accounted, journals parsed, every member live."""
+    conductor, report, _ = chaos_runs
+    ctx = build_audit_context(
+        conductor.router,
+        acks=conductor.acks,
+        round=conductor.round,
+        forgotten=conductor.forgotten,
+    )
+    assert len(ctx.completed) == report.completed
+    assert ctx.live_members == set(range(conductor.plan.members))
+    assert set(ctx.placements) <= {a["tenant_id"] for a in conductor.acks}
+    placement_kinds = {r["kind"] for r in ctx.router_records}
+    assert "placement" in placement_kinds or "router" in ctx.compacted_scopes
+
+
+# -- public kill-point scaffolding -------------------------------------------
+
+
+def test_kill_points_cover_every_plane():
+    assert set(["daemon", "gateway", "router"]) <= set(
+        __import__(
+            "evox_tpu.resilience.testing", fromlist=["KILL_POINTS"]
+        ).KILL_POINTS
+    )
+    assert kill_points("router")
+    with pytest.raises(ValueError, match="unknown plane"):
+        kill_points("mainframe")
+
+
+def test_flip_bit_damages_exactly_one_byte(tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"\x00" * 64)
+    flip_bit(p, offset=10)
+    data = p.read_bytes()
+    assert len(data) == 64
+    assert sum(1 for b in data if b != 0) == 1
+
+
+def test_evoxtop_chaos_strip(chaos_runs):
+    """The ``evoxtop`` screen renders the chaos section any conducted
+    plane publishes — and screams the violation count when non-zero."""
+    import evoxtop
+
+    conductor, _, _ = chaos_runs
+    status = conductor.router._statusz()
+    screen = evoxtop.render(status, 200, {"hosts": {}})
+    assert f"chaos [{conductor.plan.name}]" in screen
+    assert "injected" in screen
+    hot = dict(status)
+    hot["chaos"] = dict(status["chaos"], violations=2)
+    screen = evoxtop.render(hot, 200, {"hosts": {}})
+    assert "VIOLATIONS 2" in screen
+    assert evoxtop.chaos_violations(hot) == 2
+    assert evoxtop.chaos_violations({}) == 0
+
+
+# -- the soak ladder ----------------------------------------------------------
+
+
+def _assert_soak_green(report, tenants, wave):
+    assert report["violations"] == []
+    assert report["completed"] == report["tenants"] == tenants
+    # O(wave) residency, NOT O(ever-admitted): churn retired every wave.
+    assert report["peak_resident_namespaces"] <= wave
+    assert report["final_resident_namespaces"] == 0
+    # The artifact shape check_bench_history.py joins on.
+    assert {"metric", "value", "platform", "slo_burn_report"} <= set(report)
+    assert report["value"] > 0
+    json.loads(json.dumps(report))  # artifact is JSON-clean end to end
+
+
+def test_soak_rung_1k_with_chaos(tmp_path):
+    """The tier-1 rung of the scale ladder (ROADMAP item 4): 1000
+    tenants churn through a 3-member fleet in waves of 250 with a
+    mid-run member SIGKILL — zero violations, O(wave) disk, burn-rate
+    report attached."""
+    report = soak.run_soak(
+        tmp_path, tenants=1000, members=3, wave=250, chaos=True, seed=7
+    )
+    _assert_soak_green(report, 1000, 250)
+    assert report["injected_events"] > 0
+    assert report["waves"] == 4
+
+
+@pytest.mark.slow
+def test_soak_100k_proof_run(tmp_path):
+    """The ROADMAP item 4 proof: 100k tenants, chaos on, SLO burn-rate
+    report — the full-scale load test behind the cross-host scheduler."""
+    report = soak.run_soak(
+        tmp_path, tenants=100_000, members=3, wave=500, chaos=True, seed=4
+    )
+    _assert_soak_green(report, 100_000, 500)
+    assert report["injected_events"] > 0

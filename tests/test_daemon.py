@@ -31,6 +31,15 @@ import pytest
 from evox_tpu.algorithms import PSO
 from evox_tpu.problems.numerical import Ackley
 from evox_tpu.resilience import FaultyStore, Preempted
+from evox_tpu.resilience.testing import (
+    assert_states_equal,
+    kill_points,
+    last_checkpoint_digests,
+    npify,
+    run_silently,
+    silent,
+    verify_tenants_bit_identical,
+)
 from evox_tpu.service import (
     AdmissionError,
     JournalError,
@@ -44,7 +53,7 @@ from evox_tpu.service import (
 )
 from evox_tpu.service.daemon import fold_daemon_records
 from evox_tpu.utils import ExecutableCache, abstract_signature
-from evox_tpu.utils.checkpoint import ReadOnlyCheckpointStore, read_manifest
+from evox_tpu.utils.checkpoint import ReadOnlyCheckpointStore
 
 DIM = 4
 POP = 8
@@ -91,42 +100,10 @@ def make_daemon(root, **overrides):
     return ServiceDaemon(root, **kwargs)
 
 
-def _npify(x):
-    if isinstance(x, jax.Array) and jax.dtypes.issubdtype(
-        x.dtype, jax.dtypes.prng_key
-    ):
-        return np.asarray(jax.random.key_data(x))
-    return np.asarray(x)
-
-
-def assert_states_equal(a, b, context=""):
-    leaves_a = jax.tree_util.tree_leaves_with_path(a)
-    leaves_b = jax.tree_util.tree_leaves(b)
-    assert len(leaves_a) == len(leaves_b)
-    for (path, la), lb_ in zip(leaves_a, leaves_b):
-        assert np.array_equal(_npify(la), _npify(lb_)), (
-            f"{context}: leaf {jax.tree_util.keystr(path)} differs"
-        )
-
-
-def last_checkpoint_digests(root, tenant_id):
-    ns = os.path.join(root, "tenants", tenant_id)
-    newest = sorted(f for f in os.listdir(ns) if f.endswith(".npz"))[-1]
-    manifest = read_manifest(os.path.join(ns, newest))
-    return newest, manifest["leaf_digests"]
-
-
-def run_silently(daemon, *args, **kwargs):
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        daemon.run(*args, **kwargs)
-
-
-def silent(fn, *args, **kwargs):
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        return fn(*args, **kwargs)
-
+# assert_states_equal / last_checkpoint_digests / run_silently / silent
+# live in evox_tpu.resilience.testing now — ONE definition shared by every
+# kill matrix (and re-exported here for the suites importing them from
+# this module).
 
 # -- journal: append / replay / chaos ---------------------------------------
 
@@ -574,15 +551,7 @@ def _reference_results(tmp_path, n_steps=12):
     }
 
 
-@pytest.mark.parametrize(
-    "kill_point",
-    [
-        "post-submit-pre-journal-ack",
-        "post-ack-pre-admit",
-        "mid-run",
-        "post-checkpoint",
-    ],
-)
+@pytest.mark.parametrize("kill_point", kill_points("daemon"))
 def test_kill_restart_bit_identical(tmp_path, kill_point):
     """SIGKILL (modelled as abandonment — no shutdown code runs) at each
     lifecycle point; the restarted daemon finishes every tenant
@@ -632,16 +601,9 @@ def test_kill_restart_bit_identical(tmp_path, kill_point):
     for i in resubmit_after_restart:
         restarted.submit(pso_spec(f"t{i}", i))
     run_silently(restarted)
-    for i in range(N_TENANTS):
-        tid = f"t{i}"
-        assert restarted.tenant(tid).status is TenantStatus.COMPLETED
-        assert_states_equal(
-            expected[tid], restarted.result(tid), f"{kill_point}: {tid}"
-        )
-        name, digests = last_checkpoint_digests(root, tid)
-        assert (name, digests) == expected_digests[tid], (
-            f"{kill_point}: {tid} final checkpoint digests differ"
-        )
+    verify_tenants_bit_identical(
+        restarted, root, expected, expected_digests, kill_point
+    )
 
 
 def test_restart_after_completion_materializes_results_without_lanes(
@@ -920,7 +882,7 @@ def test_preempted_daemon_journals_and_restart_resumes(tmp_path):
             key = jax.tree_util.keystr(path)
             if "num_preemptions" in key:
                 continue
-            assert np.array_equal(_npify(la), _npify(lb_)), (
+            assert np.array_equal(npify(la), npify(lb_)), (
                 f"{tid}: leaf {key} differs"
             )
 

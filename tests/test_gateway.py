@@ -36,14 +36,14 @@ from evox_tpu.service import (
     TenantClass,
     TenantStatus,
 )
-from test_daemon import (
+from evox_tpu.resilience.testing import (
     assert_states_equal,
+    kill_points,
     last_checkpoint_digests,
-    make_daemon,
-    pso_spec,
     run_silently,
     silent,
 )
+from test_daemon import make_daemon, pso_spec
 
 TOKENS = {"tok-alice": "alice", "tok-bob": "bob"}
 N = 2  # tenants in the kill matrix
@@ -433,10 +433,7 @@ def _reference(tmp_path, n_steps=10):
     return results, digests, history
 
 
-@pytest.mark.parametrize(
-    "kill_point",
-    ["pre-append", "post-append-pre-reply", "mid-run", "post-checkpoint"],
-)
+@pytest.mark.parametrize("kill_point", kill_points("gateway"))
 def test_kill_at_every_boundary_http_matrix(tmp_path, kill_point):
     expected, expected_digests, expected_history = _reference(tmp_path)
     root = tmp_path / "killed"

@@ -30,6 +30,7 @@ import pytest
 from evox_tpu.algorithms import PSO
 from evox_tpu.core import State
 from evox_tpu.problems.numerical import Sphere
+from evox_tpu.resilience.testing import assert_states_equal, flip_bit
 from evox_tpu.resilience import (
     FaultyProblem,
     FaultyStore,
@@ -59,29 +60,11 @@ def _wf(problem, **kwargs):
     return StdWorkflow(PSO(16, LB, UB), problem, **kwargs)
 
 
-def _flat(state):
-    out = []
-    for leaf in jax.tree_util.tree_leaves(state):
-        if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
-            leaf.dtype, jax.dtypes.prng_key
-        ):
-            out.append(np.asarray(jax.random.key_data(leaf)))
-        else:
-            out.append(np.asarray(leaf))
-    return out
-
-
-def _assert_states_identical(a, b):
-    la, lb = _flat(a), _flat(b)
-    assert len(la) == len(lb)
-    for i, (x, y) in enumerate(zip(la, lb)):
-        np.testing.assert_array_equal(x, y, err_msg=f"state leaf {i}")
-
-
-def _flip_bit(path, offset=None):
-    raw = bytearray(path.read_bytes())
-    raw[(len(raw) // 2) if offset is None else offset] ^= 0x01
-    path.write_bytes(bytes(raw))
+# State compare and bit-flip corruption live in
+# evox_tpu.resilience.testing now — the ONE definition every kill/chaos
+# matrix shares.
+_assert_states_identical = assert_states_equal
+_flip_bit = flip_bit
 
 
 # -- PreemptionGuard unit behavior -------------------------------------------

@@ -42,16 +42,19 @@ from evox_tpu.service import (
     ServiceMember,
     TenantRouter,
 )
+from evox_tpu.resilience.testing import (
+    assert_states_equal,
+    kill_points,
+    last_checkpoint_digests,
+    run_silently,
+    silent,
+)
 from test_daemon import (
     N_TENANTS,
     _reference_results,
-    assert_states_equal,
-    last_checkpoint_digests,
     make_daemon,
     pso_spec,
-    run_silently,
     shared_cache,
-    silent,
 )
 
 TOKENS = {"tok-alice": "alice"}
@@ -251,10 +254,7 @@ def test_routed_fleet_bit_identical_to_single_daemon(tmp_path):
 # -- acceptance: kill the router at every forward boundary -------------------
 
 
-@pytest.mark.parametrize(
-    "boundary",
-    ["pre-journal", "post-journal-pre-forward", "post-forward-pre-ack"],
-)
+@pytest.mark.parametrize("boundary", kill_points("router"))
 def test_router_kill_at_forward_boundary_exactly_once(tmp_path, boundary):
     ref = make_daemon(tmp_path / "ref")
     ref.start()

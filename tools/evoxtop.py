@@ -209,6 +209,46 @@ def _render_journal(lines: list, status: dict) -> None:
         )
 
 
+def _render_chaos(lines: list, status: dict) -> None:
+    """The chaos/soak strip: rendered whenever a :class:`ChaosConductor`
+    or a ``tools/soak.py`` run has registered itself on the scraped
+    plane (``router.chaos`` / ``daemon.chaos`` / ``gateway.chaos``) —
+    live run progress, injected-event count, invariant violations (the
+    headline number: non-zero means a broken promise with a postmortem
+    bundle behind it), and the worst SLO burn rate across the fleet."""
+    chaos = status.get("chaos") or {}
+    if not chaos:
+        return
+    violations = chaos.get("violations") or 0
+    lines.append(
+        f"chaos [{chaos.get('plan')}]"
+        + (f" #{chaos['digest']}" if chaos.get("digest") else "")
+        + f": round {_fmt(chaos.get('round'))}/{_fmt(chaos.get('rounds'))}"
+        f"  injected {_fmt(chaos.get('injected_events'))}"
+        + (
+            f"  VIOLATIONS {violations}"
+            if violations
+            else "  violations 0"
+        )
+    )
+    lines.append(
+        f"  tenants: {_fmt(chaos.get('completed'))} done"
+        f"  {_fmt(chaos.get('live_tenants'))} live"
+        + (
+            f"  {_fmt(chaos.get('pending'))} pending"
+            if chaos.get("pending") is not None
+            else ""
+        )
+        + f"  worst burn {_fmt(chaos.get('worst_burn_rate'))}"
+    )
+
+
+def chaos_violations(status: dict) -> int:
+    """Probe signal: invariant violations reported by an attached chaos
+    or soak run (non-zero is a broken global promise)."""
+    return int((status.get("chaos") or {}).get("violations") or 0)
+
+
 def journal_snapshot_stale(status: dict, max_age: float) -> "str | None":
     """Probe signal: a human-readable reason when the journal's snapshot
     anchor is older than ``max_age`` seconds (or was never taken while
@@ -331,6 +371,7 @@ def render(
                 )
             )
     _render_router(lines, status, member)
+    _render_chaos(lines, status)
     decisions = status.get("decisions") or []
     if decisions:
         tail = decisions[-3:]

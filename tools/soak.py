@@ -1,0 +1,467 @@
+"""The tenant-churn soak ladder: 100k tenants through a routed fleet.
+
+ROADMAP item 4 asked for the proof behind the scheduler: *"prove it with
+a 100k-tenant, multi-host load test with SLO burn-rate report"*.  This
+is that proof, runnable at every rung of the scale ladder:
+
+* **tier-1 rung** (default, ``--tenants 1000``): three members, churn
+  waves of 250 — small enough for the CPU test lane, big enough that an
+  O(ever-admitted) disk or journal regression shows.
+* **the 100k rung** (``--tenants 100000``): the slow-marked proof run
+  (``tests/test_chaos.py::test_soak_100k_slow`` drives it).
+
+Each wave submits a batch through the :class:`~evox_tpu.service.
+TenantRouter` (journal-before-ack placement per tenant), drains it to
+completion, audits the full :data:`~evox_tpu.resilience.INVARIANTS`
+registry over a :func:`~evox_tpu.resilience.chaos.build_audit_context`
+fleet snapshot (exactly-once admission, no acked record lost, bounded
+disk, monotone counters, SLO accounting...), then retires the wave
+(fetch → forget → namespace purge) so live state — disk, placement map,
+compacted journals — stays **O(wave), not O(ever-admitted)**.  With
+``--chaos``, seeded member SIGKILLs (abandon + rebuild over the same
+root) and heal-on-retry disk faults ride along between waves.
+
+The run publishes ``evox_soak_*`` gauges (the ``evoxtop`` strip renders
+them via the router's ``chaos`` statusz section), writes the
+``bench_artifacts/soak.<backend>.json`` artifact — ``metric`` /
+``value`` / ``platform`` keys so ``tools/check_bench_history.py`` joins
+it — carrying the fleet's full SLO burn-rate report, and exits non-zero
+on any invariant violation or incomplete wave.
+
+Run::
+
+    ./run_tests.sh --chaos      # suite + graftlint sweep + this, scaled
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/soak.py
+    ... python tools/soak.py --tenants 100000 --chaos
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+import warnings
+from pathlib import Path
+from typing import Any
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from evox_tpu.algorithms import PSO  # noqa: E402
+from evox_tpu.obs import default_slos  # noqa: E402
+from evox_tpu.problems.numerical import Ackley  # noqa: E402
+from evox_tpu.resilience import FaultyStore  # noqa: E402
+from evox_tpu.resilience.chaos import build_audit_context  # noqa: E402
+from evox_tpu.resilience.invariants import audit_invariants  # noqa: E402
+from evox_tpu.service import (  # noqa: E402
+    AdmissionError,
+    ServiceMember,
+    TenantRouter,
+    TenantSpec,
+    TenantStatus,
+)
+from evox_tpu.utils import ExecutableCache  # noqa: E402
+
+_HISTORY_PATH = os.path.join(REPO, "BENCH_HISTORY.json")
+
+DIM = 4
+POP = 8
+LB = -32.0 * np.ones(DIM)
+UB = 32.0 * np.ones(DIM)
+
+
+def _silent(fn, *args, **kwargs):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return fn(*args, **kwargs)
+
+
+class SoakMonitor:
+    """The live soak strip: attached as ``router.chaos`` so ``/statusz``
+    (and ``evoxtop``) renders the run's progress — live tenants,
+    injected events, violations, worst burn rate."""
+
+    def __init__(self, name: str, tenants: int):
+        self.name = name
+        self.tenants = int(tenants)
+        self.wave = 0
+        self.waves = 0
+        self.completed = 0
+        self.live_tenants = 0
+        self.injected_events = 0
+        self.violations = 0
+        self.worst_burn_rate: float | None = None
+
+    def statusz_payload(self) -> dict[str, Any]:
+        return {
+            "plan": self.name,
+            "round": self.wave,
+            "rounds": self.waves,
+            "tenants": self.tenants,
+            "completed": self.completed,
+            "live_tenants": self.live_tenants,
+            "injected_events": self.injected_events,
+            "violations": self.violations,
+            "worst_burn_rate": self.worst_burn_rate,
+        }
+
+
+def run_soak(
+    root: Any,
+    *,
+    tenants: int = 1000,
+    members: int = 3,
+    wave: int = 250,
+    n_steps: int = 4,
+    lanes_per_pack: int = 16,
+    segment_steps: int = 4,
+    compact_records: int = 2000,
+    chaos: bool = False,
+    kill_every: int = 2,
+    seed: int = 0,
+    audit_every_wave: bool = True,
+    max_wave_rounds: int = 2000,
+) -> dict[str, Any]:
+    """Run the churn ladder; returns the JSON-ready soak report.
+
+    Raises on a wedged wave (a tenant that never completes); invariant
+    violations do NOT raise — they are collected into the report (the
+    caller gates), matching the chaos conductor's collect-everything
+    discipline."""
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    rng = random.Random(int(seed))
+    exec_cache = ExecutableCache(root / "exec")
+
+    def build_member(index: int, store: FaultyStore | None = None):
+        kwargs: dict[str, Any] = dict(
+            lanes_per_pack=lanes_per_pack,
+            segment_steps=segment_steps,
+            seed=0,
+            exec_cache=exec_cache,
+            slos=default_slos(),
+            compact_records=compact_records,
+        )
+        if store is not None:
+            kwargs["store"] = store
+        return ServiceMember(
+            index, root / f"m{index}", heartbeat_dir=root / "beats", **kwargs
+        )
+
+    fleet = {i: build_member(i) for i in range(members)}
+    router = TenantRouter(
+        root / "router",
+        [fleet[i] for i in sorted(fleet)],
+        fleet_dead_after=300.0,
+        fleet_start_grace=0.0,
+        compact_records=compact_records,
+    )
+    monitor = SoakMonitor(f"soak-{tenants}", tenants)
+    router.chaos = monitor
+    _silent(router.start)
+
+    def spec(uid: int) -> TenantSpec:
+        return TenantSpec(
+            f"s{uid:06d}",
+            PSO(POP, LB, UB),
+            Ackley(),
+            n_steps=n_steps,
+            uid=uid,
+        )
+
+    violations: list[dict[str, Any]] = []
+    forgotten: set[str] = set()
+    prev_counters: dict[str, float] = {}
+    completed_total = 0
+    injected = 0
+    peak_resident = 0
+    uid = 0
+    waves = (tenants + wave - 1) // wave
+    monitor.waves = waves
+    started = time.monotonic()
+    for w in range(waves):
+        monitor.wave = w
+        if chaos and w and w % kill_every == 0:
+            # SIGKILL as abandonment: drop the member object, rebuild
+            # over the same root; sometimes the rebuilt store fails its
+            # first save (heals on retry) — the journal-retry path.
+            index = rng.randrange(members)
+            store = (
+                FaultyStore(enospc_saves=[0])
+                if rng.random() < 0.5
+                else None
+            )
+            fleet.pop(index, None)
+            member = build_member(index, store)
+            fleet[index] = member
+            router._register(member)
+            router._dead.discard(index)
+            router.links[index] = member
+            _silent(member.start)
+            injected += 1 + (1 if store is not None else 0)
+            monitor.injected_events = injected
+        count = min(wave, tenants - w * wave)
+        wave_acks: list[dict[str, Any]] = []
+        wave_ids: list[str] = []
+        for _ in range(count):
+            s = spec(uid)
+            uid += 1
+            for _attempt in range(8):
+                try:
+                    record = _silent(router.submit, s)
+                    break
+                except AdmissionError:
+                    _silent(router.step)
+            else:
+                raise RuntimeError(
+                    f"wave {w}: tenant {s.tenant_id} refused 8 times"
+                )
+            wave_acks.append(
+                {
+                    "tenant_id": s.tenant_id,
+                    "uid": int(record.uid),
+                    "kind": "submit",
+                    "round": w,
+                }
+            )
+            wave_ids.append(s.tenant_id)
+        rounds = 0
+        while rounds < max_wave_rounds:
+            _silent(router.step)
+            rounds += 1
+            done = True
+            for tid in wave_ids:
+                placement = router._placements.get(tid)
+                if placement is None:
+                    done = False
+                    break
+                record = fleet[placement["member"]].daemon.tenant(tid)
+                if record.status is not TenantStatus.COMPLETED:
+                    done = False
+                    break
+            if done:
+                break
+        else:
+            raise RuntimeError(
+                f"wave {w}: not complete after {max_wave_rounds} rounds"
+            )
+        if audit_every_wave or w == waves - 1:
+            # Audit BEFORE retiring the wave: placements, namespaces and
+            # this wave's acks are all still live evidence.
+            counters = {
+                "soak.completed": float(completed_total),
+                "soak.waves": float(w + 1),
+            }
+            ctx = build_audit_context(
+                router,
+                acks=wave_acks,
+                round=w,
+                forgotten=forgotten,
+                counters=counters,
+                previous_counters=prev_counters,
+            )
+            prev_counters = dict(ctx.counters)
+            found = audit_invariants(ctx)
+            violations.extend(v.to_json() for v in found)
+            peak_resident = max(
+                peak_resident,
+                sum(len(names) for names in ctx.resident.values()),
+            )
+            monitor.violations = len(violations)
+        # Retire the wave: fetch is implicit in COMPLETED; forget purges
+        # the namespace — live state stays O(wave).
+        for tid in wave_ids:
+            placement = router._placements.pop(tid)
+            fleet[placement["member"]].daemon.forget(tid)
+            forgotten.add(tid)
+        completed_total += len(wave_ids)
+        monitor.completed = completed_total
+        monitor.live_tenants = len(router._placements)
+        worst = None
+        for member in fleet.values():
+            if member.daemon.slo is None:
+                continue
+            for row in member.daemon.slo.describe():
+                burn = row.get("burn_rate")
+                if burn is not None and (worst is None or burn > worst):
+                    worst = float(burn)
+        monitor.worst_burn_rate = worst
+        router._gauge(
+            "evox_soak_completed", float(completed_total),
+            "Tenants churned through the soak ladder, lifetime.",
+        )
+        router._gauge(
+            "evox_soak_live_tenants", float(len(router._placements)),
+            "Tenants currently placed (bounded by the wave size).",
+        )
+        router._gauge(
+            "evox_soak_violations", float(len(violations)),
+            "Invariant violations detected by the soak audit.",
+        )
+        router._gauge(
+            "evox_soak_injected_events", float(injected),
+            "Chaos events injected between soak waves.",
+        )
+        if worst is not None:
+            router._gauge(
+                "evox_soak_worst_burn_rate", worst,
+                "Worst SLO burn rate across the fleet.",
+            )
+    elapsed = time.monotonic() - started
+    slo_report = {
+        f"member:{i}": member.daemon.slo.describe()
+        for i, member in sorted(fleet.items())
+        if member.daemon.slo is not None
+    }
+    worst = None
+    for rows in slo_report.values():
+        for row in rows:
+            burn = row.get("burn_rate")
+            if burn is not None and (worst is None or burn > worst):
+                worst = float(burn)
+    records_since = {
+        f"member:{i}": int(
+            getattr(member.daemon.journal, "records_since_snapshot", 0) or 0
+        )
+        for i, member in sorted(fleet.items())
+    }
+    records_since["router"] = int(
+        getattr(router.journal, "records_since_snapshot", 0) or 0
+    )
+    resident_final = sum(
+        1
+        for i, member in fleet.items()
+        for p in (Path(member.root) / "tenants").glob("*")
+        if p.is_dir()
+    )
+    tps = completed_total / elapsed if elapsed > 0 else 0.0
+    report = {
+        "metric": (
+            f"Soak churn throughput, tenants/sec ({members} members, "
+            f"wave {wave}, pop={POP}, dim={DIM}, {n_steps} steps)"
+        ),
+        "value": round(tps, 3),
+        "platform": jax.default_backend(),
+        "tenants": tenants,
+        "completed": completed_total,
+        "waves": waves,
+        "chaos": bool(chaos),
+        "injected_events": injected,
+        "violations": violations,
+        "elapsed_seconds": round(elapsed, 3),
+        "peak_resident_namespaces": peak_resident,
+        "final_resident_namespaces": resident_final,
+        "records_since_snapshot": records_since,
+        "compact_records": compact_records,
+        "slo_burn_report": {
+            "worst_burn_rate": worst,
+            "scopes": slo_report,
+        },
+    }
+    router.close()
+    for member in fleet.values():
+        member.close()
+    return report
+
+
+def _record_history(report: dict[str, Any]) -> list[str]:
+    history = {}
+    if os.path.exists(_HISTORY_PATH):
+        try:
+            with open(_HISTORY_PATH) as f:
+                history = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            history = {}
+    metric = report["metric"]
+    platform = report["platform"]
+    entry = history.get(metric)
+    if entry is not None and not (
+        platform == "tpu" and entry.get("platform") == "cpu"
+    ):
+        return []  # anchored already (TPU re-anchor replaces CPU rows)
+    record = {
+        "baseline": report["value"],
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_runs": 1,
+    }
+    if platform != "tpu":
+        record["indicative_only"] = True
+        record["note"] = (
+            "CPU-provisional: dispatch-bound host timing; "
+            "tools/run_tpu_sweep.sh re-anchors"
+        )
+    history[metric] = record
+    with open(_HISTORY_PATH, "w") as f:
+        json.dump(history, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return [metric]
+
+
+def write_artifact(report: dict[str, Any]) -> str:
+    out_dir = os.path.join(REPO, "bench_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, f"soak.{report['platform']}.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    return out_path
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--tenants", type=int, default=1000)
+    parser.add_argument("--members", type=int, default=3)
+    parser.add_argument("--wave", type=int, default=250)
+    parser.add_argument("--n-steps", type=int, default=4)
+    parser.add_argument("--chaos", action="store_true",
+                        help="seeded member kills + disk faults between waves")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--workdir", default=None,
+                        help="run directory (default: a fresh tempdir, removed)")
+    args = parser.parse_args(argv)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="evox_soak_")
+    try:
+        report = run_soak(
+            workdir,
+            tenants=args.tenants,
+            members=args.members,
+            wave=args.wave,
+            n_steps=args.n_steps,
+            chaos=args.chaos,
+            seed=args.seed,
+        )
+    finally:
+        if args.workdir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+    created = _record_history(report)
+    report["history_rows_created"] = created
+    out_path = write_artifact(report)
+    print(
+        f"soak: {report['completed']}/{report['tenants']} tenants through "
+        f"{report['waves']} waves in {report['elapsed_seconds']}s "
+        f"({report['value']} tenants/s), {report['injected_events']} chaos "
+        f"events, {len(report['violations'])} violations, "
+        f"peak resident {report['peak_resident_namespaces']} namespaces"
+    )
+    print(f"recorded -> {os.path.relpath(out_path, REPO)}")
+    if report["violations"]:
+        print("INVARIANT VIOLATIONS:")
+        for v in report["violations"]:
+            print(f"  [{v['invariant']}] {v['summary']}")
+        return 1
+    if report["completed"] != report["tenants"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
